@@ -1,0 +1,1 @@
+"""Deterministic host-engine core: rng, virtual time, executor, runtime."""

@@ -1,0 +1,80 @@
+"""Simulation configuration.
+
+Reference: `madsim/src/sim/config.rs` — ``Config{net, tcp}`` with TOML
+(de)serialization and a stable hash printed alongside the failing seed so
+repros verify they ran the same config (`config.rs:25-31`,
+`runtime/mod.rs:192-199`).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Tuple
+
+
+@dataclass
+class NetConfig:
+    """Network fault model (`net/network.rs:74-94`): Bernoulli packet loss +
+    uniform per-message latency, defaults 0% loss and 1-10 ms."""
+
+    packet_loss_rate: float = 0.0
+    send_latency: Tuple[float, float] = (0.001, 0.010)  # seconds, [min, max)
+
+
+@dataclass
+class TcpConfig:
+    """Placeholder mirroring the reference's empty TcpConfig
+    (`net/tcp/config.rs:7-13`)."""
+
+
+@dataclass
+class FsConfig:
+    """Fault model for the simulated fs (reference leaves these as TODOs at
+    `fs.rs:51-53,183` — implemented for real here)."""
+
+    # Uniform extra latency per I/O op, seconds.
+    io_latency: Tuple[float, float] = (0.0, 0.0)
+
+
+@dataclass
+class Config:
+    net: NetConfig = field(default_factory=NetConfig)
+    tcp: TcpConfig = field(default_factory=TcpConfig)
+    fs: FsConfig = field(default_factory=FsConfig)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Config":
+        cfg = Config()
+        net = d.get("net", {})
+        if "packet_loss_rate" in net:
+            cfg.net.packet_loss_rate = float(net["packet_loss_rate"])
+        if "send_latency" in net:
+            lo, hi = net["send_latency"]
+            cfg.net.send_latency = (float(lo), float(hi))
+        fs = d.get("fs", {})
+        if "io_latency" in fs:
+            lo, hi = fs["io_latency"]
+            cfg.fs.io_latency = (float(lo), float(hi))
+        return cfg
+
+    @staticmethod
+    def from_toml(text: str) -> "Config":
+        import tomllib
+
+        return Config.from_dict(tomllib.loads(text))
+
+    def to_dict(self) -> dict:
+        return {
+            "net": {
+                "packet_loss_rate": self.net.packet_loss_rate,
+                "send_latency": list(self.net.send_latency),
+            },
+            "tcp": {},
+            "fs": {"io_latency": list(self.fs.io_latency)},
+        }
+
+    def hash(self) -> str:
+        """Stable fingerprint for repro banners (`config.rs:27-31` analog)."""
+        canon = json.dumps(self.to_dict(), sort_keys=True)
+        return hashlib.sha256(canon.encode()).hexdigest()[:16]

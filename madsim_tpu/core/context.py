@@ -1,0 +1,74 @@
+"""Thread-local simulation context.
+
+Reference: `madsim/src/sim/runtime/context.rs` — two thread-locals (current
+runtime Handle, current TaskInfo) with RAII enter/exit guards; net/fs calls
+resolve their node implicitly through them.
+
+Thread-local (not plain module globals) because the multi-seed test driver
+runs each simulation on its own OS thread (`builder.rs:118-136` analog), and
+threads must not see each other's runtime.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from .runtime import Handle
+    from .task import TaskInfo
+
+_tls = threading.local()
+
+
+class NoRuntimeError(RuntimeError):
+    pass
+
+
+def current_handle() -> "Handle":
+    handle = getattr(_tls, "handle", None)
+    if handle is None:
+        raise NoRuntimeError(
+            "there is no simulation running: this API must be called from "
+            "within a madsim_tpu Runtime (e.g. inside Runtime.block_on)"
+        )
+    return handle
+
+
+def try_current_handle() -> Optional["Handle"]:
+    return getattr(_tls, "handle", None)
+
+
+def current_task() -> "TaskInfo":
+    task = getattr(_tls, "task", None)
+    if task is None:
+        raise NoRuntimeError("not inside a simulation task")
+    return task
+
+
+def try_current_task() -> Optional["TaskInfo"]:
+    return getattr(_tls, "task", None)
+
+
+def current_node_id() -> int:
+    return current_task().node.id
+
+
+@contextmanager
+def enter_handle(handle: "Handle"):
+    prev = getattr(_tls, "handle", None)
+    _tls.handle = handle
+    try:
+        yield
+    finally:
+        _tls.handle = prev
+
+
+@contextmanager
+def enter_task(task: "TaskInfo"):
+    prev = getattr(_tls, "task", None)
+    _tls.task = task
+    try:
+        yield
+    finally:
+        _tls.task = prev

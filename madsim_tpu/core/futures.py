@@ -1,0 +1,144 @@
+"""Future/channel primitives for the deterministic executor.
+
+These are the host-engine analogs of the oneshot/mpsc channels the reference
+builds its endpoint mailboxes and relay tasks from (`net/endpoint.rs:241-306`,
+`net/mod.rs:224-260`). They are deliberately *not* asyncio futures: wakeups
+must route through the simulation's ready queue so the seeded random scheduler
+stays the single source of interleaving.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional
+
+
+class Cancelled(Exception):
+    """Raised when awaiting a future that was cancelled / a closed channel."""
+
+
+_PENDING = object()
+
+
+class SimFuture:
+    """A one-shot value container awaitable from simulation coroutines."""
+
+    __slots__ = ("_result", "_exception", "_callbacks")
+
+    def __init__(self):
+        self._result: Any = _PENDING
+        self._exception: Optional[BaseException] = None
+        self._callbacks: List[Callable[["SimFuture"], None]] = []
+
+    def done(self) -> bool:
+        return self._result is not _PENDING or self._exception is not None
+
+    def set_result(self, value: Any) -> None:
+        if self.done():
+            return
+        self._result = value
+        self._wake()
+
+    def set_exception(self, exc: BaseException) -> None:
+        if self.done():
+            return
+        self._exception = exc
+        self._wake()
+
+    def cancel(self) -> None:
+        self.set_exception(Cancelled())
+
+    def result(self) -> Any:
+        if self._exception is not None:
+            raise self._exception
+        if self._result is _PENDING:
+            raise RuntimeError("future is not done")
+        return self._result
+
+    def add_done_callback(self, cb: Callable[["SimFuture"], None]) -> None:
+        if self.done():
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def _wake(self) -> None:
+        callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(self)
+
+    def __await__(self):
+        if not self.done():
+            yield self
+        return self.result()
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Unbounded FIFO channel (mpsc-style) for simulation coroutines.
+
+    FIFO delivery order is intentional: nondeterminism comes from the
+    scheduler's random task pick, never from data structures.
+    """
+
+    __slots__ = ("_items", "_waiters", "_closed")
+
+    def __init__(self):
+        self._items: Deque[Any] = deque()
+        self._waiters: Deque[SimFuture] = deque()
+        self._closed = False
+
+    def send(self, item: Any) -> None:
+        if self._closed:
+            raise ChannelClosed("send on closed channel")
+        while self._waiters:
+            waiter = self._waiters.popleft()
+            if not waiter.done():
+                waiter.set_result(item)
+                return
+        self._items.append(item)
+
+    def try_recv(self):
+        if self._items:
+            return True, self._items.popleft()
+        return False, None
+
+    async def recv(self) -> Any:
+        """Receive the next item; raises ChannelClosed when drained+closed."""
+        if self._items:
+            return self._items.popleft()
+        if self._closed:
+            raise ChannelClosed()
+        fut = SimFuture()
+        self._waiters.append(fut)
+        try:
+            return await fut
+        except BaseException:
+            # Cancelled receiver (task abort / timeout): give an already-
+            # delivered item back to the queue head, or unregister, so the
+            # message is not swallowed.
+            if fut.done() and fut._exception is None:
+                self._items.appendleft(fut._result)
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        while self._waiters:
+            waiter = self._waiters.popleft()
+            if not waiter.done():
+                waiter.set_exception(ChannelClosed())
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __len__(self) -> int:
+        return len(self._items)

@@ -1,0 +1,62 @@
+"""Per-subsystem simulator plugin registry.
+
+Reference: `madsim/src/sim/plugin.rs:18-54` — a ``Simulator`` trait
+(constructed with rand/time/config handles, notified on node create/reset)
+and a global TypeId→instance lookup. Users register their own subsystem
+simulators via ``Runtime.add_simulator`` (e.g. a storage-service simulator),
+exactly like RisingWave does on the reference.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, Type, TypeVar
+
+if TYPE_CHECKING:
+    from .runtime import Handle
+
+S = TypeVar("S", bound="Simulator")
+
+
+class Simulator:
+    """Base class for subsystem simulators (network, fs, user-defined).
+
+    Subclasses get the full runtime handle at construction so they can reach
+    the deterministic rng, virtual clock, executor and config.
+    """
+
+    def __init__(self, handle: "Handle"):
+        self.handle = handle
+
+    def create_node(self, node_id: int) -> None:
+        """Called when a node is created."""
+
+    def reset_node(self, node_id: int) -> None:
+        """Called on node kill/restart: drop all node state (sockets, files
+        that weren't synced, ...)."""
+
+
+class SimulatorRegistry:
+    def __init__(self):
+        self._sims: Dict[type, Simulator] = {}
+
+    def add(self, sim: Simulator) -> None:
+        self._sims[type(sim)] = sim
+
+    def get(self, cls: Type[S]) -> S:
+        try:
+            return self._sims[cls]  # type: ignore[return-value]
+        except KeyError:
+            raise KeyError(f"simulator {cls.__name__} is not registered") from None
+
+    def contains(self, cls: type) -> bool:
+        return cls in self._sims
+
+    def all(self):
+        return list(self._sims.values())
+
+
+def simulator(cls: Type[S]) -> S:
+    """Look up a registered simulator on the current runtime
+    (`plugin.rs:45-54` analog)."""
+    from . import context
+
+    return context.current_handle().sims.get(cls)

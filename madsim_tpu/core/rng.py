@@ -1,0 +1,156 @@
+"""Deterministic global RNG for the host engine.
+
+Reference semantics: one global seeded RNG behind a lock, drawn by every
+scheduler/simulator decision (`madsim/src/sim/rand.rs:50-108`), plus a
+determinism log/check facility used by ``Runtime.check_determinism``
+(`rand.rs:84-107`).
+
+TPU-first redesign: instead of a stateful SmallRng, this is a thin stateful
+*cursor* over the counter-based Threefry stream in
+:mod:`madsim_tpu.ops.threefry`. The cursor (draw index) is the only mutable
+state, so any draw can be replayed or re-derived as a pure function of
+``(seed, stream, index)`` — the property the batched device engine relies on.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..ops.threefry import derive_stream_np, draw_np, seed_to_key
+
+# Named stream ids. The host engine draws everything from GLOBAL (matching the
+# reference's single SmallRng); the device engine uses per-purpose streams.
+STREAM_GLOBAL = 0
+STREAM_TIME_BASE = 1
+STREAM_SCHED = 2
+STREAM_NET = 3
+
+
+class DeterminismError(Exception):
+    """Raised by check-mode replay on the first divergent RNG access."""
+
+
+class GlobalRng:
+    """Seeded deterministic RNG with an optional access log for the checker."""
+
+    def __init__(self, seed: int, stream: int = STREAM_GLOBAL):
+        self.seed = seed & ((1 << 64) - 1)
+        k0, k1 = seed_to_key(self.seed)
+        self._k0, self._k1 = derive_stream_np(k0, k1, stream)
+        self._counter = 0
+        self._buf: Optional[int] = None
+        # Determinism checker state (`rand.rs:84-107`): in 'log' mode every
+        # access appends hash(value ^ hash(elapsed)); in 'check' mode accesses
+        # are compared against the recorded log and the first divergence panics
+        # with its virtual timestamp.
+        self._mode: Optional[str] = None
+        self._log: List[int] = []
+        self._check_pos = 0
+        self._clock_ns: Callable[[], int] = lambda: 0
+
+    # -- wiring ------------------------------------------------------------
+    def set_clock(self, clock_ns: Callable[[], int]) -> None:
+        """Install the virtual-clock reader used to timestamp log entries."""
+        self._clock_ns = clock_ns
+
+    # -- determinism log ---------------------------------------------------
+    def enable_log(self) -> None:
+        self._mode = "log"
+        self._log = []
+
+    def enable_check(self, log: List[int]) -> None:
+        self._mode = "check"
+        self._log = log
+        self._check_pos = 0
+
+    def take_log(self) -> List[int]:
+        log, self._log = self._log, []
+        self._mode = None
+        return log
+
+    def _observe(self, value: int) -> None:
+        if self._mode is None:
+            return
+        t = self._clock_ns()
+        entry = zlib.crc32((value & 0xFFFFFFFF).to_bytes(4, "little") + t.to_bytes(16, "little", signed=True))
+        if self._mode == "log":
+            self._log.append(entry)
+        else:
+            if self._check_pos >= len(self._log) or self._log[self._check_pos] != entry:
+                raise DeterminismError(
+                    f"non-determinism detected at {t / 1e9:.9f}s "
+                    f"(RNG access #{self._check_pos} diverged from the recorded run)"
+                )
+            self._check_pos += 1
+
+    # -- raw draws ---------------------------------------------------------
+    def next_u32(self) -> int:
+        if self._buf is not None:
+            v, self._buf = self._buf, None
+        else:
+            x0, x1 = draw_np(self._k0, self._k1, self._counter)
+            self._counter += 1
+            v, self._buf = int(x0), int(x1)
+        self._observe(v)
+        return v
+
+    def next_u64(self) -> int:
+        x0, x1 = draw_np(self._k0, self._k1, self._counter)
+        self._counter += 1
+        self._buf = None
+        v = (int(x1) << 32) | int(x0)
+        self._observe(v)
+        return v
+
+    # -- distribution helpers (rand-crate-style surface) -------------------
+    def gen_range(self, low: int, high: int) -> int:
+        """Uniform integer in [low, high). high must be > low."""
+        width = high - low
+        if width <= 0:
+            raise ValueError(f"empty range [{low}, {high})")
+        return low + self.next_u64() % width
+
+    def random(self) -> float:
+        """Uniform float in [0, 1) with 53 bits of precision."""
+        return (self.next_u64() >> 11) * (2.0 ** -53)
+
+    def gen_bool(self, p: float) -> bool:
+        if p <= 0.0:
+            # Still consume a draw so control flow doesn't change the stream.
+            self.random()
+            return False
+        if p >= 1.0:
+            self.random()
+            return True
+        return self.random() < p
+
+    def gen_range_f64(self, low: float, high: float) -> float:
+        return low + self.random() * (high - low)
+
+    def shuffle(self, seq: list) -> None:
+        """In-place Fisher-Yates shuffle."""
+        for i in range(len(seq) - 1, 0, -1):
+            j = self.gen_range(0, i + 1)
+            seq[i], seq[j] = seq[j], seq[i]
+
+    def choice(self, seq):
+        return seq[self.gen_range(0, len(seq))]
+
+    def gen_bytes(self, n: int) -> bytes:
+        words = []
+        for _ in range((n + 3) // 4):
+            words.append(self.next_u32().to_bytes(4, "little"))
+        return b"".join(words)[:n]
+
+
+def make_numpy_generator(seed: int, stream: int) -> np.random.Generator:
+    """A numpy Generator seeded deterministically from (seed, stream).
+
+    For bulk host-side sampling where bit-parity with the device engine is not
+    required (e.g. test data generation). The simulation decision path never
+    uses this — it draws from :class:`GlobalRng` only.
+    """
+    k0, k1 = derive_stream_np(*seed_to_key(seed), stream)
+    return np.random.Generator(np.random.Philox((int(k0) << 32) | int(k1)))

@@ -1,0 +1,202 @@
+"""Runtime / Handle / NodeBuilder — the composition root.
+
+Reference: `madsim/src/sim/runtime/mod.rs` — ``Runtime`` wires rng + executor
++ time + default simulators (`:50-64`); ``Handle`` is the cloneable supervisor
+(seed, kill/restart/pause/resume, create_node, simulator registry, config;
+`:201-279`); ``NodeBuilder`` configures name/ip/cores/init with init re-run on
+crash-restart (`:282-355`); ``check_determinism`` runs a test twice with RNG
+log/replay (`:164-189`).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Coroutine, Optional, Union
+
+from . import context
+from .config import Config
+from .plugin import Simulator, SimulatorRegistry
+from .rng import GlobalRng
+from .task import Executor, Node, TimeLimitExceeded  # noqa: F401 (re-export)
+from .timewheel import TimeRuntime, to_ns
+
+
+class Handle:
+    """Cloneable supervisor handle over one simulation world."""
+
+    def __init__(self, seed: int, config: Config, rng: GlobalRng, time: TimeRuntime, executor: Executor):
+        self.seed = seed
+        self.config = config
+        self.rand = rng
+        self.time = time
+        self.task = executor
+        self.sims = SimulatorRegistry()
+
+    @staticmethod
+    def current() -> "Handle":
+        return context.current_handle()
+
+    # -- fault injection (`runtime/mod.rs:241-268`) ------------------------
+    def kill(self, node: Union[int, "NodeHandle"]) -> None:
+        self.task.kill(_node_id(node))
+
+    def restart(self, node: Union[int, "NodeHandle"]) -> None:
+        self.task.restart(_node_id(node))
+
+    def pause(self, node: Union[int, "NodeHandle"]) -> None:
+        self.task.pause(_node_id(node))
+
+    def resume(self, node: Union[int, "NodeHandle"]) -> None:
+        self.task.resume(_node_id(node))
+
+    # -- topology ----------------------------------------------------------
+    def create_node(self, name: Optional[str] = None, ip: Optional[str] = None,
+                    cores: int = 1, init: Optional[Callable[[], Coroutine]] = None) -> "NodeHandle":
+        node = self.task.create_node(name=name, cores=cores, init=init)
+        for sim in self.sims.all():
+            sim.create_node(node.id)
+        if ip is not None:
+            from ..net import NetSim  # late import: net layers above core
+
+            if self.sims.contains(NetSim):
+                self.sims.get(NetSim).set_ip(node.id, ip)
+        if init is not None:
+            node.spawn(init())
+        return NodeHandle(node, self)
+
+    def get_node(self, node_id: int) -> "NodeHandle":
+        return NodeHandle(self.task._get_node(node_id), self)
+
+
+class NodeHandle:
+    """Handle to a simulated machine: spawn tasks on it, inspect identity."""
+
+    def __init__(self, node: Node, handle: Handle):
+        self._node = node
+        self._handle = handle
+
+    @property
+    def id(self) -> int:
+        return self._node.id
+
+    @property
+    def name(self) -> str:
+        return self._node.name
+
+    def spawn(self, coro: Coroutine):
+        return self._node.spawn(coro)
+
+    def __repr__(self):
+        return f"NodeHandle(id={self.id}, name={self.name!r})"
+
+
+def _node_id(node: Union[int, NodeHandle]) -> int:
+    return node.id if isinstance(node, NodeHandle) else int(node)
+
+
+class Runtime:
+    """One seeded simulation world.
+
+    ``Runtime(seed)`` builds the deterministic rng, virtual clock, executor,
+    and registers the default simulators (NetSim, FsSim), mirroring
+    `runtime/mod.rs:50-64`.
+    """
+
+    def __init__(self, seed: int = 0, config: Optional[Config] = None):
+        self.seed = seed
+        self.config = config or Config()
+        self.rand = GlobalRng(seed)
+        self.time = TimeRuntime(self.rand)
+        self.rand.set_clock(self.time.now_ns)
+        self.task = Executor(self.rand, self.time)
+        self.handle = Handle(seed, self.config, self.rand, self.time, self.task)
+        self.task.on_reset_node = self._reset_node_in_sims
+        # Default simulators. Late imports keep core free of upper layers.
+        from ..net import NetSim
+        from ..fs import FsSim
+
+        self.add_simulator(NetSim)
+        self.add_simulator(FsSim)
+
+    def _reset_node_in_sims(self, node_id: int) -> None:
+        for sim in self.handle.sims.all():
+            sim.reset_node(node_id)
+
+    def add_simulator(self, sim_cls: type) -> None:
+        if not (inspect.isclass(sim_cls) and issubclass(sim_cls, Simulator)):
+            raise TypeError("add_simulator expects a Simulator subclass")
+        with context.enter_handle(self.handle):
+            sim = sim_cls(self.handle)
+            self.handle.sims.add(sim)
+            # Back-fill nodes created before this simulator was registered
+            # (at minimum the main node, which exists from executor init).
+            for node_id in self.task.nodes:
+                sim.create_node(node_id)
+
+    # -- node & time config ------------------------------------------------
+    def create_node(self, name: Optional[str] = None, ip: Optional[str] = None,
+                    cores: int = 1, init: Optional[Callable[[], Coroutine]] = None) -> NodeHandle:
+        with context.enter_handle(self.handle):
+            return self.handle.create_node(name=name, ip=ip, cores=cores, init=init)
+
+    def set_time_limit(self, seconds: float) -> None:
+        self.task.time_limit_ns = to_ns(seconds)
+
+    # -- execution ---------------------------------------------------------
+    def block_on(self, coro: Coroutine) -> Any:
+        with context.enter_handle(self.handle):
+            return self.task.block_on(coro)
+
+    # -- determinism checking (`runtime/mod.rs:164-189`) --------------------
+    @staticmethod
+    def check_determinism(seed: int, config: Optional[Config], make_coro: Callable[[], Coroutine],
+                          time_limit: Optional[float] = None) -> Any:
+        """Run the simulation twice: first logging every RNG access, then
+        replaying with comparison. Raises DeterminismError on divergence."""
+        import threading
+
+        import copy
+
+        results: list = [None, None]
+        errors: list = [None, None]
+        log_holder: list = [None]
+
+        def run(which: int) -> None:
+            try:
+                # Fresh config per run: in-sim config mutations (e.g.
+                # NetSim.update_config chaos) must not leak into the replay.
+                rt = Runtime(seed=seed, config=copy.deepcopy(config) if config else None)
+                if time_limit is not None:
+                    rt.set_time_limit(time_limit)
+                if which == 0:
+                    rt.rand.enable_log()
+                else:
+                    rt.rand.enable_check(log_holder[0])
+                results[which] = rt.block_on(make_coro())
+                if which == 0:
+                    log_holder[0] = rt.rand.take_log()
+            except BaseException as exc:  # noqa: BLE001
+                errors[which] = exc
+
+        # Fresh threads for thread-local isolation, like the reference's
+        # per-simulation thread spawn (`builder.rs:123`).
+        for which in (0, 1):
+            t = threading.Thread(target=run, args=(which,), daemon=True)
+            t.start()
+            t.join()
+            if errors[which] is not None:
+                raise errors[which]
+        return results[1]
+
+
+def init_logger() -> None:
+    """Install a basic logging config once (`runtime/mod.rs:380-384` analog).
+    Honors MADSIM_LOG (e.g. DEBUG/INFO)."""
+    import logging
+    import os
+
+    if getattr(init_logger, "_done", False):
+        return
+    init_logger._done = True  # type: ignore[attr-defined]
+    level = os.environ.get("MADSIM_LOG", "WARNING").upper()
+    logging.basicConfig(level=getattr(logging, level, logging.WARNING),
+                        format="%(levelname)s %(name)s: %(message)s")

@@ -1,0 +1,301 @@
+"""Deterministic single-threaded task executor + node manager.
+
+Reference semantics (`madsim/src/sim/task.rs`):
+- Run-to-completion executor whose ready queue is consumed by picking a
+  *uniformly random* element (`utils/mpsc.rs:73-83`) — randomized interleaving
+  is the chaos amplifier that explores schedules.
+- Each task poll advances virtual time by a random 50-100 ns (`task.rs:176-178`).
+- Nodes own tasks; kill swaps in a fresh NodeInfo and flags the old one so
+  queued runnables are lazily dropped (`task.rs:211-226`); restart re-runs the
+  node's init closure (`task.rs:229-240`); pause parks runnables
+  (`task.rs:243-261`).
+- The block_on loop: drain ready tasks → check root → advance clock to next
+  timer, panic on deadlock (`task.rs:121-153`).
+
+Host redesign notes: tasks are Python coroutines driven directly (no asyncio).
+Awaitables must bottom out in :class:`~madsim_tpu.core.futures.SimFuture` so
+every wakeup routes through this executor's seeded scheduler. A task failure
+(other than cancellation) aborts the whole simulation, matching the
+reference where a task panic unwinds the single-threaded executor.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Coroutine, Dict, List, Optional
+
+from . import context
+from .futures import Cancelled, SimFuture
+from .rng import GlobalRng
+from .timewheel import TimeRuntime, to_ns
+
+MAIN_NODE_ID = 0
+
+
+class Deadlock(RuntimeError):
+    """All tasks are blocked and no timers are pending."""
+
+
+class TimeLimitExceeded(RuntimeError):
+    pass
+
+
+class NodeInfo:
+    """One generation of a node. Kill creates a fresh generation so stale
+    queued tasks (still pointing at the old info) are lazily dropped."""
+
+    __slots__ = ("id", "name", "cores", "killed", "paused", "tasks", "paused_tasks", "restarted_count")
+
+    def __init__(self, node_id: int, name: str, cores: int, restarted_count: int = 0):
+        self.id = node_id
+        self.name = name
+        self.cores = cores
+        self.killed = False
+        self.paused = False
+        self.tasks: set = set()
+        self.paused_tasks: List["Task"] = []
+        self.restarted_count = restarted_count
+
+    def __repr__(self):
+        return f"NodeInfo(id={self.id}, name={self.name!r}, gen={self.restarted_count})"
+
+
+# Public alias used by context.current_task()
+TaskInfo = NodeInfo  # current_task() yields the Task; node via task.node
+
+
+class Task:
+    __slots__ = ("id", "coro", "node", "join_future", "cancelled", "_scheduled", "_finished")
+
+    def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo):
+        self.id = task_id
+        self.coro = coro
+        self.node = node
+        self.join_future = SimFuture()
+        self.cancelled = False
+        self._scheduled = False
+        self._finished = False
+        node.tasks.add(self)
+
+    @property
+    def done(self) -> bool:
+        return self._finished
+
+    def drop(self) -> None:
+        """Abandon the task: close its coroutine (runs finally blocks) and
+        resolve its join future with Cancelled so joiners never hang."""
+        if self._finished:
+            return
+        self._finished = True
+        self.cancelled = True
+        try:
+            self.coro.close()
+        except (RuntimeError, ValueError):
+            # RuntimeError: coroutine ignored GeneratorExit (awaited in a
+            # finally). ValueError: the coroutine is currently executing —
+            # a task killing its own node. Either way the reference's Rust
+            # drop would not run it further; we just abandon it.
+            pass
+        self.node.tasks.discard(self)
+        self.join_future.set_exception(Cancelled())
+
+
+class JoinHandle:
+    """tokio-style join handle: awaitable, abortable, detach by dropping."""
+
+    __slots__ = ("_task", "_executor")
+
+    def __init__(self, task: Task, executor: "Executor"):
+        self._task = task
+        self._executor = executor
+
+    def abort(self) -> None:
+        self._executor.abort_task(self._task)
+
+    def is_finished(self) -> bool:
+        return self._task.done
+
+    @property
+    def id(self) -> int:
+        return self._task.id
+
+    def __await__(self):
+        return self._task.join_future.__await__()
+
+
+class Executor:
+    """Single-threaded deterministic executor over all simulated nodes."""
+
+    def __init__(self, rng: GlobalRng, time: TimeRuntime):
+        self.rng = rng
+        self.time = time
+        self.queue: List[Task] = []
+        self.nodes: Dict[int, "Node"] = {}
+        self._next_node_id = MAIN_NODE_ID
+        self._next_task_id = 0
+        self.time_limit_ns: Optional[int] = None
+        self._uncaught: Optional[BaseException] = None
+        self.main_node = self.create_node(name="main", cores=1, init=None)
+        # Hooks the Runtime installs so node lifecycle reaches simulators.
+        self.on_reset_node: Optional[Callable[[int], None]] = None
+
+    # ------------------------------------------------------------------
+    # Node management
+    # ------------------------------------------------------------------
+    def create_node(self, name: Optional[str], cores: int, init) -> "Node":
+        node_id = self._next_node_id
+        self._next_node_id += 1
+        node = Node(node_id, name or str(node_id), cores, init, self)
+        self.nodes[node_id] = node
+        return node
+
+    def kill(self, node_id: int) -> None:
+        node = self._get_node(node_id)
+        old = node.info
+        old.killed = True
+        for task in list(old.tasks):
+            task.drop()
+        old.tasks.clear()
+        old.paused_tasks.clear()
+        node.info = NodeInfo(old.id, old.name, old.cores, old.restarted_count + 1)
+        if self.on_reset_node is not None:
+            self.on_reset_node(node_id)
+
+    def restart(self, node_id: int) -> None:
+        self.kill(node_id)
+        node = self._get_node(node_id)
+        if node.init is not None:
+            self.spawn(node.init(), node.info)
+
+    def pause(self, node_id: int) -> None:
+        self._get_node(node_id).info.paused = True
+
+    def resume(self, node_id: int) -> None:
+        info = self._get_node(node_id).info
+        if not info.paused:
+            return
+        info.paused = False
+        parked, info.paused_tasks = info.paused_tasks, []
+        for task in parked:
+            self._enqueue(task)
+
+    def _get_node(self, node_id: int) -> "Node":
+        try:
+            return self.nodes[node_id]
+        except KeyError:
+            raise KeyError(f"no node with id {node_id}") from None
+
+    # ------------------------------------------------------------------
+    # Task management
+    # ------------------------------------------------------------------
+    def spawn(self, coro: Coroutine, node: Optional[NodeInfo] = None) -> JoinHandle:
+        if node is None:
+            current = context.try_current_task()
+            node = current.node if current is not None else self.main_node.info
+        task = Task(self._next_task_id, coro, node)
+        self._next_task_id += 1
+        self._enqueue(task)
+        return JoinHandle(task, self)
+
+    def abort_task(self, task: Task) -> None:
+        task.drop()
+
+    def _enqueue(self, task: Task) -> None:
+        if task._scheduled or task._finished:
+            return
+        task._scheduled = True
+        self.queue.append(task)
+
+    def _wake(self, task: Task) -> None:
+        self._enqueue(task)
+
+    # ------------------------------------------------------------------
+    # The hot loop (`task.rs:121-180`)
+    # ------------------------------------------------------------------
+    def block_on(self, coro: Coroutine) -> Any:
+        root = Task(self._next_task_id, coro, self.main_node.info)
+        self._next_task_id += 1
+        self._enqueue(root)
+        while True:
+            self.run_all_ready()
+            if self._uncaught is not None:
+                exc, self._uncaught = self._uncaught, None
+                raise exc
+            if root.done:
+                return root.join_future.result()
+            if not self.time.advance_to_next_event():
+                raise Deadlock(
+                    f"deadlock detected at t={self.time.elapsed_ns / 1e9:.9f}s: "
+                    "all tasks are blocked and no timers are pending"
+                )
+            if self.time_limit_ns is not None and self.time.elapsed_ns >= self.time_limit_ns:
+                raise TimeLimitExceeded(
+                    f"time limit ({self.time_limit_ns / 1e9}s) exceeded"
+                )
+
+    def run_all_ready(self) -> None:
+        while self.queue and self._uncaught is None:
+            # Seeded uniform pick + swap-remove: the randomized interleaving.
+            idx = self.rng.gen_range(0, len(self.queue))
+            self.queue[idx], self.queue[-1] = self.queue[-1], self.queue[idx]
+            task = self.queue.pop()
+            task._scheduled = False
+            info = task.node
+            if info.killed or task.cancelled or task._finished:
+                task.drop()
+                continue
+            if info.paused:
+                info.paused_tasks.append(task)
+                continue
+            with context.enter_task(task):
+                self._poll(task)
+            # Random 50-100 ns per poll keeps timestamps distinct across
+            # interleavings (`task.rs:176-178`).
+            self.time.advance(self.rng.gen_range(50, 100))
+
+    def _poll(self, task: Task) -> None:
+        try:
+            yielded = task.coro.send(None)
+        except StopIteration as stop:
+            task._finished = True
+            task.node.tasks.discard(task)
+            task.join_future.set_result(stop.value)
+        except Cancelled:
+            task.drop()
+        except BaseException as exc:  # noqa: BLE001 — any task failure fails the sim
+            task._finished = True
+            task.node.tasks.discard(task)
+            task.join_future.set_exception(exc)
+            self._uncaught = exc
+        else:
+            if not isinstance(yielded, SimFuture):
+                err = TypeError(
+                    f"task awaited a foreign awaitable (yielded a "
+                    f"{type(yielded).__name__}); only madsim_tpu futures "
+                    "(sleep, channels, endpoints, ...) can suspend a "
+                    "simulation task"
+                )
+                task._finished = True
+                task.node.tasks.discard(task)
+                task.join_future.set_exception(err)
+                self._uncaught = err
+                return
+            yielded.add_done_callback(lambda _fut, t=task: self._wake(t))
+
+
+class Node:
+    """A simulated machine: a stream of NodeInfo generations + init closure."""
+
+    __slots__ = ("id", "name", "cores", "init", "info", "_executor")
+
+    def __init__(self, node_id: int, name: str, cores: int, init, executor: Executor):
+        self.id = node_id
+        self.name = name
+        self.cores = cores
+        self.init = init
+        self.info = NodeInfo(node_id, name, cores)
+        self._executor = executor
+
+    def spawn(self, coro: Coroutine) -> JoinHandle:
+        return self._executor.spawn(coro, self.info)
+
+    def __repr__(self):
+        return f"Node(id={self.id}, name={self.name!r})"

@@ -1,0 +1,115 @@
+"""Virtual time: mock clock + timer wheel.
+
+Reference semantics (`madsim/src/sim/time/mod.rs:21-72,159-214`):
+- A ``Clock`` holds a randomized base wall-clock time (within year 2022,
+  derived from the seed, `time/mod.rs:27-32`) plus monotonic elapsed ns.
+- A timer wheel orders pending callbacks; ``advance_to_next_event`` pops the
+  earliest deadline, adds a 50 ns epsilon (`time/mod.rs:46-56`), expires all
+  due callbacks and sets elapsed time.
+
+Host implementation: a binary heap keyed by (deadline_ns, seq). Timer handles
+support cancellation (a dropped Sleep must not fire its waker). Time is kept
+as integer nanoseconds (Python ints — unbounded, no overflow); the public API
+speaks float seconds.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Callable, List, Optional, Tuple
+
+from .rng import GlobalRng, STREAM_TIME_BASE
+
+NANOS_PER_SEC = 1_000_000_000
+# Epsilon added when advancing to a timer deadline; mirrors the monotonicity
+# workaround at `time/mod.rs:46-56`.
+ADVANCE_EPSILON_NS = 50
+
+_UNIX_2022 = 1_640_995_200  # 2022-01-01T00:00:00Z
+_SECS_IN_2022 = 365 * 24 * 3600
+
+
+class TimerEntry:
+    __slots__ = ("deadline_ns", "seq", "callback", "cancelled")
+
+    def __init__(self, deadline_ns: int, seq: int, callback: Callable[[], None]):
+        self.deadline_ns = deadline_ns
+        self.seq = seq
+        self.callback = callback
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other: "TimerEntry") -> bool:
+        return (self.deadline_ns, self.seq) < (other.deadline_ns, other.seq)
+
+
+class TimeRuntime:
+    """Simulated clock + timer wheel driven by the executor loop."""
+
+    def __init__(self, rng: GlobalRng):
+        # Base wall-clock time randomized within 2022 from the seed, drawn
+        # from a dedicated stream so it never perturbs the scheduler stream.
+        base_rng = GlobalRng(rng.seed, stream=STREAM_TIME_BASE)
+        self.base_time_ns = (_UNIX_2022 + base_rng.gen_range(0, _SECS_IN_2022)) * NANOS_PER_SEC
+        self.elapsed_ns = 0
+        self._heap: List[TimerEntry] = []
+        self._seq = 0
+
+    # -- clock reads -------------------------------------------------------
+    def now_ns(self) -> int:
+        """Monotonic elapsed virtual nanoseconds since runtime start."""
+        return self.elapsed_ns
+
+    def system_time_ns(self) -> int:
+        """Simulated wall-clock (unix epoch) nanoseconds."""
+        return self.base_time_ns + self.elapsed_ns
+
+    # -- clock writes ------------------------------------------------------
+    def advance(self, delta_ns: int) -> None:
+        """Advance elapsed time (used for the per-poll random 50-100 ns tick)."""
+        self.elapsed_ns += delta_ns
+
+    # -- timers ------------------------------------------------------------
+    def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]) -> TimerEntry:
+        entry = TimerEntry(max(deadline_ns, self.elapsed_ns), self._seq, callback)
+        self._seq += 1
+        heapq.heappush(self._heap, entry)
+        return entry
+
+    def add_timer(self, delay_ns: int, callback: Callable[[], None]) -> TimerEntry:
+        return self.add_timer_at(self.elapsed_ns + max(0, delay_ns), callback)
+
+    def next_deadline_ns(self) -> Optional[int]:
+        while self._heap and self._heap[0].cancelled:
+            heapq.heappop(self._heap)
+        return self._heap[0].deadline_ns if self._heap else None
+
+    def advance_to_next_event(self) -> bool:
+        """Jump the clock to the earliest pending timer and fire all due
+        callbacks. Returns False if no timers are pending (deadlock)."""
+        deadline = self.next_deadline_ns()
+        if deadline is None:
+            return False
+        target = max(deadline + ADVANCE_EPSILON_NS, self.elapsed_ns)
+        self.elapsed_ns = target
+        self._fire_due()
+        return True
+
+    def _fire_due(self) -> None:
+        while self._heap:
+            head = self._heap[0]
+            if head.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            if head.deadline_ns > self.elapsed_ns:
+                break
+            heapq.heappop(self._heap)
+            head.callback()
+
+
+def to_ns(seconds: float) -> int:
+    """Convert a float-seconds duration to integer nanoseconds."""
+    if seconds < 0:
+        raise ValueError("duration must be non-negative")
+    return round(seconds * NANOS_PER_SEC)

@@ -1,0 +1,37 @@
+"""Simulated network stack (reference `madsim/src/sim/net/`).
+
+Layers: :class:`Network` graph (links, fault state, address resolution) →
+:class:`NetSim` plugin (latency/drop sampling, timer-deferred delivery,
+reliable duplex channels) → user primitives (:class:`Endpoint` tag messaging,
+:mod:`rpc`, :class:`TcpListener`/:class:`TcpStream`, :class:`UdpSocket`).
+"""
+from .addr import Addr, AddrLike, format_addr, lookup_host, parse_addr
+from .endpoint import Endpoint
+from .netsim import (
+    BindGuard,
+    ChannelReceiver,
+    ChannelSender,
+    NetSim,
+)
+from .network import (
+    AddrInUse,
+    AddrNotAvailable,
+    BrokenPipe,
+    ConnectionRefused,
+    ConnectionReset,
+    IpProtocol,
+    NetworkError,
+    Socket,
+    Stat,
+)
+from .tcp import TcpListener, TcpStream
+from .udp import UdpSocket
+from . import rpc  # attaches call/add_rpc_handler onto Endpoint
+
+__all__ = [
+    "Addr", "AddrLike", "format_addr", "lookup_host", "parse_addr",
+    "Endpoint", "NetSim", "BindGuard", "ChannelSender", "ChannelReceiver",
+    "AddrInUse", "AddrNotAvailable", "BrokenPipe", "ConnectionRefused",
+    "ConnectionReset", "IpProtocol", "NetworkError", "Socket", "Stat",
+    "TcpListener", "TcpStream", "UdpSocket", "rpc",
+]

@@ -1,0 +1,70 @@
+"""Socket address handling for the simulated network.
+
+Reference: `madsim/src/sim/net/addr.rs` (ToSocketAddrs + lookup_host). Here an
+address is a ``(ip: str, port: int)`` tuple with the IP normalized through
+:mod:`ipaddress`. Only numeric hosts and ``localhost`` resolve — there is no
+real DNS inside a simulation.
+"""
+from __future__ import annotations
+
+import ipaddress
+from typing import Tuple, Union
+
+Addr = Tuple[str, int]
+AddrLike = Union[str, Addr]
+
+
+class AddrParseError(ValueError):
+    pass
+
+
+def _normalize_ip(ip: str) -> str:
+    if ip == "localhost":
+        return "127.0.0.1"
+    try:
+        return str(ipaddress.ip_address(ip))
+    except ValueError as exc:
+        raise AddrParseError(f"invalid IP address: {ip!r}") from exc
+
+
+def parse_addr(addr: AddrLike) -> Addr:
+    """Parse ``(ip, port)``, ``"ip:port"``, or ``"[v6]:port"``."""
+    if isinstance(addr, tuple):
+        ip, port = addr
+        return _normalize_ip(str(ip)), int(port)
+    if not isinstance(addr, str):
+        raise AddrParseError(f"cannot parse address from {type(addr).__name__}")
+    text = addr.strip()
+    if text.startswith("["):  # [v6]:port
+        host, _, port = text[1:].partition("]:")
+        if not port:
+            raise AddrParseError(f"invalid address: {addr!r}")
+        return _normalize_ip(host), int(port)
+    host, sep, port = text.rpartition(":")
+    if not sep:
+        raise AddrParseError(f"missing port in address: {addr!r}")
+    return _normalize_ip(host), int(port)
+
+
+async def lookup_host(addr: AddrLike) -> list[Addr]:
+    """Resolve to a list of socket addresses (`addr.rs:32-34` analog)."""
+    return [parse_addr(addr)]
+
+
+def ip_is_loopback(ip: str) -> bool:
+    return ipaddress.ip_address(ip).is_loopback
+
+
+def ip_is_unspecified(ip: str) -> bool:
+    return ipaddress.ip_address(ip).is_unspecified
+
+
+def unspecified_for(ip: str) -> str:
+    return "::" if ipaddress.ip_address(ip).version == 6 else "0.0.0.0"
+
+
+def format_addr(addr: Addr) -> str:
+    ip, port = addr
+    if ":" in ip:
+        return f"[{ip}]:{port}"
+    return f"{ip}:{port}"

@@ -1,0 +1,201 @@
+"""Endpoint: tag-matched messaging, the primary user-facing primitive.
+
+Reference: `madsim/src/sim/net/endpoint.rs` — bind/connect (`:14-35`),
+``send_to``/``recv_from`` with tag matching plus raw-payload variants
+(`:59-163`), connection-oriented ``connect1``/``accept1`` (`:167-229`), and a
+``Mailbox`` that tries pending receivers first, else buffers (`:241-306`).
+Registered under the UDP protocol but with unbounded buffering.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from ..core import context
+from ..core.futures import Channel, ChannelClosed, SimFuture
+from .addr import Addr, AddrLike, lookup_host, parse_addr
+from .netsim import (
+    BindGuard,
+    BrokenPipe,
+    ChannelReceiver,
+    ChannelSender,
+    ConnectionReset,
+    NetSim,
+    _netsim,
+)
+from .network import IpProtocol, NetworkError, Socket
+
+
+class _Message:
+    __slots__ = ("tag", "data", "from_addr")
+
+    def __init__(self, tag: int, data: Any, from_addr: Addr):
+        self.tag = tag
+        self.data = data
+        self.from_addr = from_addr
+
+
+class _Mailbox:
+    """Tag-matched mailbox (`endpoint.rs:274-306`): deliver tries pending
+    receivers (skipping abandoned ones), else buffers; recv takes a matching
+    buffered message, else registers."""
+
+    __slots__ = ("registered", "msgs")
+
+    def __init__(self):
+        self.registered: List[Tuple[int, SimFuture]] = []
+        self.msgs: List[_Message] = []
+
+    def deliver(self, msg: _Message) -> None:
+        for i, (tag, fut) in enumerate(self.registered):
+            if tag == msg.tag and not fut.done():
+                del self.registered[i]
+                fut.set_result(msg)
+                return
+        # Drop completed/abandoned registrations opportunistically.
+        self.registered = [(t, f) for (t, f) in self.registered if not f.done()]
+        self.msgs.append(msg)
+
+    def recv(self, tag: int) -> SimFuture:
+        fut = SimFuture()
+        for i, msg in enumerate(self.msgs):
+            if msg.tag == tag:
+                del self.msgs[i]
+                fut.set_result(msg)
+                return fut
+        self.registered.append((tag, fut))
+        return fut
+
+    def unregister(self, fut: SimFuture) -> None:
+        self.registered = [(t, f) for (t, f) in self.registered if f is not fut]
+
+    def requeue_front(self, msg: _Message) -> None:
+        self.msgs.insert(0, msg)
+
+    def close(self) -> None:
+        for _, fut in self.registered:
+            if not fut.done():
+                fut.set_exception(BrokenPipe("network is down"))
+        self.registered.clear()
+
+
+class _EndpointSocket(Socket):
+    __slots__ = ("mailbox", "conn_queue")
+
+    def __init__(self):
+        self.mailbox = _Mailbox()
+        self.conn_queue = Channel()  # (tx, rx, src_addr) incoming connections
+
+    def deliver(self, src: Addr, dst: Addr, msg) -> None:
+        tag, data = msg
+        self.mailbox.deliver(_Message(tag, data, src))
+
+    def new_connection(self, src: Addr, dst: Addr, tx, rx) -> None:
+        try:
+            self.conn_queue.send((tx, rx, src))
+        except ChannelClosed:
+            pass
+
+
+class Endpoint:
+    """Bindable, tag-matching network endpoint."""
+
+    def __init__(self, guard: BindGuard, socket: _EndpointSocket):
+        self._guard = guard
+        self._socket = socket
+        self._peer: Optional[Addr] = None
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    async def bind(addr: AddrLike) -> "Endpoint":
+        socket = _EndpointSocket()
+        guard = await BindGuard.bind(addr, IpProtocol.UDP, socket)
+        return Endpoint(guard, socket)
+
+    @staticmethod
+    async def connect(addr: AddrLike) -> "Endpoint":
+        peer = (await lookup_host(addr))[0]
+        ep = await Endpoint.bind("0.0.0.0:0")
+        ep._peer = peer
+        return ep
+
+    # -- introspection -----------------------------------------------------
+    def local_addr(self) -> Addr:
+        return self._guard.addr
+
+    def peer_addr(self) -> Addr:
+        if self._peer is None:
+            raise NetworkError("not connected")
+        return self._peer
+
+    # -- datagram path (`endpoint.rs:59-163`) ------------------------------
+    async def send_to(self, dst: AddrLike, tag: int, data: Any) -> None:
+        dst_addr = (await lookup_host(dst))[0]
+        await self.send_to_raw(dst_addr, tag, data)
+
+    async def recv_from(self, tag: int) -> Tuple[Any, Addr]:
+        """Receive one message with the given tag → (data, from_addr)."""
+        return await self.recv_from_raw(tag)
+
+    async def send(self, tag: int, data: Any) -> None:
+        await self.send_to(self.peer_addr(), tag, data)
+
+    async def recv(self, tag: int) -> Any:
+        peer = self.peer_addr()
+        data, from_addr = await self.recv_from(tag)
+        assert from_addr == peer, "received a message not from the connected address"
+        return data
+
+    async def send_to_raw(self, dst: Addr, tag: int, data: Any) -> None:
+        net = self._guard.net
+        await net.send(self._guard.node, self._guard.addr[1], dst, IpProtocol.UDP, (tag, data))
+
+    async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:
+        fut = self._socket.mailbox.recv(tag)
+        try:
+            msg = await fut
+        except BaseException:
+            # A cancelled receiver (e.g. timeout) must give its message back
+            # to later receivers (`endpoint.rs:353-387` test): either it was
+            # still registered, or it already held an undelivered message.
+            if fut.done() and fut._exception is None:
+                self._socket.mailbox.requeue_front(fut.result())
+            else:
+                self._socket.mailbox.unregister(fut)
+            raise
+        try:
+            await self._guard.net.rand_delay()
+        except BaseException:
+            # Cancelled during the post-receive processing delay: the message
+            # was already taken out of the mailbox — put it back.
+            self._socket.mailbox.requeue_front(msg)
+            raise
+        return msg.data, msg.from_addr
+
+    # -- connection-oriented path (`endpoint.rs:167-229`) -------------------
+    async def connect1(self, addr: AddrLike) -> Tuple[ChannelSender, ChannelReceiver]:
+        dst = (await lookup_host(addr))[0]
+        tx, rx, _src = await self._guard.net.connect1(
+            self._guard.node, self._guard.addr[1], dst, IpProtocol.UDP
+        )
+        return tx, rx
+
+    async def accept1(self) -> Tuple[ChannelSender, ChannelReceiver, Addr]:
+        await self._guard.net.rand_delay()
+        try:
+            tx, rx, src = await self._socket.conn_queue.recv()
+        except ChannelClosed:
+            raise ConnectionReset("endpoint closed") from None
+        return tx, rx, src
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._guard.close()
+        self._socket.conn_queue.close()
+        self._socket.mailbox.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

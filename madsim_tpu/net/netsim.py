@@ -1,0 +1,243 @@
+"""NetSim: the network simulator plugin + connection fabric.
+
+Reference: `madsim/src/sim/net/mod.rs` — send = random 0-5 µs delay →
+``try_send`` → timer-deferred delivery (`mod.rs:173-197`); ``connect1`` builds
+a reliable ordered duplex channel out of two unbounded queues + one relay task
+per direction that re-checks link health per message with exponential backoff
+1 ms → 10 s while partitioned, so **messages queue across partitions and flush
+on heal** (`mod.rs:224-260`); relay tasks are aborted on node reset.
+
+Messages cross the simulated network as in-process Python objects — zero
+serialization (`mod.rs:86`), mirroring the reference's ``Box<dyn Any>``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+from ..core import context
+from ..core.futures import Channel, ChannelClosed
+from ..core.plugin import Simulator
+from ..core.timewheel import to_ns
+from .addr import Addr, AddrLike, format_addr, lookup_host, parse_addr
+from .network import (
+    AddrInUse,  # noqa: F401 (re-export for callers)
+    AddrNotAvailable,  # noqa: F401
+    BrokenPipe,
+    ConnectionRefused,
+    ConnectionReset,
+    IpProtocol,
+    Network,
+    Socket,
+    Stat,
+)
+
+logger = logging.getLogger("madsim_tpu.net")
+
+_BACKOFF_INITIAL_NS = to_ns(0.001)
+_BACKOFF_MAX_NS = to_ns(10.0)
+
+
+class NetSim(Simulator):
+    """Per-runtime network simulator. Registered by default
+    (`runtime/mod.rs:61-62` analog); fetched via ``plugin.simulator(NetSim)``."""
+
+    def __init__(self, handle):
+        super().__init__(handle)
+        self.network = Network(handle.rand, handle.config.net)
+        self.time = handle.time
+        self.rand = handle.rand
+        self.executor = handle.task
+
+    # -- Simulator hooks ---------------------------------------------------
+    def create_node(self, node_id: int) -> None:
+        self.network.insert_node(node_id)
+
+    def reset_node(self, node_id: int) -> None:
+        self.network.reset_node(node_id)
+
+    # -- supervisor API (`net/mod.rs:120-178`) ------------------------------
+    def stat(self) -> Stat:
+        return self.network.stat
+
+    def update_config(self, f: Callable) -> None:
+        f(self.network.config)
+
+    def set_ip(self, node_id: int, ip: str) -> None:
+        self.network.set_ip(node_id, ip)
+
+    def connect(self, node_id: int) -> None:
+        self.network.unclog_node(node_id)
+
+    def disconnect(self, node_id: int) -> None:
+        self.network.clog_node(node_id)
+
+    def connect2(self, node1: int, node2: int) -> None:
+        self.network.unclog_link(node1, node2)
+        self.network.unclog_link(node2, node1)
+
+    def disconnect2(self, node1: int, node2: int) -> None:
+        self.network.clog_link(node1, node2)
+        self.network.clog_link(node2, node1)
+
+    # -- data path ----------------------------------------------------------
+    async def rand_delay(self) -> None:
+        """Random 0-5 µs processing delay before touching the network
+        (`mod.rs:173-178`); keeps send timestamps distinct across seeds."""
+        from .. import time as vtime
+
+        delay_us = self.rand.gen_range(0, 5)
+        await vtime.sleep(delay_us * 1e-6)
+
+    async def send(self, node_id: int, port: int, dst: Addr, protocol: IpProtocol, msg) -> None:
+        await self.rand_delay()
+        res = self.network.try_send(node_id, dst, protocol)
+        if res is None:
+            return  # dropped (clogged / lost / no dest) — datagram semantics
+        src_ip, _dst_node, socket, latency_ns = res
+        src = (src_ip, port)
+        self.time.add_timer(latency_ns, lambda: socket.deliver(src, dst, msg))
+
+    async def connect1(self, node_id: int, port: int, dst: Addr, protocol: IpProtocol
+                       ) -> Tuple["ChannelSender", "ChannelReceiver", Addr]:
+        """Open a reliable ordered duplex connection (`mod.rs:201-221`)."""
+        await self.rand_delay()
+        res = self.network.try_send(node_id, dst, protocol)
+        if res is None:
+            raise ConnectionRefused(f"connection refused: {format_addr(dst)}")
+        src_ip, dst_node, socket, latency_ns = res
+        src = (src_ip, port)
+        tx1, rx1 = self._channel(node_id, dst, protocol)
+        tx2, rx2 = self._channel(dst_node, src, protocol)
+        self.time.add_timer(latency_ns, lambda: socket.new_connection(src, dst, tx2, rx1))
+        return tx1, rx2, src
+
+    def _channel(self, node_id: int, dst: Addr, protocol: IpProtocol
+                 ) -> Tuple["ChannelSender", "ChannelReceiver"]:
+        """One direction of a connection: user queue → relay task → peer
+        queue. The relay re-samples the link per message and backs off
+        exponentially while partitioned (`mod.rs:224-260`)."""
+        upstream = Channel()
+        downstream = Channel()
+
+        async def relay():
+            from .. import time as vtime
+
+            try:
+                while True:
+                    try:
+                        msg = await upstream.recv()
+                    except ChannelClosed:
+                        downstream.close()  # sender side closed: EOF at peer
+                        return
+                    wait_ns = _BACKOFF_INITIAL_NS
+                    while True:
+                        res = self.network.try_send(node_id, dst, protocol)
+                        if res is not None:
+                            await vtime.sleep(res[3] / 1e9)
+                            break
+                        await vtime.sleep(wait_ns / 1e9)
+                        wait_ns = min(wait_ns * 2, _BACKOFF_MAX_NS)
+                    try:
+                        downstream.send(msg)
+                    except ChannelClosed:
+                        return  # receiver closed: stop relaying
+            except GeneratorExit:
+                # Relay aborted (node reset): peer sees connection reset.
+                downstream.close()
+                raise
+
+        handle = self.executor.spawn(relay(), self.executor.main_node.info)
+
+        def on_reset():
+            handle.abort()
+            upstream.close()
+            downstream.close()
+
+        self.network.add_reset_hook(node_id, on_reset)
+        return ChannelSender(upstream), ChannelReceiver(downstream)
+
+
+class ChannelSender:
+    """Sending half of a reliable connection (`endpoint.rs:204-221` analog)."""
+
+    __slots__ = ("_ch",)
+
+    def __init__(self, ch: Channel):
+        self._ch = ch
+
+    async def send(self, payload) -> None:
+        try:
+            self._ch.send(payload)
+        except ChannelClosed:
+            raise ConnectionReset("connection reset") from None
+
+    def close(self) -> None:
+        self._ch.close()
+
+
+class ChannelReceiver:
+    """Receiving half of a reliable connection. ``recv`` raises
+    :class:`ConnectionReset` when the channel is closed and drained (the
+    peer's EOF)."""
+
+    __slots__ = ("_ch",)
+
+    def __init__(self, ch: Channel):
+        self._ch = ch
+
+    async def recv(self):
+        try:
+            return await self._ch.recv()
+        except ChannelClosed:
+            raise ConnectionReset("connection reset") from None
+
+    async def recv_or_eof(self):
+        """Like recv but returns None at EOF (for stream adapters)."""
+        try:
+            return await self._ch.recv()
+        except ChannelClosed:
+            return None
+
+    def close(self) -> None:
+        self._ch.close()
+
+
+class BindGuard:
+    """Releases the bound port on close (`mod.rs:264-318`). Python has no
+    deterministic drop, so owners call ``close()`` (or use ``with``)."""
+
+    __slots__ = ("net", "node", "addr", "protocol", "_closed")
+
+    def __init__(self, net: NetSim, node: int, addr: Addr, protocol: IpProtocol):
+        self.net = net
+        self.node = node
+        self.addr = addr
+        self.protocol = protocol
+        self._closed = False
+
+    @staticmethod
+    async def bind(addr: AddrLike, protocol: IpProtocol, socket: Socket) -> "BindGuard":
+        net = _netsim()
+        node = context.current_node_id()
+        last_err: Optional[Exception] = None
+        for candidate in await lookup_host(addr):
+            await net.rand_delay()
+            try:
+                bound = net.network.bind(node, candidate, protocol, socket)
+                return BindGuard(net, node, bound, protocol)
+            except OSError as exc:
+                last_err = exc
+        raise last_err or AddrNotAvailable("could not resolve to any addresses")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.net.network.close(self.node, self.addr, self.protocol)
+
+    def __del__(self):
+        self.close()
+
+
+def _netsim() -> NetSim:
+    return context.current_handle().sims.get(NetSim)

@@ -1,0 +1,219 @@
+"""The network graph: links, address resolution, sockets, fault state.
+
+Reference: `madsim/src/sim/net/network.rs` — nodes with ≤1 IP, an
+``addr_to_node`` map, a socket table keyed ``(addr, protocol)``, clogged
+node/link sets, and ``test_link`` = clog check → Bernoulli(packet loss) →
+uniform latency sample (`network.rs:249-257`). Protocol-agnostic: upper layers
+implement the :class:`Socket` interface.
+"""
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.config import NetConfig
+from ..core.rng import GlobalRng
+from ..core.timewheel import to_ns
+from .addr import Addr, format_addr, ip_is_loopback, ip_is_unspecified
+
+logger = logging.getLogger("madsim_tpu.net")
+
+LOCALHOST_V4 = "127.0.0.1"
+
+
+class IpProtocol(enum.Enum):
+    TCP = "tcp"
+    UDP = "udp"
+
+
+class Socket:
+    """Upper-level protocol socket (`network.rs:56-69`)."""
+
+    def deliver(self, src: Addr, dst: Addr, msg) -> None:
+        pass
+
+    def new_connection(self, src: Addr, dst: Addr, tx, rx) -> None:
+        pass
+
+
+class Stat:
+    """Network statistics (`network.rs:104-110`)."""
+
+    __slots__ = ("msg_count",)
+
+    def __init__(self):
+        self.msg_count = 0
+
+    def __repr__(self):
+        return f"Stat(msg_count={self.msg_count})"
+
+
+class NetworkError(OSError):
+    pass
+
+
+class AddrNotAvailable(NetworkError):
+    pass
+
+
+class AddrInUse(NetworkError):
+    pass
+
+
+class ConnectionRefused(NetworkError):
+    pass
+
+
+class ConnectionReset(NetworkError):
+    pass
+
+
+class BrokenPipe(NetworkError):
+    pass
+
+
+class _NetNode:
+    __slots__ = ("ip", "sockets", "reset_hooks")
+
+    def __init__(self):
+        self.ip: Optional[str] = None
+        self.sockets: Dict[Tuple[Addr, IpProtocol], Socket] = {}
+        # Closures run on node reset: abort relay tasks / close channels
+        # (`network.rs:303-306` + FallibleTask cancel-on-drop).
+        self.reset_hooks: List = []
+
+
+class Network:
+    def __init__(self, rand: GlobalRng, config: NetConfig):
+        self.rand = rand
+        self.config = config
+        self.stat = Stat()
+        self.nodes: Dict[int, _NetNode] = {}
+        self.addr_to_node: Dict[str, int] = {}
+        self.clogged_node: Set[int] = set()
+        self.clogged_link: Set[Tuple[int, int]] = set()
+
+    # -- topology ----------------------------------------------------------
+    def insert_node(self, node_id: int) -> None:
+        self.nodes[node_id] = _NetNode()
+
+    def reset_node(self, node_id: int) -> None:
+        node = self.nodes[node_id]
+        node.sockets.clear()
+        hooks, node.reset_hooks = node.reset_hooks, []
+        for hook in hooks:
+            hook()
+
+    def set_ip(self, node_id: int, ip: str) -> None:
+        node = self.nodes[node_id]
+        if node.ip is not None:
+            self.addr_to_node.pop(node.ip, None)
+        node.ip = ip
+        old = self.addr_to_node.get(ip)
+        if old is not None and old != node_id:
+            raise ValueError(f"IP conflict: {ip} already assigned to node {old}")
+        self.addr_to_node[ip] = node_id
+
+    # -- fault state (`network.rs:159-190`) --------------------------------
+    def clog_node(self, node_id: int) -> None:
+        assert node_id in self.nodes
+        self.clogged_node.add(node_id)
+
+    def unclog_node(self, node_id: int) -> None:
+        assert node_id in self.nodes
+        self.clogged_node.discard(node_id)
+
+    def clog_link(self, src: int, dst: int) -> None:
+        assert src in self.nodes and dst in self.nodes
+        self.clogged_link.add((src, dst))
+
+    def unclog_link(self, src: int, dst: int) -> None:
+        assert src in self.nodes and dst in self.nodes
+        self.clogged_link.discard((src, dst))
+
+    def link_clogged(self, src: int, dst: int) -> bool:
+        return (
+            src in self.clogged_node
+            or dst in self.clogged_node
+            or (src, dst) in self.clogged_link
+        )
+
+    # -- sockets -----------------------------------------------------------
+    def bind(self, node_id: int, addr: Addr, protocol: IpProtocol, socket: Socket) -> Addr:
+        node = self.nodes[node_id]
+        ip, port = addr
+        if (
+            not ip_is_unspecified(ip)
+            and not ip_is_loopback(ip)
+            and node.ip is not None
+            and ip != node.ip
+        ):
+            raise AddrNotAvailable(f"invalid address: {format_addr(addr)}")
+        if port == 0:
+            port = self._ephemeral_port(node, ip, protocol)
+            addr = (ip, port)
+        key = (addr, protocol)
+        if key in node.sockets:
+            raise AddrInUse(f"address already in use: {format_addr(addr)}")
+        node.sockets[key] = socket
+        logger.debug("bind node=%s addr=%s proto=%s", node_id, format_addr(addr), protocol.value)
+        return addr
+
+    def _ephemeral_port(self, node: _NetNode, ip: str, protocol: IpProtocol) -> int:
+        for port in range(1, 0x10000):
+            if ((ip, port), protocol) not in node.sockets:
+                return port
+        raise AddrInUse("no available ephemeral port")
+
+    def close(self, node_id: int, addr: Addr, protocol: IpProtocol) -> None:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.sockets.pop((addr, protocol), None)
+
+    # -- sending (`network.rs:249-301`) ------------------------------------
+    def test_link(self, src: int, dst: int) -> Optional[int]:
+        """Clog check → Bernoulli loss → uniform latency (ns), None = no
+        delivery now. The fault-injection point of the whole system."""
+        if self.link_clogged(src, dst) or self.rand.gen_bool(self.config.packet_loss_rate):
+            return None
+        self.stat.msg_count += 1
+        lo, hi = self.config.send_latency
+        return self.rand.gen_range(to_ns(lo), max(to_ns(hi), to_ns(lo) + 1))
+
+    def resolve_dest_node(self, node_id: int, dst: Addr, protocol: IpProtocol) -> Optional[int]:
+        node = self.nodes[node_id]
+        if ip_is_loopback(dst[0]) or (dst, protocol) in node.sockets:
+            return node_id
+        if node.ip is None:
+            logger.warning("ip not set: node %s", node_id)
+            return None
+        target = self.addr_to_node.get(dst[0])
+        if target is None:
+            logger.warning("destination not found: %s", format_addr(dst))
+        return target
+
+    def try_send(self, node_id: int, dst: Addr, protocol: IpProtocol):
+        """Returns (src_ip, dst_node, socket, latency_ns) or None."""
+        dst_node = self.resolve_dest_node(node_id, dst, protocol)
+        if dst_node is None:
+            return None
+        latency = self.test_link(node_id, dst_node)
+        if latency is None:
+            return None
+        sockets = self.nodes[dst_node].sockets
+        from .addr import unspecified_for
+
+        socket = sockets.get((dst, protocol))
+        if socket is None:
+            socket = sockets.get(((unspecified_for(dst[0]), dst[1]), protocol))
+        if socket is None:
+            return None
+        if ip_is_loopback(dst[0]):
+            src_ip = LOCALHOST_V4
+        else:
+            src_ip = self.nodes[node_id].ip
+        return src_ip, dst_node, socket, latency
+
+    def add_reset_hook(self, node_id: int, hook) -> None:
+        self.nodes[node_id].reset_hooks.append(hook)

@@ -1,0 +1,121 @@
+"""Built-in RPC framework over tag-matched endpoints.
+
+Reference: `madsim/src/sim/net/rpc.rs` — request tag = stable per-type ID
+(hash33 of the type path, `rpc.rs:82-92`); the request payload carries a
+random u64 response tag echoed back (`rpc.rs:96-131`);
+``add_rpc_handler`` spawns a dispatcher loop per request type, each request
+handled in a fresh task (`rpc.rs:134-166`). In sim mode payloads cross as
+Python objects — no serialization.
+"""
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable, Optional, Tuple, Type
+
+from ..core import context
+from ..core.futures import ChannelClosed
+from .addr import AddrLike, lookup_host
+from .endpoint import Endpoint
+from .network import BrokenPipe, ConnectionReset
+
+
+def hash_str(s: str) -> int:
+    """hash33 (`rpc.rs:82-92`): h = h*33 + byte, over u64."""
+    h = 0
+    for b in s.encode():
+        h = (h * 33 + b) & ((1 << 64) - 1)
+    return h
+
+
+def type_tag(req_type: type) -> int:
+    """Stable RPC tag for a request type (module path + qualname)."""
+    override = getattr(req_type, "__rpc_id__", None)
+    if override is not None:
+        return int(override)
+    return hash_str(f"{req_type.__module__}::{req_type.__qualname__}")
+
+
+async def call(ep: Endpoint, dst: AddrLike, request: Any, timeout: Optional[float] = None) -> Any:
+    """Send an RPC and await its response."""
+    resp, _ = await call_with_data(ep, dst, request, b"", timeout=timeout)
+    return resp
+
+
+async def call_with_data(ep: Endpoint, dst: AddrLike, request: Any, data: bytes,
+                         timeout: Optional[float] = None) -> Tuple[Any, bytes]:
+    """Send an RPC with a raw data sidecar → (response, response_data)."""
+    from .. import time as vtime
+
+    dst_addr = (await lookup_host(dst))[0]
+    rsp_tag = context.current_handle().rand.next_u64()
+    await ep.send_to_raw(dst_addr, type_tag(type(request)), (rsp_tag, request, data))
+
+    async def _recv():
+        payload, from_addr = await ep.recv_from_raw(rsp_tag)
+        resp, rsp_data = payload
+        if isinstance(resp, _RpcFault):
+            raise RpcError(resp.message)
+        return resp, rsp_data
+
+    if timeout is not None:
+        return await vtime.timeout(timeout, _recv())
+    return await _recv()
+
+
+def add_rpc_handler(ep: Endpoint, req_type: Type,
+                    handler: Callable[[Any], Awaitable[Any]]) -> None:
+    """Register an async handler ``(request) -> response`` for a request type."""
+
+    async def _with_data(req, _data):
+        return await handler(req), b""
+
+    add_rpc_handler_with_data(ep, req_type, _with_data)
+
+
+def add_rpc_handler_with_data(ep: Endpoint, req_type: Type,
+                              handler: Callable[[Any, bytes], Awaitable[Tuple[Any, bytes]]]) -> None:
+    """Register an async handler ``(request, data) -> (response, data)``.
+
+    Spawns a dispatcher loop on the current node; each request runs in a
+    fresh task so slow handlers don't serialize the endpoint
+    (`rpc.rs:134-166`).
+    """
+    executor = context.current_handle().task
+    tag = type_tag(req_type)
+
+    async def dispatcher():
+        while True:
+            try:
+                payload, from_addr = await ep.recv_from_raw(tag)
+            except (BrokenPipe, ConnectionReset, ChannelClosed):
+                return  # endpoint closed / node network reset: clean exit
+            rsp_tag, request, data = payload
+
+            async def handle_one(rsp_tag=rsp_tag, request=request, data=data, from_addr=from_addr):
+                try:
+                    resp, rsp_data = await handler(request, data)
+                except RpcError as exc:
+                    resp, rsp_data = _RpcFault(str(exc)), b""
+                await ep.send_to_raw(from_addr, rsp_tag, (resp, rsp_data))
+
+            executor.spawn(handle_one())
+
+    executor.spawn(dispatcher())
+
+
+class RpcError(Exception):
+    """Application-level RPC failure, propagated to the caller."""
+
+
+class _RpcFault:
+    __slots__ = ("message",)
+
+    def __init__(self, message: str):
+        self.message = message
+
+
+# Ergonomic method-style access, mirroring the reference's trait impls on
+# Endpoint (`rpc.rs:94-166`).
+Endpoint.call = call  # type: ignore[attr-defined]
+Endpoint.call_with_data = call_with_data  # type: ignore[attr-defined]
+Endpoint.add_rpc_handler = add_rpc_handler  # type: ignore[attr-defined]
+Endpoint.add_rpc_handler_with_data = add_rpc_handler_with_data  # type: ignore[attr-defined]

@@ -1,0 +1,148 @@
+"""TCP simulation: listener + ordered reliable byte streams.
+
+Reference: `madsim/src/sim/net/tcp/*` — tokio-compatible ``TcpListener``
+(`listener.rs:35-70`) / ``TcpStream`` (`stream.rs:49-88`) built on the
+``connect1`` duplex channels; reads drain a local byte buffer then await the
+channel (EOF on channel close = orderly shutdown, `stream.rs:107-132`); writes
+buffer locally and ``flush`` ships one payload (`stream.rs:135-158`). Like the
+reference: no backlog limit, no partial-write simulation.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..core.futures import Channel, ChannelClosed
+from .addr import Addr, AddrLike, format_addr
+from .netsim import BindGuard, ChannelReceiver, ChannelSender, ConnectionReset, _netsim
+from .network import IpProtocol, Socket
+
+
+class _ListenerSocket(Socket):
+    __slots__ = ("conn_queue",)
+
+    def __init__(self):
+        self.conn_queue = Channel()
+
+    def new_connection(self, src: Addr, dst: Addr, tx, rx) -> None:
+        try:
+            self.conn_queue.send((tx, rx, src, dst))
+        except ChannelClosed:
+            pass
+
+
+class TcpListener:
+    def __init__(self, guard: BindGuard, socket: _ListenerSocket):
+        self._guard = guard
+        self._socket = socket
+
+    @staticmethod
+    async def bind(addr: AddrLike) -> "TcpListener":
+        socket = _ListenerSocket()
+        guard = await BindGuard.bind(addr, IpProtocol.TCP, socket)
+        return TcpListener(guard, socket)
+
+    def local_addr(self) -> Addr:
+        return self._guard.addr
+
+    async def accept(self) -> Tuple["TcpStream", Addr]:
+        await self._guard.net.rand_delay()
+        try:
+            tx, rx, src, dst = await self._socket.conn_queue.recv()
+        except ChannelClosed:
+            raise ConnectionReset("listener closed") from None
+        # The server-side stream is manufactured here (`listener.rs:77-96`).
+        stream = TcpStream(tx, rx, local=dst, peer=src, guard=None)
+        return stream, src
+
+    def close(self) -> None:
+        self._guard.close()
+        self._socket.conn_queue.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class TcpStream:
+    def __init__(self, tx: ChannelSender, rx: ChannelReceiver, local: Addr, peer: Addr,
+                 guard: Optional[BindGuard]):
+        self._tx = tx
+        self._rx = rx
+        self._local = local
+        self._peer = peer
+        self._guard = guard  # client side holds its ephemeral port binding
+        self._read_buf = b""
+        self._write_buf = bytearray()
+        self._eof = False
+
+    @staticmethod
+    async def connect(addr: AddrLike) -> "TcpStream":
+        net = _netsim()
+        guard = await BindGuard.bind("0.0.0.0:0", IpProtocol.TCP, Socket())
+        from .addr import lookup_host
+
+        dst = (await lookup_host(addr))[0]
+        tx, rx, src = await net.connect1(guard.node, guard.addr[1], dst, IpProtocol.TCP)
+        return TcpStream(tx, rx, local=src, peer=dst, guard=guard)
+
+    def local_addr(self) -> Addr:
+        return self._local
+
+    def peer_addr(self) -> Addr:
+        return self._peer
+
+    # -- reading -----------------------------------------------------------
+    async def read(self, max_bytes: int = 65536) -> bytes:
+        """Read up to max_bytes; returns b"" at EOF (orderly shutdown)."""
+        if not self._read_buf:
+            if self._eof:
+                return b""
+            chunk = await self._rx.recv_or_eof()
+            if chunk is None:
+                self._eof = True
+                return b""
+            self._read_buf = bytes(chunk)
+        out, self._read_buf = self._read_buf[:max_bytes], self._read_buf[max_bytes:]
+        return out
+
+    async def read_exact(self, n: int) -> bytes:
+        parts = []
+        remaining = n
+        while remaining > 0:
+            chunk = await self.read(remaining)
+            if not chunk:
+                raise ConnectionReset("unexpected EOF")
+            parts.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(parts)
+
+    # -- writing -----------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        """Buffer data locally (`stream.rs:135-147`); flush to transmit."""
+        self._write_buf.extend(data)
+
+    async def flush(self) -> None:
+        if self._write_buf:
+            payload, self._write_buf = bytes(self._write_buf), bytearray()
+            await self._tx.send(payload)
+
+    async def write_all(self, data: bytes) -> None:
+        self.write(data)
+        await self.flush()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Orderly shutdown: peer reads EOF after draining in-flight data."""
+        self._tx.close()
+        if self._guard is not None:
+            self._guard.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,45 @@
+"""UDP facade: thin wrapper over Endpoint with tag 0
+(reference `madsim/src/sim/net/udp.rs:21-72`)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+from .addr import Addr, AddrLike
+from .endpoint import Endpoint
+
+
+class UdpSocket:
+    def __init__(self, ep: Endpoint):
+        self._ep = ep
+
+    @staticmethod
+    async def bind(addr: AddrLike) -> "UdpSocket":
+        return UdpSocket(await Endpoint.bind(addr))
+
+    @staticmethod
+    async def connect(addr: AddrLike) -> "UdpSocket":
+        return UdpSocket(await Endpoint.connect(addr))
+
+    def local_addr(self) -> Addr:
+        return self._ep.local_addr()
+
+    def peer_addr(self) -> Addr:
+        return self._ep.peer_addr()
+
+    async def send_to(self, dst: AddrLike, data: bytes) -> int:
+        await self._ep.send_to(dst, 0, bytes(data))
+        return len(data)
+
+    async def recv_from(self) -> Tuple[bytes, Addr]:
+        data, addr = await self._ep.recv_from(0)
+        return data, addr
+
+    async def send(self, data: bytes) -> int:
+        await self._ep.send(0, bytes(data))
+        return len(data)
+
+    async def recv(self) -> bytes:
+        return await self._ep.recv(0)
+
+    def close(self) -> None:
+        self._ep.close()

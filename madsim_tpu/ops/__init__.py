@@ -1,0 +1,2 @@
+"""Array-level ops shared by host and device engines (threefry RNG, event
+queues, pallas kernels)."""

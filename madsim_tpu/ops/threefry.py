@@ -1,0 +1,112 @@
+"""Counter-based RNG core: Threefry-2x32, 20 rounds (Random123).
+
+This replaces the reference's stateful SmallRng (`madsim/src/sim/rand.rs:63-108`)
+with a *counter-based* generator addressed by ``(key, counter)``. Counter-based
+is the design decision that makes the batched TPU backend possible: every random
+decision in a simulation is a pure function of ``(seed, stream, draw_index)``,
+so the host engine (numpy, one seed at a time) and the device engine (JAX,
+thousands of seeds vmapped) draw bit-identical values with no shared mutable
+state and no draw-order dependence.
+
+Two implementations with bit-exact agreement (tested against each other and
+against Random123 known-answer vectors):
+
+- :func:`threefry2x32_np` — numpy uint32, used by the host runtime's GlobalRng.
+- :func:`threefry2x32_jax` — jax uint32, traced into the device engine step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_M32 = np.uint32(0xFFFFFFFF)
+# Threefry-2x32 rotation constants (Random123).
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+_PARITY = 0x1BD11BDA
+
+
+def _rotl_np(x: np.ndarray, r: int) -> np.ndarray:
+    r = np.uint32(r)
+    return (x << r) | (x >> np.uint32(32 - int(r)))
+
+
+def threefry2x32_np(k0, k1, c0, c1):
+    """Threefry-2x32 (20 rounds) on numpy uint32 arrays or scalars.
+
+    Returns a pair of uint32 arrays with the same shape as the inputs.
+    """
+    with np.errstate(over="ignore"):
+        k0 = np.asarray(k0, dtype=np.uint32)
+        k1 = np.asarray(k1, dtype=np.uint32)
+        x0 = np.asarray(c0, dtype=np.uint32) + k0
+        x1 = np.asarray(c1, dtype=np.uint32) + k1
+        ks2 = k0 ^ k1 ^ np.uint32(_PARITY)
+        ks = (k0, k1, ks2)
+        for i in range(5):
+            for r in range(4):
+                x0 = x0 + x1
+                x1 = _rotl_np(x1, _ROTATIONS[4 * (i % 2) + r])
+                x1 = x1 ^ x0
+            x0 = x0 + ks[(i + 1) % 3]
+            x1 = x1 + ks[(i + 2) % 3] + np.uint32(i + 1)
+        return x0, x1
+
+
+def threefry2x32_jax(k0, k1, c0, c1):
+    """Threefry-2x32 (20 rounds) on jax uint32 arrays. Bit-exact vs numpy."""
+    import jax.numpy as jnp
+
+    k0 = jnp.asarray(k0, dtype=jnp.uint32)
+    k1 = jnp.asarray(k1, dtype=jnp.uint32)
+    x0 = jnp.asarray(c0, dtype=jnp.uint32) + k0
+    x1 = jnp.asarray(c1, dtype=jnp.uint32) + k1
+    ks2 = k0 ^ k1 ^ jnp.uint32(_PARITY)
+    ks = (k0, k1, ks2)
+
+    def rotl(x, r):
+        return (x << r) | (x >> (32 - r))
+
+    for i in range(5):
+        for r in range(4):
+            x0 = x0 + x1
+            x1 = rotl(x1, _ROTATIONS[4 * (i % 2) + r])
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(i + 1) % 3]
+        x1 = x1 + ks[(i + 2) % 3] + jnp.uint32(i + 1)
+    return x0, x1
+
+
+# ---------------------------------------------------------------------------
+# Stream derivation.
+#
+# A simulation seed (u64) is split into a 2x32 key. Named streams (scheduler,
+# network, time-base, user, per-purpose device streams) are derived by
+# encrypting the stream id under the seed key, giving independent counter
+# spaces per purpose. Draw i of stream s under seed k is
+#   threefry(derive(k, s), (lo(i), hi(i)))
+# — a pure function, identical on host and device.
+# ---------------------------------------------------------------------------
+
+def seed_to_key(seed: int):
+    """Split a u64 seed into a (k0, k1) uint32 pair."""
+    seed &= (1 << 64) - 1
+    return np.uint32(seed & 0xFFFFFFFF), np.uint32(seed >> 32)
+
+
+def derive_stream_np(k0, k1, stream: int):
+    """Derive an independent (k0, k1) key for a named stream id (u64)."""
+    stream &= (1 << 64) - 1
+    return threefry2x32_np(k0, k1, np.uint32(stream & 0xFFFFFFFF), np.uint32(stream >> 32))
+
+
+def derive_stream_jax(k0, k1, stream):
+    """JAX version of :func:`derive_stream_np` (stream may be a traced u32 pair)."""
+    import jax.numpy as jnp
+
+    stream_lo = jnp.asarray(stream, dtype=jnp.uint32)
+    return threefry2x32_jax(k0, k1, stream_lo, jnp.zeros_like(stream_lo))
+
+
+def draw_np(k0, k1, counter: int):
+    """Draw block `counter` (u64) of the stream keyed by (k0, k1) → 2 uint32."""
+    counter &= (1 << 64) - 1
+    return threefry2x32_np(k0, k1, np.uint32(counter & 0xFFFFFFFF), np.uint32(counter >> 32))

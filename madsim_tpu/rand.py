@@ -1,0 +1,45 @@
+"""Public deterministic-randomness API.
+
+Reference: `madsim/src/sim/rand.rs:135-164` — ``thread_rng()``/``random()``
+backed by the single seeded global RNG, so *every* random decision in the
+simulated world comes from the seed.
+"""
+from __future__ import annotations
+
+from .core import context
+from .core.rng import DeterminismError, GlobalRng  # noqa: F401 (re-export)
+
+__all__ = ["thread_rng", "random", "gen_range", "gen_bool", "shuffle", "choice",
+           "randbytes", "GlobalRng", "DeterminismError"]
+
+
+def thread_rng() -> GlobalRng:
+    """The current simulation's global RNG."""
+    return context.current_handle().rand
+
+
+def random() -> float:
+    return thread_rng().random()
+
+
+def gen_range(low: int, high: int) -> int:
+    return thread_rng().gen_range(low, high)
+
+
+def gen_bool(p: float) -> bool:
+    return thread_rng().gen_bool(p)
+
+
+def shuffle(seq: list) -> None:
+    thread_rng().shuffle(seq)
+
+
+def choice(seq):
+    return thread_rng().choice(seq)
+
+
+def randbytes(n: int) -> bytes:
+    """Deterministic replacement for os.urandom within a simulation
+    (the analog of the libc getrandom/getentropy overrides,
+    `rand.rs:195-261`)."""
+    return thread_rng().gen_bytes(n)

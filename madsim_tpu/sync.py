@@ -1,0 +1,184 @@
+"""Synchronization primitives for simulation tasks.
+
+The reference passes tokio::sync through unchanged (`madsim-tokio/src/lib.rs:
+40-52`) because tokio's primitives are runtime-independent. Here the executor
+is our own, so these are native implementations whose wakeups all route
+through the deterministic scheduler.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, List, Optional
+
+from .core.futures import Channel, ChannelClosed, SimFuture  # noqa: F401 (re-export)
+
+__all__ = ["Event", "Barrier", "Lock", "Semaphore", "Notify", "Queue", "oneshot",
+           "Channel", "ChannelClosed", "SimFuture"]
+
+
+class Event:
+    """One-way latch: wait() until set()."""
+
+    def __init__(self):
+        self._set = False
+        self._waiters: List[SimFuture] = []
+
+    def is_set(self) -> bool:
+        return self._set
+
+    def set(self) -> None:
+        self._set = True
+        waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            w.set_result(None)
+
+    async def wait(self) -> None:
+        if self._set:
+            return
+        fut = SimFuture()
+        self._waiters.append(fut)
+        await fut
+
+
+class Barrier:
+    """N-party barrier (tokio::sync::Barrier semantics, reusable)."""
+
+    def __init__(self, parties: int):
+        if parties < 1:
+            raise ValueError("barrier needs at least 1 party")
+        self._parties = parties
+        self._arrived: List[SimFuture] = []
+
+    async def wait(self) -> bool:
+        """Returns True for the leader (last arriver) of each generation."""
+        if len(self._arrived) + 1 == self._parties:
+            arrived, self._arrived = self._arrived, []
+            for fut in arrived:
+                fut.set_result(None)
+            return True
+        fut = SimFuture()
+        self._arrived.append(fut)
+        await fut
+        return False
+
+
+class Lock:
+    """Async mutex."""
+
+    def __init__(self):
+        self._locked = False
+        self._waiters: Deque[SimFuture] = deque()
+
+    async def acquire(self) -> None:
+        if not self._locked:
+            self._locked = True
+            return
+        fut = SimFuture()
+        self._waiters.append(fut)
+        await fut
+
+    def release(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)  # hand the lock to the next waiter
+                return
+        self._locked = False
+
+    async def __aenter__(self):
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.release()
+        return False
+
+
+class Semaphore:
+    def __init__(self, permits: int):
+        self._permits = permits
+        self._waiters: Deque[SimFuture] = deque()
+
+    async def acquire(self) -> None:
+        if self._permits > 0:
+            self._permits -= 1
+            return
+        fut = SimFuture()
+        self._waiters.append(fut)
+        await fut
+
+    def release(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._permits += 1
+
+    async def __aenter__(self):
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.release()
+        return False
+
+
+class Notify:
+    """tokio::sync::Notify: notify_one stores a permit if nobody waits."""
+
+    def __init__(self):
+        self._permit = False
+        self._waiters: Deque[SimFuture] = deque()
+
+    def notify_one(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._permit = True
+
+    def notify_waiters(self) -> None:
+        waiters, self._waiters = self._waiters, deque()
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    async def notified(self) -> None:
+        if self._permit:
+            self._permit = False
+            return
+        fut = SimFuture()
+        self._waiters.append(fut)
+        await fut
+
+
+class Queue:
+    """Unbounded async FIFO queue (asyncio.Queue-flavored surface)."""
+
+    def __init__(self):
+        self._ch = Channel()
+
+    def put_nowait(self, item: Any) -> None:
+        self._ch.send(item)
+
+    async def put(self, item: Any) -> None:
+        self._ch.send(item)
+
+    async def get(self) -> Any:
+        return await self._ch.recv()
+
+    def qsize(self) -> int:
+        return len(self._ch)
+
+    def empty(self) -> bool:
+        return len(self._ch) == 0
+
+    def close(self) -> None:
+        self._ch.close()
+
+
+def oneshot() -> SimFuture:
+    """A oneshot channel is just a future: sender calls set_result."""
+    return SimFuture()

@@ -1,0 +1,37 @@
+"""Public task API: spawn / spawn_blocking / JoinHandle.
+
+Reference: `madsim/src/sim/task.rs:369-459` — tokio-style spawn returning an
+abortable, awaitable JoinHandle. ``spawn_local`` is an alias (the whole world
+is one thread); ``spawn_blocking`` wraps a sync callable as a task that runs
+to completion at its scheduling point.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Coroutine
+
+from .core import context
+from .core.task import JoinHandle  # noqa: F401 (re-export)
+
+__all__ = ["spawn", "spawn_local", "spawn_blocking", "JoinHandle", "available_parallelism"]
+
+
+def spawn(coro: Coroutine) -> JoinHandle:
+    """Spawn a coroutine as a task on the current node."""
+    return context.current_handle().task.spawn(coro)
+
+
+def spawn_local(coro: Coroutine) -> JoinHandle:
+    return spawn(coro)
+
+
+def spawn_blocking(fn: Callable[[], Any]) -> JoinHandle:
+    async def _runner():
+        return fn()
+
+    return spawn(_runner())
+
+
+def available_parallelism() -> int:
+    """The current node's configured core count (the analog of the
+    sched_getaffinity/sysconf interception at `task.rs:508-560`)."""
+    return context.current_task().node.cores

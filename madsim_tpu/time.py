@@ -1,0 +1,190 @@
+"""Public virtual-time API: sleep / timeout / interval / clocks.
+
+Reference: `madsim/src/sim/time/{mod,sleep,interval}.rs` — ``sleep``,
+``sleep_until``, ``timeout`` (future-vs-timer race, `time/mod.rs:122-134`),
+tokio-style ``Interval`` with the three MissedTickBehavior variants
+(`interval.rs:38-188`), plus ``Instant``/``SystemTime`` reads of the mock
+clock. Durations are float seconds at the API; integer nanoseconds inside.
+"""
+from __future__ import annotations
+
+import enum
+from functools import total_ordering
+from typing import Any, Awaitable, Optional
+
+from .core import context
+from .core.futures import SimFuture
+from .core.timewheel import NANOS_PER_SEC, to_ns
+
+__all__ = [
+    "sleep", "sleep_until", "timeout", "interval", "interval_at",
+    "Interval", "MissedTickBehavior", "Instant", "monotonic", "monotonic_ns",
+    "system_time", "system_time_ns", "elapsed",
+]
+
+
+def _time():
+    return context.current_handle().time
+
+
+# -- clock reads -----------------------------------------------------------
+
+def monotonic_ns() -> int:
+    """Virtual monotonic nanoseconds since simulation start."""
+    return _time().now_ns()
+
+
+def monotonic() -> float:
+    return monotonic_ns() / NANOS_PER_SEC
+
+
+def system_time_ns() -> int:
+    """Simulated wall-clock unix-epoch nanoseconds (seed-randomized base in
+    2022, `time/mod.rs:27-32`)."""
+    return _time().system_time_ns()
+
+
+def system_time() -> float:
+    return system_time_ns() / NANOS_PER_SEC
+
+
+def elapsed() -> float:
+    """Alias for :func:`monotonic` (reference's Instant-since-start idiom)."""
+    return monotonic()
+
+
+@total_ordering
+class Instant:
+    """Monotonic timestamp on the virtual clock."""
+
+    __slots__ = ("ns",)
+
+    def __init__(self, ns: int):
+        self.ns = ns
+
+    @staticmethod
+    def now() -> "Instant":
+        return Instant(monotonic_ns())
+
+    def elapsed(self) -> float:
+        return (monotonic_ns() - self.ns) / NANOS_PER_SEC
+
+    def __sub__(self, other: "Instant") -> float:
+        return (self.ns - other.ns) / NANOS_PER_SEC
+
+    def __add__(self, seconds: float) -> "Instant":
+        return Instant(self.ns + to_ns(seconds))
+
+    def __eq__(self, other):
+        return isinstance(other, Instant) and self.ns == other.ns
+
+    def __lt__(self, other):
+        return self.ns < other.ns
+
+    def __hash__(self):
+        return hash(self.ns)
+
+    def __repr__(self):
+        return f"Instant({self.ns}ns)"
+
+
+# -- sleeping --------------------------------------------------------------
+
+def sleep(seconds: float) -> SimFuture:
+    """Awaitable that completes after virtual ``seconds``. The timer is
+    registered at call time (tokio Sleep semantics)."""
+    return sleep_until_ns(_time().now_ns() + to_ns(seconds))
+
+
+def sleep_until(instant: "Instant | float") -> SimFuture:
+    """Sleep until an :class:`Instant` (or float virtual-monotonic seconds)."""
+    ns = instant.ns if isinstance(instant, Instant) else to_ns(instant)
+    return sleep_until_ns(ns)
+
+
+def sleep_until_ns(deadline_ns: int) -> SimFuture:
+    time = _time()
+    fut = SimFuture()
+    if deadline_ns <= time.now_ns():
+        fut.set_result(None)
+    else:
+        time.add_timer_at(deadline_ns, lambda: fut.set_result(None))
+    return fut
+
+
+# -- timeout ---------------------------------------------------------------
+
+async def timeout(seconds: float, awaitable: Awaitable[Any]) -> Any:
+    """Run ``awaitable`` with a virtual-time deadline; raises
+    :class:`TimeoutError` if the deadline elapses first
+    (`time/mod.rs:122-134`)."""
+    handle = context.current_handle()
+    result: SimFuture = SimFuture()
+
+    async def _runner():
+        try:
+            value = await awaitable
+        except GeneratorExit:
+            raise
+        except BaseException as exc:  # noqa: BLE001 — forwarded to the caller
+            result.set_exception(exc)
+        else:
+            result.set_result(value)
+
+    timer = handle.time.add_timer(
+        to_ns(seconds), lambda: result.set_exception(TimeoutError())
+    )
+    inner = handle.task.spawn(_runner())
+    try:
+        return await result
+    finally:
+        timer.cancel()
+        inner.abort()
+
+
+# -- interval --------------------------------------------------------------
+
+class MissedTickBehavior(enum.Enum):
+    """tokio's three catch-up policies (`interval.rs:38-188`)."""
+
+    BURST = "burst"
+    DELAY = "delay"
+    SKIP = "skip"
+
+
+class Interval:
+    def __init__(self, period: float, start_ns: Optional[int] = None,
+                 missed_tick_behavior: MissedTickBehavior = MissedTickBehavior.BURST):
+        if period <= 0:
+            raise ValueError("interval period must be positive")
+        self.period_ns = to_ns(period)
+        self.missed_tick_behavior = missed_tick_behavior
+        self._next_ns = start_ns if start_ns is not None else _time().now_ns()
+
+    async def tick(self) -> Instant:
+        """Wait for the next tick; returns its scheduled timestamp."""
+        await sleep_until_ns(self._next_ns)
+        scheduled = self._next_ns
+        now = _time().now_ns()
+        behavior = self.missed_tick_behavior
+        if behavior is MissedTickBehavior.BURST:
+            self._next_ns = scheduled + self.period_ns
+        elif behavior is MissedTickBehavior.DELAY:
+            self._next_ns = now + self.period_ns
+        else:  # SKIP: next multiple of period after now, phase-locked to start
+            missed = (now - scheduled) // self.period_ns + 1
+            self._next_ns = scheduled + missed * self.period_ns
+        return Instant(scheduled)
+
+    def reset(self) -> None:
+        self._next_ns = _time().now_ns() + self.period_ns
+
+
+def interval(period: float) -> Interval:
+    """Interval whose first tick completes immediately (tokio semantics)."""
+    return Interval(period)
+
+
+def interval_at(start: "Instant | float", period: float) -> Interval:
+    start_ns = start.ns if isinstance(start, Instant) else to_ns(start)
+    return Interval(period, start_ns=start_ns)

@@ -1,0 +1,135 @@
+"""File-system simulator tests (`fs.rs:259-296` + the power_fail semantics
+the reference left as a TODO)."""
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import fs, time
+
+
+def test_create_write_read():
+    rt = ms.Runtime(seed=1)
+    node = rt.create_node(name="n1")
+
+    async def work():
+        f = await fs.File.create("/data")
+        await f.write_all_at(b"hello world", 0)
+        assert await f.read_at(0, 5) == b"hello"
+        assert await f.read_at(6, 100) == b"world"
+        assert (await f.metadata()).len == 11
+        await f.set_len(5)
+        assert await f.read_all() == b"hello"
+        assert await fs.read("/data") == b"hello"
+
+    h = node.spawn(work())
+
+    async def main():
+        await h
+
+    rt.block_on(main())
+
+
+def test_open_missing_file():
+    rt = ms.Runtime(seed=1)
+    node = rt.create_node(name="n1")
+
+    async def work():
+        with pytest.raises(FileNotFoundError):
+            await fs.File.open("/missing")
+
+    h = node.spawn(work())
+
+    async def main():
+        await h
+
+    rt.block_on(main())
+
+
+def test_fs_is_per_node():
+    rt = ms.Runtime(seed=1)
+    n1 = rt.create_node(name="n1")
+    n2 = rt.create_node(name="n2")
+
+    async def writer():
+        await fs.write("/f", b"n1-data")
+
+    async def reader():
+        with pytest.raises(FileNotFoundError):
+            await fs.read("/f")
+
+    async def main():
+        await n1.spawn(writer())
+        await n2.spawn(reader())
+
+    rt.block_on(main())
+
+
+def test_power_fail_loses_unsynced_data():
+    """Kill = power failure: synced data survives, unsynced is lost."""
+    rt = ms.Runtime(seed=1)
+    results = {}
+
+    async def init():
+        f = await fs.File.open_or_create("/wal")
+        existing = await f.read_all()
+        if existing:
+            results["after_crash"] = existing
+            return
+        await f.write_all_at(b"durable", 0)
+        await f.sync_all()
+        await f.write_all_at(b"volatile", 7)
+        # no sync — crash loses this
+        await time.sleep(1000.0)
+
+    node = rt.create_node(name="db", init=init)
+
+    async def main():
+        await time.sleep(1.0)
+        ms.Handle.current().restart(node)
+        await time.sleep(1.0)
+        assert results["after_crash"] == b"durable"
+
+    rt.block_on(main())
+
+
+def test_disk_survives_restart():
+    rt = ms.Runtime(seed=1)
+    seen = []
+
+    async def init():
+        f = await fs.File.open_or_create("/state")
+        data = await f.read_all()
+        seen.append(bytes(data))
+        await f.set_len(0)
+        await f.write_all_at(b"gen%d" % len(seen), 0)
+        await f.sync_all()
+        await time.sleep(1000.0)
+
+    node = rt.create_node(name="db", init=init)
+
+    async def main():
+        await time.sleep(1.0)
+        ms.Handle.current().restart(node)
+        await time.sleep(1.0)
+        ms.Handle.current().restart(node)
+        await time.sleep(1.0)
+        assert seen == [b"", b"gen1", b"gen2"]
+
+    rt.block_on(main())
+
+
+def test_remove_file():
+    rt = ms.Runtime(seed=1)
+    node = rt.create_node(name="n1")
+
+    async def work():
+        await fs.write("/tmpf", b"x")
+        await fs.remove_file("/tmpf")
+        with pytest.raises(FileNotFoundError):
+            await fs.read("/tmpf")
+
+    h = node.spawn(work())
+
+    async def main():
+        await h
+
+    rt.block_on(main())

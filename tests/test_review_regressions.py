@@ -1,0 +1,127 @@
+"""Regression tests for defects found in code review (round 1)."""
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import net, task, time
+from madsim_tpu.net import Endpoint, NetSim
+from madsim_tpu.net import rpc as msrpc
+
+
+def test_main_node_can_use_network():
+    """The block_on root task (main node, id 0) must be known to NetSim."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        ep = await Endpoint.bind("127.0.0.1:100")
+        assert ep.local_addr() == ("127.0.0.1", 100)
+
+    rt.block_on(main())
+
+
+def test_node_killing_itself():
+    """A task calling kill() on its own node must not crash the sim."""
+    rt = ms.Runtime(seed=1)
+    log = []
+
+    async def suicidal():
+        log.append("up")
+        await time.sleep(0.1)
+        ms.Handle.current().kill(node)
+        log.append("after-kill")  # runs until next await, then dropped
+
+    node = rt.create_node(name="kamikaze", init=suicidal)
+
+    async def main():
+        await time.sleep(5.0)
+        assert "up" in log
+
+    rt.block_on(main())
+
+
+def test_check_determinism_with_config_mutation():
+    """In-sim config mutation must not leak between checker runs."""
+    cfg = ms.Config()
+
+    async def main():
+        sim = ms.simulator(NetSim)
+        sim.update_config(lambda c: setattr(c, "packet_loss_rate", c.packet_loss_rate + 0.4))
+        for _ in range(20):
+            await time.sleep(ms.rand.random())
+
+    ms.Runtime.check_determinism(0, cfg, main)
+    assert cfg.net.packet_loss_rate == 0.0, "caller's config must not be polluted"
+
+
+def test_recv_cancelled_in_processing_delay_requeues():
+    """A message taken from the mailbox but not delivered (receiver cancelled
+    during the post-receive delay) must be requeued, not lost."""
+    rt = ms.Runtime(seed=1)
+    n1 = rt.create_node(name="n1", ip="10.0.0.1")
+    n2 = rt.create_node(name="n2", ip="10.0.0.2")
+
+    async def sender():
+        ep = await Endpoint.bind(("10.0.0.1", 1))
+        await ep.send_to(("10.0.0.2", 1), 7, b"precious")
+
+    async def receiver():
+        ep = await Endpoint.bind(("10.0.0.2", 1))
+        # Try many tight timeouts: some cancel mid-delay across seeds.
+        got = None
+        for _ in range(200):
+            try:
+                got = await time.timeout(1e-6, ep.recv_from(7))
+                break
+            except TimeoutError:
+                continue
+        if got is None:
+            got = await time.timeout(10.0, ep.recv_from(7))
+        assert got[0] == b"precious"
+
+    n1.spawn(sender())
+    h = n2.spawn(receiver())
+
+    async def main():
+        await h
+
+    rt.block_on(main())
+
+
+def test_endpoint_close_with_rpc_handler_is_clean():
+    """Closing an endpoint with a registered handler must not abort the sim."""
+    rt = ms.Runtime(seed=1)
+    node = rt.create_node(name="srv", ip="10.0.0.1")
+
+    class Req:
+        pass
+
+    async def server():
+        ep = await Endpoint.bind(("10.0.0.1", 1))
+
+        async def on_req(_req):
+            return "ok"
+
+        msrpc.add_rpc_handler(ep, Req, on_req)
+        await time.sleep(1.0)
+        ep.close()
+
+    h = node.spawn(server())
+
+    async def main():
+        await h
+        await time.sleep(5.0)  # dispatcher must have exited cleanly
+
+    rt.block_on(main())
+
+
+def test_aborted_tasks_do_not_leak():
+    """timeout() aborts its runner; NodeInfo.tasks must not grow unboundedly."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        for _ in range(100):
+            with pytest.raises(TimeoutError):
+                await time.timeout(0.001, time.sleep(10.0))
+        node = ms.Handle.current().task.main_node.info
+        assert len(node.tasks) < 10, f"leaked {len(node.tasks)} task entries"
+
+    rt.block_on(main())
