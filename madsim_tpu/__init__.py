@@ -23,6 +23,8 @@ from .core.runtime import Handle, NodeHandle, Runtime, init_logger
 from .core.task import Deadlock, JoinHandle, TimeLimitExceeded
 from .core.plugin import Simulator, simulator
 
+from .testing import Builder, main, run, test
+
 from . import fs, net, rand, sync, task, time
 
 __version__ = "0.1.0"
@@ -32,6 +34,7 @@ __all__ = [
     "Runtime", "Handle", "NodeHandle", "init_logger",
     "Deadlock", "TimeLimitExceeded", "DeterminismError", "NoRuntimeError",
     "Cancelled", "ChannelClosed",
+    "Builder", "main", "run", "test",
     "Simulator", "simulator",
     "fs", "net", "rand", "sync", "task", "time",
 ]

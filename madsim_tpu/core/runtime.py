@@ -82,6 +82,11 @@ class NodeHandle:
     def name(self) -> str:
         return self._node.name
 
+    def is_alive(self) -> bool:
+        """False between kill() and restart() (true liveness, not the
+        per-generation killed flag)."""
+        return self._node.alive
+
     def spawn(self, coro: Coroutine):
         return self._node.spawn(coro)
 

@@ -149,6 +149,7 @@ class Executor:
 
     def kill(self, node_id: int) -> None:
         node = self._get_node(node_id)
+        node.alive = False
         old = node.info
         old.killed = True
         for task in list(old.tasks):
@@ -162,6 +163,7 @@ class Executor:
     def restart(self, node_id: int) -> None:
         self.kill(node_id)
         node = self._get_node(node_id)
+        node.alive = True
         if node.init is not None:
             self.spawn(node.init(), node.info)
 
@@ -284,7 +286,7 @@ class Executor:
 class Node:
     """A simulated machine: a stream of NodeInfo generations + init closure."""
 
-    __slots__ = ("id", "name", "cores", "init", "info", "_executor")
+    __slots__ = ("id", "name", "cores", "init", "info", "alive", "_executor")
 
     def __init__(self, node_id: int, name: str, cores: int, init, executor: Executor):
         self.id = node_id
@@ -292,6 +294,7 @@ class Node:
         self.cores = cores
         self.init = init
         self.info = NodeInfo(node_id, name, cores)
+        self.alive = True
         self._executor = executor
 
     def spawn(self, coro: Coroutine) -> JoinHandle:

@@ -1,0 +1,2 @@
+"""Reference workloads built on the framework (the MadRaft analog and the
+benchmark payloads from BASELINE.md)."""

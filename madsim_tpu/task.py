@@ -12,7 +12,8 @@ from typing import Any, Callable, Coroutine
 from .core import context
 from .core.task import JoinHandle  # noqa: F401 (re-export)
 
-__all__ = ["spawn", "spawn_local", "spawn_blocking", "JoinHandle", "available_parallelism"]
+__all__ = ["spawn", "spawn_local", "spawn_blocking", "JoinHandle",
+           "available_parallelism", "current_node"]
 
 
 def spawn(coro: Coroutine) -> JoinHandle:
@@ -35,3 +36,9 @@ def available_parallelism() -> int:
     """The current node's configured core count (the analog of the
     sched_getaffinity/sysconf interception at `task.rs:508-560`)."""
     return context.current_task().node.cores
+
+
+def current_node():
+    """The NodeHandle of the node the current task runs on."""
+    handle = context.current_handle()
+    return handle.get_node(context.current_task().node.id)

@@ -1,0 +1,188 @@
+"""Multi-seed test driver + @test/@main decorators.
+
+Reference: `madsim-macros/src/lib.rs:115-153` (#[madsim::test] rewrites the
+body into ``init_logger(); Builder::from_env().run(...)``) and
+`madsim/src/sim/runtime/builder.rs:23-148` (env-driven seed sweep).
+
+Environment variables (same names as the reference):
+
+- ``MADSIM_TEST_SEED``   — base seed (default: unix-epoch seconds)
+- ``MADSIM_TEST_NUM``    — number of seeds, seed..seed+num (default 1)
+- ``MADSIM_TEST_JOBS``   — concurrent simulations (threads; default 1)
+- ``MADSIM_TEST_CONFIG`` — path to a TOML config file
+- ``MADSIM_TEST_TIME_LIMIT``        — virtual-time limit per run, seconds
+- ``MADSIM_TEST_CHECK_DETERMINISM`` — run each seed twice with RNG log/replay
+
+On failure the driver prints the repro banner with the failing seed and the
+config hash (`runtime/mod.rs:192-199`).
+
+This thread-per-simulation sweep is the reference's only multi-simulation
+parallelism (`builder.rs:118-136`) — the axis the batched device engine
+(:mod:`madsim_tpu.engine`) turns into vmap over thousands of seeds.
+"""
+from __future__ import annotations
+
+import copy
+import functools
+import inspect
+import os
+import sys
+import threading
+import time as _walltime
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Coroutine, Optional
+
+from .core.config import Config
+from .core.runtime import Runtime, init_logger
+
+
+class Builder:
+    """Seed-sweep driver for simulation tests."""
+
+    def __init__(self, seed: Optional[int] = None, count: int = 1, jobs: int = 1,
+                 config: Optional[Config] = None, config_path: Optional[str] = None,
+                 time_limit: Optional[float] = None, check_determinism: bool = False):
+        self.seed = seed if seed is not None else int(_walltime.time())
+        self.count = max(1, count)
+        self.jobs = max(1, jobs)
+        self.config = config
+        self.config_path = config_path
+        self.time_limit = time_limit
+        self.check_determinism = check_determinism
+
+    @staticmethod
+    def from_env() -> "Builder":
+        env = os.environ
+        seed = int(env["MADSIM_TEST_SEED"]) if "MADSIM_TEST_SEED" in env else None
+        count = int(env.get("MADSIM_TEST_NUM", "1"))
+        jobs = int(env.get("MADSIM_TEST_JOBS", "1"))
+        time_limit = (
+            float(env["MADSIM_TEST_TIME_LIMIT"]) if "MADSIM_TEST_TIME_LIMIT" in env else None
+        )
+        check = env.get("MADSIM_TEST_CHECK_DETERMINISM", "") not in ("", "0", "false")
+        config = None
+        config_path = env.get("MADSIM_TEST_CONFIG")
+        if config_path:
+            with open(config_path) as f:
+                config = Config.from_toml(f.read())
+        return Builder(seed=seed, count=count, jobs=jobs, config=config,
+                       config_path=config_path, time_limit=time_limit,
+                       check_determinism=check)
+
+    def _run_one(self, seed: int, make_coro: Callable[[], Coroutine]) -> Any:
+        config = copy.deepcopy(self.config) if self.config is not None else None
+        if self.check_determinism:
+            return Runtime.check_determinism(seed, config, make_coro,
+                                             time_limit=self.time_limit)
+        rt = Runtime(seed=seed, config=config)
+        if self.time_limit is not None:
+            rt.set_time_limit(self.time_limit)
+        return rt.block_on(make_coro())
+
+    def run(self, make_coro: Callable[[], Coroutine]) -> Any:
+        """Run the simulation for each seed; returns the last result.
+
+        On failure, prints the reproduction banner and re-raises.
+        """
+        result: Any = None
+        seeds = range(self.seed, self.seed + self.count)
+
+        def run_seed(seed: int) -> Any:
+            try:
+                return self._run_one(seed, make_coro)
+            except BaseException:
+                config = self.config if self.config is not None else Config()
+                print(
+                    "note: run with environment variable "
+                    f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"
+                    f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}",
+                    file=sys.stderr,
+                )
+                raise
+
+        if self.jobs == 1:
+            for seed in seeds:
+                # A dedicated thread per simulation isolates thread-local
+                # context exactly like the reference (`builder.rs:123`).
+                result = _run_on_thread(run_seed, seed)
+        else:
+            with ThreadPoolExecutor(max_workers=self.jobs) as pool:
+                futures = [pool.submit(run_seed, seed) for seed in seeds]
+                for fut in futures:
+                    result = fut.result()
+        return result
+
+
+def _run_on_thread(fn: Callable[[int], Any], seed: int) -> Any:
+    box: list = [None, None]
+
+    def target():
+        try:
+            box[0] = fn(seed)
+        except BaseException as exc:  # noqa: BLE001
+            box[1] = exc
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join()
+    if box[1] is not None:
+        raise box[1]
+    return box[0]
+
+
+def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Optional[int] = None,
+         jobs: Optional[int] = None, config: Optional[Config] = None,
+         time_limit: Optional[float] = None, check_determinism: Optional[bool] = None):
+    """Decorator: turn an async test fn into a multi-seed simulation test.
+
+    ``@madsim_tpu.test`` / ``@madsim_tpu.test(count=10, time_limit=300)``.
+    Env vars override nothing explicitly passed; explicit kwargs win.
+    """
+
+    def wrap(async_fn: Callable[..., Coroutine]) -> Callable:
+        if not inspect.iscoroutinefunction(async_fn):
+            raise TypeError("@madsim_tpu.test requires an async function")
+
+        @functools.wraps(async_fn)
+        def runner(*args, **kwargs):
+            init_logger()
+            b = Builder.from_env()
+            if seed is not None:
+                b.seed = seed
+            if count is not None:
+                b.count = max(1, count)
+            if jobs is not None:
+                b.jobs = max(1, jobs)
+            if config is not None:
+                b.config = config
+            if time_limit is not None:
+                b.time_limit = time_limit
+            if check_determinism is not None:
+                b.check_determinism = check_determinism
+            return b.run(lambda: async_fn(*args, **kwargs))
+
+        return runner
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def main(fn: Callable[..., Coroutine]) -> Callable:
+    """Decorator for executable entry points (#[madsim::main] analog)."""
+
+    @functools.wraps(fn)
+    def runner(*args, **kwargs):
+        init_logger()
+        return Builder.from_env().run(lambda: fn(*args, **kwargs))
+
+    return runner
+
+
+def run(coro: Coroutine, seed: int = 0, config: Optional[Config] = None,
+        time_limit: Optional[float] = None) -> Any:
+    """One-shot convenience: run a coroutine in a fresh seeded Runtime."""
+    rt = Runtime(seed=seed, config=config)
+    if time_limit is not None:
+        rt.set_time_limit(time_limit)
+    return rt.block_on(coro)

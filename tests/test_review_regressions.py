@@ -125,3 +125,72 @@ def test_aborted_tasks_do_not_leak():
         assert len(node.tasks) < 10, f"leaked {len(node.tasks)} task entries"
 
     rt.block_on(main())
+
+
+def test_node_liveness_api():
+    """NodeHandle.is_alive reflects kill/restart (review round 2)."""
+    rt = ms.Runtime(seed=1)
+    node = rt.create_node(name="n")
+
+    async def main():
+        assert node.is_alive()
+        ms.Handle.current().kill(node)
+        assert not node.is_alive()
+        ms.Handle.current().restart(node)
+        assert node.is_alive()
+
+    rt.block_on(main())
+
+
+def test_raft_leader_persists_to_own_disk():
+    """Leader-side start() must persist to the leader's node disk, not the
+    caller's (review round 2)."""
+    from madsim_tpu.models.raft import RaftCluster
+    from madsim_tpu import fs as msfs
+
+    rt = ms.Runtime(seed=13)
+    rt.set_time_limit(120.0)
+
+    async def main():
+        cluster = RaftCluster(3)
+        leader = await cluster.wait_for_leader()
+        await cluster.propose("precious")
+        await time.sleep(0.5)
+
+        # main node disk must NOT have raft state
+        async def read_main():
+            try:
+                return await msfs.read("/raft-state")
+            except FileNotFoundError:
+                return None
+
+        assert await read_main() is None, "raft state leaked onto main node disk"
+        # leader's own disk must have it
+        blob = {}
+
+        async def read_leader():
+            blob["b"] = await msfs.read("/raft-state")
+
+        await cluster.nodes[leader].spawn(read_leader())
+        import pickle
+        term, voted, log = pickle.loads(blob["b"])
+        assert any(cmd == "precious" for _, cmd in log)
+
+    rt.block_on(main())
+
+
+def test_wait_for_leader_after_kill_excludes_dead_node():
+    from madsim_tpu.models.raft import RaftCluster
+
+    rt = ms.Runtime(seed=21)
+    rt.set_time_limit(120.0)
+
+    async def main():
+        cluster = RaftCluster(3)
+        first = await cluster.wait_for_leader()
+        cluster.kill(first)
+        assert cluster.leader() != first, "dead node must not be reported leader"
+        second = await cluster.wait_for_leader(timeout=30.0)
+        assert second != first
+
+    rt.block_on(main())
