@@ -1,0 +1,39 @@
+"""Batched device engine: the simulation decision kernel on TPU.
+
+This is the TPU-native answer to the reference's one-seed-per-thread sweep
+(`madsim/src/sim/runtime/builder.rs:118-136`, env ``MADSIM_TEST_JOBS``): the
+discrete-event core — next-event selection, virtual-clock advance, RNG draws,
+network latency/loss/partition sampling, fault schedules
+(`madsim/src/sim/time/mod.rs`, `net/network.rs:249-257`, `rand.rs:63-108`) —
+is lifted into a pure JAX step function over arrays with a leading *world*
+(seed) axis, ``vmap``'d over thousands of seeds, and sharded across a TPU mesh
+via :mod:`madsim_tpu.parallel`.
+
+Workloads for this engine are *actors*: node logic written as pure JAX
+functions over fixed-size state (see :class:`madsim_tpu.engine.raft_actor.RaftActor`),
+in contrast to the host engine which runs arbitrary Python coroutines one
+seed at a time. Both engines draw from the same counter-based Threefry
+streams (:mod:`madsim_tpu.ops.threefry`).
+"""
+from .core import (
+    DeviceEngine,
+    EngineConfig,
+    Event,
+    Outbox,
+    WorldState,
+    FAULT_KILL,
+    FAULT_RESTART,
+    FAULT_CLOG_NODE,
+    FAULT_UNCLOG_NODE,
+    FAULT_CLOG_LINK,
+    FAULT_UNCLOG_LINK,
+    INF_TIME,
+)
+from .raft_actor import RaftActor, RaftDeviceConfig
+
+__all__ = [
+    "DeviceEngine", "EngineConfig", "Event", "Outbox", "WorldState",
+    "RaftActor", "RaftDeviceConfig",
+    "FAULT_KILL", "FAULT_RESTART", "FAULT_CLOG_NODE", "FAULT_UNCLOG_NODE",
+    "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "INF_TIME",
+]

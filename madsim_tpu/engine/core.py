@@ -1,0 +1,358 @@
+"""The batched device engine core: world state + step function.
+
+Design (SURVEY §7 stage 4): one *world* = one seeded simulation, all of whose
+engine-level state — virtual clock, pending-event queue, RNG cursor, node
+liveness/generation, link partition matrices, counters — is fixed-shape
+arrays. The per-world ``step`` is a pure function (pop earliest event →
+apply fault / dispatch to the actor via its handler → sample network
+latency/loss for the outbox → push), ``vmap``'d over the world axis so
+thousands of seeds advance per XLA dispatch. Worlds that finish (empty queue,
+time limit, or bug with ``stop_on_bug``) are frozen by a select — the
+step-synchronous masking that replaces the reference's one-OS-thread-per-seed
+sweep (`madsim/src/sim/runtime/builder.rs:118-136`).
+
+Semantics carried over from the reference host engine:
+- message sends sample clog/loss/latency at *send* time
+  (`madsim/src/sim/net/network.rs:249-257`);
+- node kill bumps a generation counter so pending timers die with the node
+  (the lazy-drop of queued runnables, `task.rs:211-226`), while in-flight
+  messages are delivered iff the destination is alive at delivery time;
+- restart re-runs the actor's init hook (`task.rs:229-240`);
+- every random decision draws from the per-world counter-based Threefry
+  stream, so (seed, config) ⇒ bit-exact trajectories, re-runnable anywhere.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .queue import (
+    Event,
+    EventQueue,
+    FLAG_FAULT,
+    FLAG_TIMER,
+    INF_TIME,
+    empty_queue,
+    next_deadline,
+    pop,
+    push,
+)
+from .rng import DevRng, make_rng, uniform_f32, uniform_u32
+
+# Device-engine RNG stream id (host streams occupy 0..3, see core/rng.py).
+STREAM_DEVICE = 16
+
+# Fault-injection ops (event kind when FLAG_FAULT is set). The analogs of
+# Handle::kill/restart (`runtime/mod.rs:241-258`) and NetSim::clog_node /
+# clog_link (`net/mod.rs:147-170`, `network.rs:159-190`).
+FAULT_KILL = 0
+FAULT_RESTART = 1
+FAULT_CLOG_NODE = 2
+FAULT_UNCLOG_NODE = 3
+FAULT_CLOG_LINK = 4
+FAULT_UNCLOG_LINK = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static (compile-time) engine parameters. Hashable: part of jit keys."""
+
+    n_nodes: int
+    queue_cap: int = 128
+    payload_words: int = 8
+    outbox_cap: Optional[int] = None  # default n_nodes + 1
+    # Network model (reference defaults: 1-10 ms latency, 0 loss;
+    # `net/network.rs:74-94`). Times are int32 microseconds.
+    latency_min_us: int = 1_000
+    latency_max_us: int = 10_000
+    loss_rate: float = 0.0
+    t_limit_us: int = 10_000_000
+    stop_on_bug: bool = True
+
+    @property
+    def m(self) -> int:
+        return self.outbox_cap if self.outbox_cap is not None else self.n_nodes + 1
+
+
+class Outbox(NamedTuple):
+    """Fixed-capacity send buffer an actor returns from a handler.
+
+    Slot fields are (M,) arrays ((M, P) for payload). Timers are delivered to
+    ``dst`` after ``delay_us`` and are generation-checked; messages get
+    engine-sampled latency/loss/partition treatment instead.
+    """
+
+    valid: jnp.ndarray     # (M,) bool
+    is_timer: jnp.ndarray  # (M,) bool
+    kind: jnp.ndarray      # (M,) int32
+    dst: jnp.ndarray       # (M,) int32
+    delay_us: jnp.ndarray  # (M,) int32 — timers only
+    payload: jnp.ndarray   # (M, P) int32
+
+    @staticmethod
+    def empty(cfg: EngineConfig) -> "Outbox":
+        m = cfg.m
+        return Outbox(
+            valid=jnp.zeros((m,), bool),
+            is_timer=jnp.zeros((m,), bool),
+            kind=jnp.zeros((m,), jnp.int32),
+            dst=jnp.zeros((m,), jnp.int32),
+            delay_us=jnp.zeros((m,), jnp.int32),
+            payload=jnp.zeros((m, cfg.payload_words), jnp.int32),
+        )
+
+
+class WorldState(NamedTuple):
+    """All state of one world (or, with a leading axis, of W worlds)."""
+
+    now: jnp.ndarray          # int32 µs
+    queue: EventQueue
+    rng: DevRng
+    alive: jnp.ndarray        # (N,) bool
+    gen: jnp.ndarray          # (N,) int32 — bumped on kill/restart
+    clog_node: jnp.ndarray    # (N,) bool
+    clog_link: jnp.ndarray    # (N, N) bool, [src, dst]
+    astate: Any               # actor pytree
+    active: jnp.ndarray       # bool — False ⇒ frozen
+    steps: jnp.ndarray        # int32
+    delivered: jnp.ndarray    # int32
+    dropped: jnp.ndarray      # int32
+    overflow: jnp.ndarray     # bool — event queue overflowed (diagnostic)
+    bug: jnp.ndarray          # bool — invariant violation observed
+    bug_time: jnp.ndarray     # int32 µs of first bug, INF_TIME if none
+
+
+def tree_select(pred, a, b):
+    """Per-world select over two identical pytrees (pred is a scalar bool)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class DeviceEngine:
+    """Compiles (actor, config) into jit-ready batched simulation functions.
+
+    Usage::
+
+        eng = DeviceEngine(RaftActor(rcfg), EngineConfig(n_nodes=3))
+        state = eng.init(np.arange(10_000))          # one world per seed
+        state = eng.run(state, max_steps=5_000)       # jitted while_loop
+        out = eng.observe(state)                      # host-side dict
+    """
+
+    def __init__(self, actor, cfg: EngineConfig):
+        self.actor = actor
+        self.cfg = cfg
+        self._step_one = self._build_step()
+        self.step = jax.jit(jax.vmap(self._step_one))
+        self._run_steps = jax.jit(self._run_steps_impl, static_argnums=1)
+        self._run = jax.jit(self._run_impl, static_argnums=1)
+
+    # ------------------------------------------------------------------
+    # Initialization
+    # ------------------------------------------------------------------
+    def init(self, seeds, faults: Optional[np.ndarray] = None) -> WorldState:
+        """Build W worlds from a vector of u64 seeds.
+
+        ``faults``: optional int32 array of fault-schedule rows
+        ``[time_us, op, a, b]``, shape (F, 4) (same schedule every world) or
+        (W, F, 4) (per-world schedules). Rows with time < 0 are disabled —
+        use that to give worlds ragged schedules under one static F.
+        """
+        seeds = np.asarray(seeds, dtype=np.uint64)
+        if seeds.ndim != 1:
+            raise ValueError("seeds must be a 1-D vector (one world per seed)")
+        w = seeds.shape[0]
+        lo = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (seeds >> np.uint64(32)).astype(np.uint32)
+        if faults is None:
+            faults = np.zeros((w, 0, 4), np.int32)
+        else:
+            faults = np.asarray(faults, np.int32)
+            if faults.ndim == 2:
+                faults = np.broadcast_to(faults, (w,) + faults.shape)
+        n_faults = faults.shape[1]
+
+        def init_one(seed_lo, seed_hi, fault_rows):
+            cfg = self.cfg
+            rng = make_rng(seed_lo, seed_hi, STREAM_DEVICE)
+            q = empty_queue(cfg.queue_cap, cfg.payload_words)
+            astate, events, rng = self.actor.init(cfg, rng)
+            overflow = jnp.asarray(False)
+            for ev in events:
+                q, ok = push(q, ev)
+                overflow = overflow | ~ok
+            for f in range(n_faults):  # static unroll
+                row = fault_rows[f]
+                fev = Event(time=row[0], kind=row[1], flags=jnp.int32(FLAG_FAULT),
+                            src=row[2], dst=row[3], gen=jnp.int32(0),
+                            payload=jnp.zeros((cfg.payload_words,), jnp.int32))
+                q, ok = push(q, fev, enable=row[0] >= 0)
+                overflow = overflow | ~ok
+            n = cfg.n_nodes
+            return WorldState(
+                now=jnp.int32(0),
+                queue=q,
+                rng=rng,
+                alive=jnp.ones((n,), bool),
+                gen=jnp.zeros((n,), jnp.int32),
+                clog_node=jnp.zeros((n,), bool),
+                clog_link=jnp.zeros((n, n), bool),
+                astate=astate,
+                active=jnp.asarray(True),
+                steps=jnp.int32(0),
+                delivered=jnp.int32(0),
+                dropped=jnp.int32(0),
+                overflow=overflow,
+                bug=jnp.asarray(False),
+                bug_time=INF_TIME,
+            )
+
+        return jax.jit(jax.vmap(init_one))(jnp.asarray(lo), jnp.asarray(hi),
+                                           jnp.asarray(faults))
+
+    # ------------------------------------------------------------------
+    # The per-world step
+    # ------------------------------------------------------------------
+    def _build_step(self) -> Callable[[WorldState], WorldState]:
+        cfg = self.cfg
+        actor = self.actor
+
+        def apply_fault(ws: WorldState, ev: Event) -> Tuple[WorldState, Outbox]:
+            op, a, b = ev.kind, ev.src, ev.dst
+            is_kill = op == FAULT_KILL
+            is_restart = op == FAULT_RESTART
+            alive = ws.alive.at[a].set(
+                jnp.where(is_kill, False, jnp.where(is_restart, True, ws.alive[a])))
+            gen = ws.gen.at[a].add((is_kill | is_restart).astype(jnp.int32))
+            clog_node = ws.clog_node.at[a].set(jnp.where(
+                op == FAULT_CLOG_NODE, True,
+                jnp.where(op == FAULT_UNCLOG_NODE, False, ws.clog_node[a])))
+            clog_link = ws.clog_link.at[a, b].set(jnp.where(
+                op == FAULT_CLOG_LINK, True,
+                jnp.where(op == FAULT_UNCLOG_LINK, False, ws.clog_link[a, b])))
+            astate_r, ob_r, rng_r = actor.on_restart(cfg, ws.astate, a, ws.now, ws.rng)
+            astate = tree_select(is_restart, astate_r, ws.astate)
+            rng = tree_select(is_restart, rng_r, ws.rng)
+            ob = tree_select(is_restart, ob_r, Outbox.empty(cfg))
+            return ws._replace(alive=alive, gen=gen, clog_node=clog_node,
+                               clog_link=clog_link, astate=astate, rng=rng), ob
+
+        def push_outbox(ws: WorldState, src, ob: Outbox) -> WorldState:
+            q, rng, overflow = ws.queue, ws.rng, ws.overflow
+            loss = jnp.float32(cfg.loss_rate)
+            for m in range(cfg.m):  # static unroll
+                # Two draws per slot regardless of validity: the draw count
+                # per step is static, so RNG counters depend only on step
+                # index — replayable and backend-independent.
+                lat, rng = uniform_u32(rng, cfg.latency_min_us, cfg.latency_max_us)
+                u, rng = uniform_f32(rng)
+                dst = jnp.clip(ob.dst[m], 0, cfg.n_nodes - 1)
+                clogged = ws.clog_node[src] | ws.clog_node[dst] | ws.clog_link[src, dst]
+                dropped = (~ob.is_timer[m]) & (clogged | (u < loss))
+                t = ws.now + jnp.where(ob.is_timer[m], ob.delay_us[m], lat)
+                ev = Event(
+                    time=t, kind=ob.kind[m],
+                    flags=jnp.where(ob.is_timer[m], FLAG_TIMER, 0).astype(jnp.int32),
+                    src=jnp.asarray(src, jnp.int32), dst=dst, gen=ws.gen[dst],
+                    payload=ob.payload[m],
+                )
+                q, ok = push(q, ev, enable=ob.valid[m] & ~dropped)
+                overflow = overflow | ~ok
+            return ws._replace(queue=q, rng=rng, overflow=overflow)
+
+        def step(ws: WorldState) -> WorldState:
+            q, ev, found = pop(ws.queue)
+            now = jnp.where(found, jnp.maximum(ws.now, ev.time), ws.now)
+            in_time = now < jnp.int32(cfg.t_limit_us)
+            ws1 = ws._replace(queue=q, now=now, steps=ws.steps + 1)
+
+            dst = jnp.clip(ev.dst, 0, cfg.n_nodes - 1)
+            is_fault = (ev.flags & FLAG_FAULT) != 0
+            is_timer = (ev.flags & FLAG_TIMER) != 0
+            stale = is_timer & (ev.gen != ws1.gen[dst])
+            dead = ~ws1.alive[dst]
+            deliver = found & in_time & ~is_fault & ~stale & ~dead
+            do_fault = found & in_time & is_fault
+
+            fault_ws, fault_ob = apply_fault(ws1, ev)
+            astate2, act_ob, rng2, hbug = actor.handle(cfg, ws1.astate, ev, now, ws1.rng)
+            act_ws = ws1._replace(astate=astate2, rng=rng2)
+
+            ws2 = tree_select(do_fault, fault_ws,
+                              tree_select(deliver, act_ws, ws1))
+            ob = tree_select(do_fault, fault_ob,
+                             tree_select(deliver, act_ob, Outbox.empty(cfg)))
+            src = jnp.where(do_fault, jnp.clip(ev.src, 0, cfg.n_nodes - 1), dst)
+            ws3 = push_outbox(ws2, src, ob)
+
+            bug_now = (deliver & hbug) | actor.invariant(cfg, ws3.astate)
+            bug = ws3.bug | bug_now
+            bug_time = jnp.where(bug & ~ws3.bug, now, ws3.bug_time)
+            active = found & in_time & ~(cfg.stop_on_bug & bug)
+            ws4 = ws3._replace(
+                bug=bug, bug_time=bug_time, active=active,
+                delivered=ws3.delivered + deliver.astype(jnp.int32),
+                dropped=ws3.dropped
+                + (found & in_time & ~deliver & ~do_fault).astype(jnp.int32),
+            )
+            # Frozen worlds pass through untouched.
+            return tree_select(ws.active, ws4, ws)
+
+        return step
+
+    # ------------------------------------------------------------------
+    # Batched run loops
+    # ------------------------------------------------------------------
+    def _run_steps_impl(self, state: WorldState, k: int) -> WorldState:
+        batched = jax.vmap(self._step_one)
+
+        def body(s, _):
+            return batched(s), None
+
+        state, _ = jax.lax.scan(body, state, None, length=k)
+        return state
+
+    def run_steps(self, state: WorldState, k: int) -> WorldState:
+        """Advance every world by exactly ``k`` masked steps (fixed cost)."""
+        return self._run_steps(state, k)
+
+    def _run_impl(self, state: WorldState, max_steps: int) -> WorldState:
+        batched = jax.vmap(self._step_one)
+
+        def cond(carry):
+            s, i = carry
+            return jnp.any(s.active) & (i < max_steps)
+
+        def body(carry):
+            s, i = carry
+            return batched(s), i + 1
+
+        state, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+        return state
+
+    def run(self, state: WorldState, max_steps: int = 100_000) -> WorldState:
+        """Step until every world is inactive (or ``max_steps``)."""
+        return self._run(state, max_steps)
+
+    # ------------------------------------------------------------------
+    # Observation
+    # ------------------------------------------------------------------
+    def observe(self, state: WorldState) -> Dict[str, np.ndarray]:
+        """Pull engine metrics (plus the actor's) to host as numpy arrays."""
+        out = {
+            "now_us": state.now,
+            "active": state.active,
+            "steps": state.steps,
+            "delivered": state.delivered,
+            "dropped": state.dropped,
+            "overflow": state.overflow,
+            "bug": state.bug,
+            "bug_time_us": state.bug_time,
+            "queue_depth": jax.vmap(
+                lambda q: jnp.sum(q.valid.astype(jnp.int32)))(state.queue),
+        }
+        out.update(self.actor.observe(self.cfg, state.astate))
+        return {k: np.asarray(v) for k, v in out.items()}

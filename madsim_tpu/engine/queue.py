@@ -1,0 +1,137 @@
+"""Fixed-capacity masked event queue (per world; vmapped over the seed axis).
+
+The device analog of the host timer wheel + NetSim delivery queue
+(`madsim/src/sim/time/mod.rs:159-214`, `net/mod.rs:173-197`): every pending
+future occurrence in a world — timer expiry, message delivery, fault
+injection — is one slot in a flat array. ``pop`` is a masked argmin over the
+time lane (a single vectorized reduction, which is exactly the shape TPUs
+like); ``push`` scatters into the first free slot. No pointer heap: priority
+order is recomputed per pop, which for capacities ~64-256 is cheaper on TPU
+than maintaining heap invariants with data-dependent control flow.
+
+Tie-break: equal deadlines pop in *slot order*, and freed slots are reused
+lowest-first, so the order is deterministic but not FIFO — the host engine
+breaks ties by insertion sequence instead. Schedules are engine-specific;
+determinism-per-seed is the contract (see engine/__init__ docstring).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+INF_TIME = jnp.int32(2**31 - 1)
+
+# Event flag bits.
+FLAG_TIMER = 1  # gen-checked against the destination node's generation
+FLAG_FAULT = 2  # engine-handled fault-injection event (kind = fault op)
+
+
+class Event(NamedTuple):
+    """One scheduled occurrence. All fields int32; payload is (P,) int32."""
+
+    time: jnp.ndarray
+    kind: jnp.ndarray
+    flags: jnp.ndarray
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    gen: jnp.ndarray
+    payload: jnp.ndarray
+
+    @staticmethod
+    def make(time, kind, payload_words: int, flags=0, src=0, dst=0, gen=0,
+             payload=()) -> "Event":
+        """Build a concrete event, zero-padding the payload to P words."""
+        pad = list(payload) + [0] * (payload_words - len(payload))
+        return Event(
+            time=jnp.asarray(time, jnp.int32),
+            kind=jnp.asarray(kind, jnp.int32),
+            flags=jnp.asarray(flags, jnp.int32),
+            src=jnp.asarray(src, jnp.int32),
+            dst=jnp.asarray(dst, jnp.int32),
+            gen=jnp.asarray(gen, jnp.int32),
+            payload=jnp.asarray(pad, jnp.int32),
+        )
+
+
+class EventQueue(NamedTuple):
+    """Struct-of-arrays event store: scalars are (Q,), payload is (Q, P)."""
+
+    time: jnp.ndarray
+    kind: jnp.ndarray
+    flags: jnp.ndarray
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    gen: jnp.ndarray
+    payload: jnp.ndarray
+    valid: jnp.ndarray  # (Q,) bool
+
+
+def empty_queue(capacity: int, payload_words: int) -> EventQueue:
+    z = jnp.zeros((capacity,), jnp.int32)
+    return EventQueue(
+        time=jnp.full((capacity,), INF_TIME, jnp.int32),
+        kind=z, flags=z, src=z, dst=z, gen=z,
+        payload=jnp.zeros((capacity, payload_words), jnp.int32),
+        valid=jnp.zeros((capacity,), bool),
+    )
+
+
+def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray]:
+    """Insert ``ev`` into the first free slot. Returns (queue, ok).
+
+    ``enable`` masks the push (False ⇒ no-op, ok=True) so callers can keep a
+    single static code path for conditional sends. ok=False ⇒ overflow.
+    """
+    enable = jnp.asarray(enable, bool)
+    # First free slot: argmin over valid (False < True).
+    slot = jnp.argmin(q.valid)
+    free = ~q.valid[slot]
+    do = enable & free
+    ok = ~enable | free
+
+    def put(lane, value):
+        return lane.at[slot].set(jnp.where(do, value, lane[slot]))
+
+    q = EventQueue(
+        time=put(q.time, ev.time),
+        kind=put(q.kind, ev.kind),
+        flags=put(q.flags, ev.flags),
+        src=put(q.src, ev.src),
+        dst=put(q.dst, ev.dst),
+        gen=put(q.gen, ev.gen),
+        payload=q.payload.at[slot].set(
+            jnp.where(do, ev.payload, q.payload[slot])),
+        valid=put(q.valid, jnp.asarray(True)),
+    )
+    return q, ok
+
+
+def pop(q: EventQueue) -> Tuple[EventQueue, Event, jnp.ndarray]:
+    """Remove and return the earliest valid event. Returns (queue, ev, found).
+
+    When the queue is empty, ``found`` is False and the event contents are
+    arbitrary (time INF_TIME) — callers must mask on ``found``.
+    """
+    keyed = jnp.where(q.valid, q.time, INF_TIME)
+    slot = jnp.argmin(keyed)
+    found = q.valid[slot]
+    ev = Event(
+        time=keyed[slot],
+        kind=q.kind[slot],
+        flags=q.flags[slot],
+        src=q.src[slot],
+        dst=q.dst[slot],
+        gen=q.gen[slot],
+        payload=q.payload[slot],
+    )
+    q = q._replace(
+        valid=q.valid.at[slot].set(jnp.where(found, False, q.valid[slot])),
+        time=q.time.at[slot].set(jnp.where(found, INF_TIME, q.time[slot])),
+    )
+    return q, ev, found
+
+
+def next_deadline(q: EventQueue) -> jnp.ndarray:
+    """Earliest pending time, or INF_TIME when empty."""
+    return jnp.min(jnp.where(q.valid, q.time, INF_TIME))

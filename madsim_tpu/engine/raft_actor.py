@@ -1,0 +1,512 @@
+"""Pure-JAX Raft actor for the batched device engine.
+
+The device-side MadRaft equivalent (see `madsim_tpu/models/raft.py` for the
+host-engine version): leader election + single-entry-pipelined log
+replication over the engine's simulated network, with on-device invariant
+checking (election safety, log matching) producing the per-world *bug flag*
+that BASELINE.json's time-to-first-bug metric measures. All state is
+fixed-shape int32 arrays, all control flow is ``lax`` primitives, so the
+whole cluster steps inside one XLA program and vmaps over thousands of
+worlds.
+
+Fault tolerance matches the host model: node kill drops timers via the
+engine's generation counters; restart preserves persistent state
+(term/voted_for/log — what ``RaftServer._persist`` writes to the simulated
+disk) and resets volatile state, mirroring crash-recovery semantics.
+
+The ``buggy_double_vote`` switch deliberately breaks the "one vote per term"
+rule so seed sweeps have a real bug to find — the analog of the interleaving
+bugs madsim exists to catch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .core import EngineConfig, Outbox
+from .queue import Event, FLAG_TIMER, INF_TIME
+from .rng import DevRng, uniform_u32
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# Event kinds.
+K_ELECTION = 0      # timer [epoch]
+K_HEARTBEAT = 1     # timer [term]
+K_REQVOTE = 2       # msg [term, candidate, last_idx, last_term]
+K_VOTEREPLY = 3     # msg [term, granted, voter]
+K_APPEND = 4        # msg [term, leader, prev_idx, prev_term, n, e_term, e_cmd, l_commit]
+K_APPENDREPLY = 5   # msg [term, success, match_idx, follower]
+K_PROPOSE = 6       # scheduled client proposal [cmd]
+NUM_KINDS = 7
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftDeviceConfig:
+    """Static Raft parameters (host analog: models/raft.py RaftOptions)."""
+
+    n: int = 3
+    log_cap: int = 16
+    elect_min_us: int = 150_000
+    elect_max_us: int = 300_000
+    heartbeat_us: int = 50_000
+    # Client proposals broadcast to every node at fixed virtual times; only
+    # the current leader appends. cmd of proposal i is i+1.
+    n_proposals: int = 0
+    propose_start_us: int = 800_000
+    propose_interval_us: int = 100_000
+    # Injected bug: grant votes ignoring the one-vote-per-term rule.
+    buggy_double_vote: bool = False
+
+
+class RaftState(NamedTuple):
+    term: jnp.ndarray        # (N,) i32
+    voted_for: jnp.ndarray   # (N,) i32, -1 = none
+    role: jnp.ndarray        # (N,) i32
+    votes: jnp.ndarray       # (N,) i32 bitmask of granted votes
+    commit: jnp.ndarray      # (N,) i32
+    log_len: jnp.ndarray     # (N,) i32
+    log_term: jnp.ndarray    # (N, L) i32
+    log_cmd: jnp.ndarray     # (N, L) i32
+    next_idx: jnp.ndarray    # (N, N) i32 [leader, peer]
+    match_idx: jnp.ndarray   # (N, N) i32 [leader, peer]
+    elect_epoch: jnp.ndarray  # (N,) i32 — invalidates stale election timers
+    first_leader_time: jnp.ndarray  # i32 µs, INF if never
+    elections_won: jnp.ndarray      # i32
+
+
+class RaftActor:
+    """Actor implementing the DeviceEngine protocol for a Raft cluster."""
+
+    num_kinds = NUM_KINDS
+
+    def __init__(self, rcfg: RaftDeviceConfig):
+        self.rcfg = rcfg
+
+    # ------------------------------------------------------------------
+    # Protocol: init
+    # ------------------------------------------------------------------
+    def init(self, cfg: EngineConfig, rng: DevRng
+             ) -> Tuple[RaftState, List[Event], DevRng]:
+        r = self.rcfg
+        n, L = r.n, r.log_cap
+        if cfg.n_nodes != n:
+            raise ValueError("EngineConfig.n_nodes must match RaftDeviceConfig.n")
+        if cfg.m != n + 1:
+            raise ValueError("RaftActor needs outbox_cap == n + 1 "
+                             "(n-1 peer messages + 1 timer per handler)")
+        if cfg.payload_words < 8:
+            raise ValueError("RaftActor needs payload_words >= 8")
+        s = RaftState(
+            term=jnp.zeros((n,), jnp.int32),
+            voted_for=jnp.full((n,), -1, jnp.int32),
+            role=jnp.zeros((n,), jnp.int32),
+            votes=jnp.zeros((n,), jnp.int32),
+            commit=jnp.zeros((n,), jnp.int32),
+            log_len=jnp.zeros((n,), jnp.int32),
+            log_term=jnp.zeros((n, L), jnp.int32),
+            log_cmd=jnp.zeros((n, L), jnp.int32),
+            next_idx=jnp.ones((n, n), jnp.int32),
+            match_idx=jnp.zeros((n, n), jnp.int32),
+            elect_epoch=jnp.zeros((n,), jnp.int32),
+            first_leader_time=INF_TIME,
+            elections_won=jnp.int32(0),
+        )
+        events: List[Event] = []
+        for i in range(n):
+            delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
+            events.append(Event.make(
+                time=delay, kind=K_ELECTION, payload_words=cfg.payload_words,
+                flags=FLAG_TIMER, src=i, dst=i, payload=[0]))
+        for p in range(r.n_proposals):
+            t = r.propose_start_us + p * r.propose_interval_us
+            for i in range(n):
+                events.append(Event.make(
+                    time=t, kind=K_PROPOSE, payload_words=cfg.payload_words,
+                    src=i, dst=i, payload=[p + 1]))
+        return s, events, rng
+
+    # ------------------------------------------------------------------
+    # Protocol: restart hook (persistent state survives; volatile resets)
+    # ------------------------------------------------------------------
+    def on_restart(self, cfg: EngineConfig, s: RaftState, node, now, rng: DevRng
+                   ) -> Tuple[RaftState, Outbox, DevRng]:
+        r = self.rcfg
+        n = r.n
+        me = jnp.clip(node, 0, n - 1)
+        epoch2 = s.elect_epoch[me] + 1
+        s = s._replace(
+            role=s.role.at[me].set(FOLLOWER),
+            votes=s.votes.at[me].set(0),
+            commit=s.commit.at[me].set(0),
+            next_idx=s.next_idx.at[me].set(jnp.ones((n,), jnp.int32)),
+            match_idx=s.match_idx.at[me].set(jnp.zeros((n,), jnp.int32)),
+            elect_epoch=s.elect_epoch.at[me].set(epoch2),
+        )
+        delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=jnp.zeros((n,), bool),
+            msg_kind=jnp.zeros((n,), jnp.int32),
+            msg_payload=jnp.zeros((n, cfg.payload_words), jnp.int32),
+            timer_valid=jnp.asarray(True), timer_kind=jnp.int32(K_ELECTION),
+            timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [epoch2]),
+        )
+        return s, ob, rng
+
+    # ------------------------------------------------------------------
+    # Protocol: event dispatch
+    # ------------------------------------------------------------------
+    def handle(self, cfg: EngineConfig, s: RaftState, ev: Event, now, rng: DevRng
+               ) -> Tuple[RaftState, Outbox, DevRng, jnp.ndarray]:
+        branches = [
+            self._on_election, self._on_heartbeat, self._on_reqvote,
+            self._on_votereply, self._on_append, self._on_appendreply,
+            self._on_propose,
+        ]
+
+        def mk(fn):
+            return lambda a, e, t, r: fn(cfg, a, e, t, r)
+
+        kind = jnp.clip(ev.kind, 0, NUM_KINDS - 1)
+        return jax.lax.switch(kind, [mk(f) for f in branches], s, ev, now, rng)
+
+    # ------------------------------------------------------------------
+    # Protocol: invariants (the bug flag)
+    # ------------------------------------------------------------------
+    def invariant(self, cfg: EngineConfig, s: RaftState) -> jnp.ndarray:
+        n = self.rcfg.n
+        # Election safety: at most one leader per term (models/raft.py
+        # InvariantChecker.on_become_leader).
+        is_leader = s.role == LEADER
+        same_term = s.term[:, None] == s.term[None, :]
+        pair = is_leader[:, None] & is_leader[None, :] & same_term
+        off_diag = ~jnp.eye(n, dtype=bool)
+        two_leaders = jnp.any(pair & off_diag)
+        # Log matching on committed prefixes (on_commit analog).
+        L = self.rcfg.log_cap
+        k = jnp.arange(L)
+        lim = jnp.minimum(s.commit[:, None], s.commit[None, :])  # (N, N)
+        mask = k[None, None, :] < lim[:, :, None]
+        diff = (s.log_term[:, None, :] != s.log_term[None, :, :]) | \
+               (s.log_cmd[:, None, :] != s.log_cmd[None, :, :])
+        log_mismatch = jnp.any(mask & diff)
+        return two_leaders | log_mismatch
+
+    # ------------------------------------------------------------------
+    # Protocol: observation
+    # ------------------------------------------------------------------
+    def observe(self, cfg: EngineConfig, s: RaftState) -> dict:
+        return {
+            "leader_elected": s.first_leader_time < INF_TIME,
+            "first_leader_time_us": s.first_leader_time,
+            "elections_won": s.elections_won,
+            "max_commit": jnp.max(s.commit, axis=-1),
+            "max_term": jnp.max(s.term, axis=-1),
+        }
+
+    # ==================================================================
+    # Handlers. Each returns (state, outbox, rng, bug).
+    # ==================================================================
+    def _on_election(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n = r.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        epoch_ok = ev.payload[0] == s.elect_epoch[me]
+        fire = epoch_ok & (s.role[me] != LEADER)
+        term2 = s.term[me] + 1
+        s2 = s._replace(
+            term=s.term.at[me].set(jnp.where(fire, term2, s.term[me])),
+            voted_for=s.voted_for.at[me].set(jnp.where(fire, me, s.voted_for[me])),
+            role=s.role.at[me].set(jnp.where(fire, CANDIDATE, s.role[me])),
+            votes=s.votes.at[me].set(jnp.where(fire, 1 << me, s.votes[me])),
+        )
+        last_idx = s.log_len[me]
+        last_term = self._log_term_at(s, me, last_idx)
+        payload = self._bcast_payload(cfg, [term2, me, last_idx, last_term])
+        peers = jnp.arange(n) != me
+        delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=fire & peers,
+            msg_kind=jnp.full((n,), K_REQVOTE, jnp.int32),
+            msg_payload=payload,
+            timer_valid=epoch_ok,  # keep exactly one live election timer
+            timer_kind=jnp.int32(K_ELECTION), timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [s.elect_epoch[me]]),
+        )
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_heartbeat(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n = r.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        live = (s.role[me] == LEADER) & (s.term[me] == ev.payload[0])
+        msg_valid, msg_payload = self._append_msgs(cfg, s, me)
+        ob = self._outbox(
+            cfg,
+            msg_valid=live & msg_valid,
+            msg_kind=jnp.full((n,), K_APPEND, jnp.int32),
+            msg_payload=msg_payload,
+            timer_valid=live, timer_kind=jnp.int32(K_HEARTBEAT), timer_dst=me,
+            timer_delay=jnp.int32(r.heartbeat_us),
+            timer_payload=self._pad(cfg, [ev.payload[0]]),
+        )
+        return s, ob, rng, jnp.asarray(False)
+
+    def _on_reqvote(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n = r.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        t, cand = ev.payload[0], jnp.clip(ev.payload[1], 0, n - 1)
+        last_idx, last_term = ev.payload[2], ev.payload[3]
+        s = self._maybe_step_down(s, me, t)
+        reject = t < s.term[me]
+        my_last = s.log_len[me]
+        my_last_term = self._log_term_at(s, me, my_last)
+        up_to_date = (last_term > my_last_term) | \
+                     ((last_term == my_last_term) & (last_idx >= my_last))
+        if r.buggy_double_vote:
+            can_vote = jnp.asarray(True)
+        else:
+            can_vote = (s.voted_for[me] == -1) | (s.voted_for[me] == cand)
+        grant = ~reject & up_to_date & can_vote
+        epoch2 = s.elect_epoch[me] + 1
+        s2 = s._replace(
+            voted_for=s.voted_for.at[me].set(
+                jnp.where(grant, cand, s.voted_for[me])),
+            elect_epoch=s.elect_epoch.at[me].set(
+                jnp.where(grant, epoch2, s.elect_epoch[me])),
+        )
+        payload = self._bcast_payload(cfg, [s.term[me], grant.astype(jnp.int32), me, 0])
+        delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=jnp.arange(n) == cand,
+            msg_kind=jnp.full((n,), K_VOTEREPLY, jnp.int32),
+            msg_payload=payload,
+            timer_valid=grant,  # granting resets the election timer
+            timer_kind=jnp.int32(K_ELECTION), timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [epoch2]),
+        )
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_votereply(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n = r.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        t, granted, voter = ev.payload[0], ev.payload[1], jnp.clip(ev.payload[2], 0, n - 1)
+        s = self._maybe_step_down(s, me, t)
+        counted = (granted != 0) & (s.role[me] == CANDIDATE) & (t == s.term[me])
+        votes2 = jnp.where(counted, s.votes[me] | (1 << voter), s.votes[me])
+        win = counted & (jax.lax.population_count(votes2) > n // 2)
+        llen = s.log_len[me]
+        s2 = s._replace(
+            votes=s.votes.at[me].set(votes2),
+            role=s.role.at[me].set(jnp.where(win, LEADER, s.role[me])),
+            next_idx=s.next_idx.at[me].set(jnp.where(
+                win, jnp.full((n,), llen + 1, jnp.int32), s.next_idx[me])),
+            match_idx=s.match_idx.at[me].set(jnp.where(
+                win,
+                jnp.zeros((n,), jnp.int32).at[me].set(llen),
+                s.match_idx[me])),
+            first_leader_time=jnp.where(
+                win, jnp.minimum(s.first_leader_time, jnp.asarray(now, jnp.int32)),
+                s.first_leader_time),
+            elections_won=s.elections_won + win.astype(jnp.int32),
+        )
+        msg_valid, msg_payload = self._append_msgs(cfg, s2, me)
+        ob = self._outbox(
+            cfg,
+            msg_valid=win & msg_valid,
+            msg_kind=jnp.full((n,), K_APPEND, jnp.int32),
+            msg_payload=msg_payload,
+            timer_valid=win, timer_kind=jnp.int32(K_HEARTBEAT), timer_dst=me,
+            timer_delay=jnp.int32(r.heartbeat_us),
+            timer_payload=self._pad(cfg, [s2.term[me]]),
+        )
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_append(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n, L = r.n, r.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        t, leader = ev.payload[0], jnp.clip(ev.payload[1], 0, n - 1)
+        prev_idx, prev_term = ev.payload[2], ev.payload[3]
+        n_ent, e_term, e_cmd, l_commit = (ev.payload[4], ev.payload[5],
+                                          ev.payload[6], ev.payload[7])
+        s = self._maybe_step_down(s, me, t, follower_on_equal=True)
+        reject = t < s.term[me]
+        prev_ok = (prev_idx <= s.log_len[me]) & \
+                  (self._log_term_at(s, me, prev_idx) == prev_term)
+        success = ~reject & prev_ok
+        idx = prev_idx + 1
+        has_room = idx <= L
+        write = success & (n_ent > 0) & has_room
+        pos = jnp.clip(idx - 1, 0, L - 1)
+        same = (idx <= s.log_len[me]) & \
+               (s.log_term[me, pos] == e_term) & (s.log_cmd[me, pos] == e_cmd)
+        new_len = jnp.where(write, jnp.where(same, s.log_len[me], idx),
+                            s.log_len[me])
+        log_term2 = s.log_term.at[me, pos].set(
+            jnp.where(write, e_term, s.log_term[me, pos]))
+        log_cmd2 = s.log_cmd.at[me, pos].set(
+            jnp.where(write, e_cmd, s.log_cmd[me, pos]))
+        match = jnp.where(write, idx, jnp.where(success, prev_idx, 0))
+        commit2 = jnp.where(success,
+                            jnp.maximum(s.commit[me],
+                                        jnp.minimum(l_commit, new_len)),
+                            s.commit[me])
+        epoch2 = s.elect_epoch[me] + 1
+        s2 = s._replace(
+            log_term=log_term2, log_cmd=log_cmd2,
+            log_len=s.log_len.at[me].set(new_len),
+            commit=s.commit.at[me].set(commit2),
+            elect_epoch=s.elect_epoch.at[me].set(
+                jnp.where(reject, s.elect_epoch[me], epoch2)),
+        )
+        payload = self._bcast_payload(
+            cfg, [s.term[me], success.astype(jnp.int32), match, me])
+        delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=jnp.arange(n) == leader,
+            msg_kind=jnp.full((n,), K_APPENDREPLY, jnp.int32),
+            msg_payload=payload,
+            timer_valid=~reject,  # a valid AppendEntries is a heartbeat
+            timer_kind=jnp.int32(K_ELECTION), timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [epoch2]),
+        )
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_appendreply(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n, L = r.n, r.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        t, success = ev.payload[0], ev.payload[1]
+        match, follower = ev.payload[2], jnp.clip(ev.payload[3], 0, n - 1)
+        s = self._maybe_step_down(s, me, t)
+        live = (s.role[me] == LEADER) & (t == s.term[me])
+        ok = live & (success != 0)
+        fail = live & (success == 0)
+        match2 = jnp.maximum(s.match_idx[me, follower], match)
+        s2 = s._replace(
+            match_idx=s.match_idx.at[me, follower].set(
+                jnp.where(ok, match2, s.match_idx[me, follower])),
+            next_idx=s.next_idx.at[me, follower].set(jnp.where(
+                ok, match2 + 1,
+                jnp.where(fail,
+                          jnp.maximum(1, s.next_idx[me, follower] - 1),
+                          s.next_idx[me, follower]))),
+        )
+        # Advance commit: the largest n with majority match and current-term
+        # entry (models/raft.py _advance_commit).
+        ns = jnp.arange(1, L + 1)
+        counts = jnp.sum(s2.match_idx[me][:, None] >= ns[None, :], axis=0)
+        okn = (ns <= s2.log_len[me]) & (counts > n // 2) & \
+              (s2.log_term[me] == s2.term[me])
+        best = jnp.max(jnp.where(okn, ns, 0))
+        commit2 = jnp.where(live, jnp.maximum(s2.commit[me], best), s2.commit[me])
+        s3 = s2._replace(commit=s2.commit.at[me].set(commit2))
+        return s3, Outbox.empty(cfg), rng, jnp.asarray(False)
+
+    def _on_propose(self, cfg, s: RaftState, ev: Event, now, rng):
+        r = self.rcfg
+        n, L = r.n, r.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        cmd = ev.payload[0]
+        accept = (s.role[me] == LEADER) & (s.log_len[me] < L)
+        pos = jnp.clip(s.log_len[me], 0, L - 1)
+        llen2 = s.log_len[me] + accept.astype(jnp.int32)
+        s2 = s._replace(
+            log_term=s.log_term.at[me, pos].set(
+                jnp.where(accept, s.term[me], s.log_term[me, pos])),
+            log_cmd=s.log_cmd.at[me, pos].set(
+                jnp.where(accept, cmd, s.log_cmd[me, pos])),
+            log_len=s.log_len.at[me].set(llen2),
+            match_idx=s.match_idx.at[me, me].set(
+                jnp.where(accept, llen2, s.match_idx[me, me])),
+        )
+        msg_valid, msg_payload = self._append_msgs(cfg, s2, me)
+        ob = self._outbox(
+            cfg,
+            msg_valid=accept & msg_valid,
+            msg_kind=jnp.full((n,), K_APPEND, jnp.int32),
+            msg_payload=msg_payload,
+            timer_valid=jnp.asarray(False), timer_kind=jnp.int32(0),
+            timer_dst=me, timer_delay=jnp.int32(0),
+            timer_payload=self._pad(cfg, []),
+        )
+        return s2, ob, rng, jnp.asarray(False)
+
+    # ==================================================================
+    # Helpers
+    # ==================================================================
+    def _maybe_step_down(self, s: RaftState, me, t, follower_on_equal=False):
+        """Adopt a higher term (→ follower, clear vote); optionally also
+        step down from CANDIDATE on an equal-term AppendEntries."""
+        higher = t > s.term[me]
+        demote = higher | (follower_on_equal & (t == s.term[me]) &
+                           (s.role[me] == CANDIDATE))
+        return s._replace(
+            term=s.term.at[me].set(jnp.where(higher, t, s.term[me])),
+            voted_for=s.voted_for.at[me].set(
+                jnp.where(higher, -1, s.voted_for[me])),
+            role=s.role.at[me].set(jnp.where(demote, FOLLOWER, s.role[me])),
+        )
+
+    def _log_term_at(self, s: RaftState, me, idx):
+        """Term of entry ``idx`` (1-based); 0 for idx == 0."""
+        L = self.rcfg.log_cap
+        pos = jnp.clip(idx - 1, 0, L - 1)
+        return jnp.where(idx <= 0, 0, s.log_term[me, pos])
+
+    def _append_msgs(self, cfg, s: RaftState, me):
+        """Per-peer AppendEntries payloads from the leader's next_idx row."""
+        r = self.rcfg
+        n, L = r.n, r.log_cap
+        nxt = jnp.clip(s.next_idx[me], 1, L + 1)      # (N,)
+        prev = nxt - 1
+        prev_pos = jnp.clip(prev - 1, 0, L - 1)
+        prev_term = jnp.where(prev <= 0, 0, s.log_term[me, prev_pos])
+        have = nxt <= s.log_len[me]                   # entry to ship?
+        pos = jnp.clip(nxt - 1, 0, L - 1)
+        e_term = jnp.where(have, s.log_term[me, pos], 0)
+        e_cmd = jnp.where(have, s.log_cmd[me, pos], 0)
+        term = jnp.full((n,), s.term[me], jnp.int32)
+        payload = jnp.stack([
+            term, jnp.full((n,), me, jnp.int32), prev, prev_term,
+            have.astype(jnp.int32), e_term, e_cmd,
+            jnp.full((n,), s.commit[me], jnp.int32),
+        ], axis=1)
+        pad = jnp.zeros((n, cfg.payload_words - 8), jnp.int32)
+        return jnp.arange(n) != me, jnp.concatenate([payload, pad], axis=1)
+
+    def _bcast_payload(self, cfg, words):
+        """(N, P) payload with the same words in every row."""
+        n = self.rcfg.n
+        row = self._pad(cfg, words)
+        return jnp.broadcast_to(row, (n, cfg.payload_words))
+
+    def _pad(self, cfg, words) -> jnp.ndarray:
+        vals = [jnp.asarray(wd, jnp.int32) for wd in words]
+        vals += [jnp.int32(0)] * (cfg.payload_words - len(words))
+        return jnp.stack(vals)
+
+    def _outbox(self, cfg, msg_valid, msg_kind, msg_payload, timer_valid,
+                timer_kind, timer_dst, timer_delay, timer_payload) -> Outbox:
+        """Assemble the (N peers + 1 timer) outbox layout."""
+        n = self.rcfg.n
+        app = lambda xs, x: jnp.concatenate(  # noqa: E731
+            [jnp.asarray(xs), jnp.asarray(x)[None]], axis=0)
+        return Outbox(
+            valid=app(msg_valid, timer_valid),
+            is_timer=app(jnp.zeros((n,), bool), jnp.asarray(True)),
+            kind=app(msg_kind, timer_kind),
+            dst=app(jnp.arange(n, dtype=jnp.int32), jnp.asarray(timer_dst, jnp.int32)),
+            delay_us=app(jnp.zeros((n,), jnp.int32), jnp.asarray(timer_delay, jnp.int32)),
+            payload=jnp.concatenate([msg_payload, timer_payload[None]], axis=0),
+        )

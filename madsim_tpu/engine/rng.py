@@ -1,0 +1,60 @@
+"""Per-world counter-based RNG for the device engine.
+
+Functional cursor over the Threefry stream in :mod:`madsim_tpu.ops.threefry`
+— the device-side sibling of the host engine's
+:class:`madsim_tpu.core.rng.GlobalRng`. Every draw is a pure function of
+``(seed, stream, counter)``; the cursor is carried through the step function
+as part of the world state, so batched runs are bit-reproducible from the
+seed vector alone (the property the reference gets from its global seeded
+SmallRng, `madsim/src/sim/rand.rs:50-108`).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.threefry import threefry2x32_jax
+
+
+class DevRng(NamedTuple):
+    """A named Threefry stream plus a draw counter (all uint32 scalars)."""
+
+    k0: jnp.ndarray
+    k1: jnp.ndarray
+    counter: jnp.ndarray
+
+
+def make_rng(seed_lo, seed_hi, stream: int) -> DevRng:
+    """Derive the per-(seed, stream) key; mirrors ``derive_stream_np``."""
+    k0, k1 = threefry2x32_jax(seed_lo, seed_hi,
+                              jnp.uint32(stream & 0xFFFFFFFF),
+                              jnp.uint32((stream >> 32) & 0xFFFFFFFF))
+    return DevRng(k0=k0, k1=k1, counter=jnp.uint32(0))
+
+
+def next_u32(rng: DevRng) -> Tuple[jnp.ndarray, DevRng]:
+    """One uint32 draw; advances the counter."""
+    x0, _ = threefry2x32_jax(rng.k0, rng.k1, rng.counter, jnp.uint32(0))
+    return x0, rng._replace(counter=rng.counter + jnp.uint32(1))
+
+
+def uniform_u32(rng: DevRng, low, high) -> Tuple[jnp.ndarray, DevRng]:
+    """Uniform integer in [low, high) as int32 (modulo method, like the host
+    GlobalRng.gen_range). ``high`` must be > ``low``."""
+    x, rng = next_u32(rng)
+    width = jnp.uint32(jnp.asarray(high, jnp.int32) - jnp.asarray(low, jnp.int32))
+    return jnp.asarray(low, jnp.int32) + (x % width).astype(jnp.int32), rng
+
+
+def uniform_f32(rng: DevRng) -> Tuple[jnp.ndarray, DevRng]:
+    """Uniform float32 in [0, 1) from the top 24 bits of one draw."""
+    x, rng = next_u32(rng)
+    return (x >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24), rng
+
+
+def bernoulli(rng: DevRng, p) -> Tuple[jnp.ndarray, DevRng]:
+    """Bernoulli(p) draw. Always consumes exactly one counter tick so control
+    flow never changes the stream (matches GlobalRng.gen_bool)."""
+    u, rng = uniform_f32(rng)
+    return u < jnp.asarray(p, jnp.float32), rng

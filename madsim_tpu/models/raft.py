@@ -417,9 +417,15 @@ class RaftCluster:
     # -- chaos --------------------------------------------------------------
     def kill(self, i: int) -> None:
         ms.Handle.current().kill(self.nodes[i])
+        # Drop the orphaned server object immediately: observers must not act
+        # on it between kill and the respawned init re-registering.
+        self.servers.pop(i, None)
 
     def restart(self, i: int) -> None:
         ms.Handle.current().restart(self.nodes[i])
+        # The replacement registers itself when the init task runs; until
+        # then no server for i must be visible to leader()/propose().
+        self.servers.pop(i, None)
 
     def partition(self, group_a: List[int], group_b: List[int]) -> None:
         from madsim_tpu.net import NetSim

@@ -1,0 +1,15 @@
+"""Seed-parallelism over TPU meshes.
+
+The reference's only multi-simulation parallelism is one OS thread per seed
+(`madsim/src/sim/runtime/builder.rs:118-136`, ``MADSIM_TEST_JOBS``). Here the
+world (seed) axis of the batched device engine is data-parallel state, so it
+shards across a `jax.sharding.Mesh`: each chip advances its shard of worlds
+with zero communication, and the only collectives are tiny reductions over
+the bug/active flags riding ICI (`any`-reduce to answer "did any seed find a
+bug?" without pulling per-world state to host). Multi-host sweeps extend the
+same mesh over DCN — the sharded world axis simply spans processes.
+"""
+from .mesh import seed_mesh, shard_worlds
+from .sweep import SweepResult, sharded_engine, sweep
+
+__all__ = ["seed_mesh", "shard_worlds", "sharded_engine", "sweep", "SweepResult"]

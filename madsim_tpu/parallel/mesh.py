@@ -1,0 +1,36 @@
+"""Mesh construction and world-state sharding helpers."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORLD_AXIS = "worlds"
+
+
+def seed_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over ``devices`` with the world axis as its only dim.
+
+    Seed-sweep state has no model axes to shard — worlds are independent —
+    so a flat mesh is the right topology; on a pod slice the axis simply
+    spans all chips (and all hosts under multi-process JAX).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (WORLD_AXIS,))
+
+
+def shard_worlds(state, mesh: Mesh):
+    """Place a batched WorldState so its leading axis is split over the mesh.
+
+    Every leaf of the engine state carries the world axis first, so a single
+    `PartitionSpec(WORLD_AXIS)` shards the entire pytree; XLA then runs the
+    vmapped step on each shard with no cross-chip traffic.
+    """
+    sharding = NamedSharding(mesh, P(WORLD_AXIS))
+    return jax.device_put(state, sharding)

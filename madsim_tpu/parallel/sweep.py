@@ -1,0 +1,119 @@
+"""Sharded multi-seed sweeps: the TPU replacement for MADSIM_TEST_JOBS.
+
+``sweep`` is the device-engine counterpart of the host test driver's seed
+loop (`madsim/src/sim/runtime/builder.rs:110-148` / madsim_tpu.testing):
+initialize one world per seed, shard the world axis over the mesh, advance
+all worlds in fixed-step chunks, and after each chunk reduce two tiny scalars
+over ICI — "any bug found?" and "how many worlds still active?" — so the host
+loop makes progress/early-exit decisions without ever pulling per-world state
+off device. Failing seeds (the repro banner of `runtime/mod.rs:192-199`)
+are gathered once, at the end.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..engine.core import DeviceEngine, EngineConfig, WorldState
+from .mesh import WORLD_AXIS, seed_mesh, shard_worlds
+
+
+def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
+    """Compile a chunk runner: state → (state, any_bug, n_active).
+
+    The body is `shard_map`'d so each device advances only its world shard
+    (no resharding possible); the two scalar outputs are psum/any reductions
+    over the mesh axis — the only cross-chip communication in a sweep.
+
+    Runners are cached per (mesh, chunk_steps) on the engine, so repeated
+    sweeps reuse the compiled program instead of paying a fresh XLA compile
+    for an identical closure.
+    """
+    cache = eng.__dict__.setdefault("_sharded_runner_cache", {})
+    key = (mesh, chunk_steps)
+    if key in cache:
+        return cache[key]
+    spec = P(WORLD_AXIS)
+
+    def chunk(state: WorldState):
+        state = eng._run_steps_impl(state, chunk_steps)
+        any_bug = jax.lax.psum(
+            jnp.any(state.bug).astype(jnp.int32), WORLD_AXIS) > 0
+        n_active = jax.lax.psum(
+            jnp.sum(state.active.astype(jnp.int32)), WORLD_AXIS)
+        return state, any_bug, n_active
+
+    runner = jax.jit(shard_map(
+        chunk, mesh=mesh, in_specs=(spec,),
+        out_specs=(spec, P(), P()), check_rep=False))
+    cache[key] = runner
+    return runner
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Outcome of a sharded seed sweep."""
+
+    seeds: np.ndarray            # the (unpadded) seed vector
+    bug: np.ndarray              # per-seed bug flag
+    observations: Dict[str, np.ndarray]  # engine + actor metrics, per seed
+    steps_run: int               # chunks * chunk_steps issued
+    n_devices: int
+
+    @property
+    def failing_seeds(self) -> List[int]:
+        return [int(s) for s in self.seeds[self.bug]]
+
+    def repro_banner(self) -> Optional[str]:
+        """The failing-seed reproduction hint (`runtime/mod.rs:192-199`)."""
+        if not self.failing_seeds:
+            return None
+        return ("note: run with environment variable "
+                f"MADSIM_TEST_SEED={self.failing_seeds[0]} to reproduce "
+                f"this failure ({len(self.failing_seeds)} failing seeds total)")
+
+
+def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = None,
+          mesh: Optional[Mesh] = None, chunk_steps: int = 512,
+          max_steps: int = 1_000_000, stop_on_first_bug: bool = False,
+          engine: Optional[DeviceEngine] = None) -> SweepResult:
+    """Run one simulation per seed, sharded over the mesh, to completion."""
+    eng = engine if engine is not None else DeviceEngine(actor, cfg)
+    mesh = mesh if mesh is not None else seed_mesh()
+    n_dev = mesh.devices.size
+    seeds = np.asarray(seeds, np.uint64)
+    n = seeds.shape[0]
+    # Pad the world axis to a multiple of the mesh (padded worlds are real
+    # simulations of dummy seeds; their results are sliced off below).
+    pad = (-n) % n_dev
+    seeds_p = np.concatenate([seeds, seeds[:1].repeat(pad)]) if pad else seeds
+    faults_p = faults
+    if faults is not None and pad:
+        faults_p = np.asarray(faults, np.int32)
+        if faults_p.ndim == 3:
+            faults_p = np.concatenate(
+                [faults_p, faults_p[:1].repeat(pad, axis=0)], axis=0)
+
+    state = shard_worlds(eng.init(seeds_p, faults=faults_p), mesh)
+    runner = sharded_engine(eng, mesh, chunk_steps)
+
+    steps = 0
+    while steps < max_steps:
+        state, any_bug, n_active = runner(state)
+        steps += chunk_steps
+        if int(n_active) == 0:
+            break
+        if stop_on_first_bug and bool(any_bug):
+            break
+
+    obs = eng.observe(state)
+    obs = {k: v[:n] for k, v in obs.items()}
+    return SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
+                       steps_run=steps, n_devices=n_dev)

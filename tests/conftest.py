@@ -3,6 +3,9 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on host CPU devices (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the JAX_PLATFORMS env var alone is not honored when an accelerator
+PJRT plugin is installed, so the platform is also pinned via jax.config.
 """
 import os
 
@@ -10,3 +13,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_platforms", "cpu")

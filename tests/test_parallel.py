@@ -1,0 +1,60 @@
+"""Tests for mesh sharding of seed sweeps (madsim_tpu/parallel)."""
+import jax
+import numpy as np
+
+from madsim_tpu.engine import DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig
+from madsim_tpu.parallel import seed_mesh, shard_worlds, sweep
+
+RCFG = RaftDeviceConfig(n=3, n_proposals=1, buggy_double_vote=True)
+ECFG = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000)
+
+
+def test_mesh_uses_all_devices():
+    mesh = seed_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.devices.size == 8  # conftest forces an 8-device CPU mesh
+
+
+def test_sharded_state_placement():
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    mesh = seed_mesh()
+    state = shard_worlds(eng.init(np.arange(16)), mesh)
+    shard_devs = {s.device for s in state.now.addressable_shards}
+    assert len(shard_devs) == 8
+
+
+def test_sharded_sweep_matches_single_device():
+    seeds = np.arange(50)  # not a multiple of 8: exercises padding
+    r8 = sweep(RaftActor(RCFG), ECFG, seeds, mesh=seed_mesh(), chunk_steps=200)
+    r1 = sweep(RaftActor(RCFG), ECFG, seeds, mesh=seed_mesh(n_devices=1),
+               chunk_steps=200)
+    assert np.array_equal(r8.bug, r1.bug)
+    for k in r8.observations:
+        assert np.array_equal(r8.observations[k], r1.observations[k]), k
+    assert r8.n_devices == 8 and r1.n_devices == 1
+
+
+def test_sweep_finds_failing_seeds_with_repro_banner():
+    res = sweep(RaftActor(RCFG), ECFG, np.arange(128), mesh=seed_mesh(),
+                chunk_steps=256)
+    assert res.failing_seeds  # double-vote bug must surface somewhere
+    banner = res.repro_banner()
+    assert f"MADSIM_TEST_SEED={res.failing_seeds[0]}" in banner
+
+
+def test_sweep_early_exit_on_first_bug():
+    res = sweep(RaftActor(RCFG), ECFG, np.arange(128), mesh=seed_mesh(),
+                chunk_steps=64, stop_on_first_bug=True)
+    assert res.bug.any()
+    # Early exit: stopped well before the no-bug completion step count.
+    full = sweep(RaftActor(RCFG), ECFG, np.arange(128), mesh=seed_mesh(),
+                 chunk_steps=64)
+    assert res.steps_run <= full.steps_run
+
+
+def test_sweep_clean_config_no_bugs():
+    clean = RaftDeviceConfig(n=3, n_proposals=1)
+    res = sweep(RaftActor(clean), ECFG, np.arange(64), mesh=seed_mesh(),
+                chunk_steps=256)
+    assert not res.bug.any()
+    assert res.observations["leader_elected"].all()
