@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .lanes import sel, sel2, upd, upd2
 from .queue import (
     Event,
     EventQueue,
@@ -224,15 +225,16 @@ class DeviceEngine:
             op, a, b = ev.kind, ev.src, ev.dst
             is_kill = op == FAULT_KILL
             is_restart = op == FAULT_RESTART
-            alive = ws.alive.at[a].set(
-                jnp.where(is_kill, False, jnp.where(is_restart, True, ws.alive[a])))
-            gen = ws.gen.at[a].add((is_kill | is_restart).astype(jnp.int32))
-            clog_node = ws.clog_node.at[a].set(jnp.where(
+            alive = upd(ws.alive, a, jnp.where(
+                is_kill, False, jnp.where(is_restart, True, sel(ws.alive, a))))
+            gen = upd(ws.gen, a,
+                      sel(ws.gen, a) + (is_kill | is_restart).astype(jnp.int32))
+            clog_node = upd(ws.clog_node, a, jnp.where(
                 op == FAULT_CLOG_NODE, True,
-                jnp.where(op == FAULT_UNCLOG_NODE, False, ws.clog_node[a])))
-            clog_link = ws.clog_link.at[a, b].set(jnp.where(
+                jnp.where(op == FAULT_UNCLOG_NODE, False, sel(ws.clog_node, a))))
+            clog_link = upd2(ws.clog_link, a, b, jnp.where(
                 op == FAULT_CLOG_LINK, True,
-                jnp.where(op == FAULT_UNCLOG_LINK, False, ws.clog_link[a, b])))
+                jnp.where(op == FAULT_UNCLOG_LINK, False, sel2(ws.clog_link, a, b))))
             astate_r, ob_r, rng_r = actor.on_restart(cfg, ws.astate, a, ws.now, ws.rng)
             astate = tree_select(is_restart, astate_r, ws.astate)
             rng = tree_select(is_restart, rng_r, ws.rng)
@@ -243,6 +245,7 @@ class DeviceEngine:
         def push_outbox(ws: WorldState, src, ob: Outbox) -> WorldState:
             q, rng, overflow = ws.queue, ws.rng, ws.overflow
             loss = jnp.float32(cfg.loss_rate)
+            src_clogged = sel(ws.clog_node, src)
             for m in range(cfg.m):  # static unroll
                 # Two draws per slot regardless of validity: the draw count
                 # per step is static, so RNG counters depend only on step
@@ -250,13 +253,14 @@ class DeviceEngine:
                 lat, rng = uniform_u32(rng, cfg.latency_min_us, cfg.latency_max_us)
                 u, rng = uniform_f32(rng)
                 dst = jnp.clip(ob.dst[m], 0, cfg.n_nodes - 1)
-                clogged = ws.clog_node[src] | ws.clog_node[dst] | ws.clog_link[src, dst]
+                clogged = src_clogged | sel(ws.clog_node, dst) | \
+                    sel2(ws.clog_link, src, dst)
                 dropped = (~ob.is_timer[m]) & (clogged | (u < loss))
                 t = ws.now + jnp.where(ob.is_timer[m], ob.delay_us[m], lat)
                 ev = Event(
                     time=t, kind=ob.kind[m],
                     flags=jnp.where(ob.is_timer[m], FLAG_TIMER, 0).astype(jnp.int32),
-                    src=jnp.asarray(src, jnp.int32), dst=dst, gen=ws.gen[dst],
+                    src=jnp.asarray(src, jnp.int32), dst=dst, gen=sel(ws.gen, dst),
                     payload=ob.payload[m],
                 )
                 q, ok = push(q, ev, enable=ob.valid[m] & ~dropped)
@@ -272,8 +276,8 @@ class DeviceEngine:
             dst = jnp.clip(ev.dst, 0, cfg.n_nodes - 1)
             is_fault = (ev.flags & FLAG_FAULT) != 0
             is_timer = (ev.flags & FLAG_TIMER) != 0
-            stale = is_timer & (ev.gen != ws1.gen[dst])
-            dead = ~ws1.alive[dst]
+            stale = is_timer & (ev.gen != sel(ws1.gen, dst))
+            dead = ~sel(ws1.alive, dst)
             deliver = found & in_time & ~is_fault & ~stale & ~dead
             do_fault = found & in_time & is_fault
 

@@ -1,0 +1,64 @@
+"""One-hot select/update primitives for the device engine.
+
+TPU-first data movement: under ``vmap``, ``x[i]`` and ``x.at[i].set(v)`` with
+traced indices lower to gather/scatter HLOs, which XLA cannot fuse and which
+serialize badly on TPU. For the tiny per-world axes this engine indexes
+(nodes N ≤ 8, queue slots Q ≤ 256), a one-hot mask + elementwise
+select/reduce is strictly better: it fuses into the surrounding kernel and
+vectorizes over the world axis for free. Every dynamic index in the engine
+and its actors goes through these helpers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def onehot(i, n: int) -> jnp.ndarray:
+    """(n,) bool mask selecting index ``i``.
+
+    Out-of-range ``i`` selects *nothing* (drop semantics: sel yields 0/False,
+    upd is a no-op) — unlike jit-mode ``x[i]``, which clamps to the edge.
+    Callers with possibly-wild indices must clip first.
+    """
+    return jnp.arange(n) == jnp.asarray(i, jnp.int32)
+
+
+def _shaped(mask: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """Reshape a (n,) mask to broadcast over trailing dims of an ndim array."""
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
+def sel(x: jnp.ndarray, i) -> jnp.ndarray:
+    """``x[i]`` over axis 0 without a gather. x: (n, ...) → (...)."""
+    m = _shaped(onehot(i, x.shape[0]), x.ndim)
+    if x.dtype == jnp.bool_:
+        return jnp.any(x & m, axis=0)
+    return jnp.sum(jnp.where(m, x, 0), axis=0).astype(x.dtype)
+
+
+def sel2(x: jnp.ndarray, i, j) -> jnp.ndarray:
+    """``x[i, j]`` over the two leading axes. x: (n, m, ...) → (...)."""
+    return sel(sel(x, i), j)
+
+
+def sel_many(x: jnp.ndarray, idxs: jnp.ndarray) -> jnp.ndarray:
+    """``x[idxs]`` for a 1-D ``x`` and a vector of indices, gather-free.
+
+    x: (n,), idxs: (k,) → (k,). The (k, n) one-hot matrix contracts over n;
+    for the engine's tiny n this fuses into the surrounding elementwise work.
+    """
+    m = jnp.arange(x.shape[0])[None, :] == idxs[:, None]
+    return jnp.sum(jnp.where(m, x[None, :], 0), axis=1).astype(x.dtype)
+
+
+def upd(x: jnp.ndarray, i, v) -> jnp.ndarray:
+    """``x.at[i].set(v)`` over axis 0 without a scatter."""
+    m = _shaped(onehot(i, x.shape[0]), x.ndim)
+    return jnp.where(m, jnp.asarray(v, x.dtype), x)
+
+
+def upd2(x: jnp.ndarray, i, j, v) -> jnp.ndarray:
+    """``x.at[i, j].set(v)`` over the two leading axes."""
+    m = (_shaped(onehot(i, x.shape[0]), x.ndim)
+         & _shaped(onehot(j, x.shape[1]), x.ndim - 1)[None])
+    return jnp.where(m, jnp.asarray(v, x.dtype), x)

@@ -20,6 +20,8 @@ from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
+from .lanes import onehot, sel
+
 INF_TIME = jnp.int32(2**31 - 1)
 
 # Event flag bits.
@@ -82,16 +84,20 @@ def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray
 
     ``enable`` masks the push (False ⇒ no-op, ok=True) so callers can keep a
     single static code path for conditional sends. ok=False ⇒ overflow.
+
+    Scatter-free: the slot is addressed by a one-hot mask so the whole
+    insert is elementwise over the Q lanes and fuses under vmap (see
+    engine/lanes.py for why this beats ``.at[slot].set`` on TPU).
     """
     enable = jnp.asarray(enable, bool)
-    # First free slot: argmin over valid (False < True).
-    slot = jnp.argmin(q.valid)
-    free = ~q.valid[slot]
-    do = enable & free
-    ok = ~enable | free
+    free_any = ~jnp.all(q.valid)
+    # First free slot: one-hot of the argmin over valid (False < True).
+    mask = onehot(jnp.argmin(q.valid), q.valid.shape[0])
+    do = mask & enable & free_any
+    ok = ~enable | free_any
 
     def put(lane, value):
-        return lane.at[slot].set(jnp.where(do, value, lane[slot]))
+        return jnp.where(do, jnp.asarray(value, lane.dtype), lane)
 
     q = EventQueue(
         time=put(q.time, ev.time),
@@ -100,9 +106,8 @@ def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray
         src=put(q.src, ev.src),
         dst=put(q.dst, ev.dst),
         gen=put(q.gen, ev.gen),
-        payload=q.payload.at[slot].set(
-            jnp.where(do, ev.payload, q.payload[slot])),
-        valid=put(q.valid, jnp.asarray(True)),
+        payload=jnp.where(do[:, None], ev.payload[None, :], q.payload),
+        valid=q.valid | do,
     )
     return q, ok
 
@@ -112,22 +117,27 @@ def pop(q: EventQueue) -> Tuple[EventQueue, Event, jnp.ndarray]:
 
     When the queue is empty, ``found`` is False and the event contents are
     arbitrary (time INF_TIME) — callers must mask on ``found``.
+
+    Scatter/gather-free: the min slot is read back via a one-hot masked
+    reduction and cleared via an elementwise select.
     """
     keyed = jnp.where(q.valid, q.time, INF_TIME)
     slot = jnp.argmin(keyed)
-    found = q.valid[slot]
+    mask = onehot(slot, q.valid.shape[0])
+    found = jnp.any(mask & q.valid)
     ev = Event(
-        time=keyed[slot],
-        kind=q.kind[slot],
-        flags=q.flags[slot],
-        src=q.src[slot],
-        dst=q.dst[slot],
-        gen=q.gen[slot],
-        payload=q.payload[slot],
+        time=jnp.where(found, sel(keyed, slot), INF_TIME),
+        kind=sel(q.kind, slot),
+        flags=sel(q.flags, slot),
+        src=sel(q.src, slot),
+        dst=sel(q.dst, slot),
+        gen=sel(q.gen, slot),
+        payload=sel(q.payload, slot),
     )
+    clear = mask & found
     q = q._replace(
-        valid=q.valid.at[slot].set(jnp.where(found, False, q.valid[slot])),
-        time=q.time.at[slot].set(jnp.where(found, INF_TIME, q.time[slot])),
+        valid=q.valid & ~clear,
+        time=jnp.where(clear, INF_TIME, q.time),
     )
     return q, ev, found
 

@@ -5,9 +5,10 @@ host-engine version): leader election + single-entry-pipelined log
 replication over the engine's simulated network, with on-device invariant
 checking (election safety, log matching) producing the per-world *bug flag*
 that BASELINE.json's time-to-first-bug metric measures. All state is
-fixed-shape int32 arrays, all control flow is ``lax`` primitives, so the
-whole cluster steps inside one XLA program and vmaps over thousands of
-worlds.
+fixed-shape int32 arrays, all control flow is ``lax`` primitives, and all
+node indexing goes through the one-hot helpers in engine/lanes.py (no
+gather/scatter HLOs), so the whole cluster steps inside one fused XLA
+program and vmaps over thousands of worlds.
 
 Fault tolerance matches the host model: node kill drops timers via the
 engine's generation counters; restart preserves persistent state
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .core import EngineConfig, Outbox
+from .lanes import sel, sel2, sel_many, upd, upd2
 from .queue import Event, FLAG_TIMER, INF_TIME
 from .rng import DevRng, uniform_u32
 
@@ -136,14 +138,14 @@ class RaftActor:
         r = self.rcfg
         n = r.n
         me = jnp.clip(node, 0, n - 1)
-        epoch2 = s.elect_epoch[me] + 1
+        epoch2 = sel(s.elect_epoch, me) + 1
         s = s._replace(
-            role=s.role.at[me].set(FOLLOWER),
-            votes=s.votes.at[me].set(0),
-            commit=s.commit.at[me].set(0),
-            next_idx=s.next_idx.at[me].set(jnp.ones((n,), jnp.int32)),
-            match_idx=s.match_idx.at[me].set(jnp.zeros((n,), jnp.int32)),
-            elect_epoch=s.elect_epoch.at[me].set(epoch2),
+            role=upd(s.role, me, FOLLOWER),
+            votes=upd(s.votes, me, 0),
+            commit=upd(s.commit, me, 0),
+            next_idx=upd(s.next_idx, me, jnp.ones((n,), jnp.int32)),
+            match_idx=upd(s.match_idx, me, jnp.zeros((n,), jnp.int32)),
+            elect_epoch=upd(s.elect_epoch, me, epoch2),
         )
         delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
         ob = self._outbox(
@@ -215,16 +217,18 @@ class RaftActor:
         r = self.rcfg
         n = r.n
         me = jnp.clip(ev.dst, 0, n - 1)
-        epoch_ok = ev.payload[0] == s.elect_epoch[me]
-        fire = epoch_ok & (s.role[me] != LEADER)
-        term2 = s.term[me] + 1
+        epoch_ok = ev.payload[0] == sel(s.elect_epoch, me)
+        fire = epoch_ok & (sel(s.role, me) != LEADER)
+        term_me = sel(s.term, me)
+        term2 = term_me + 1
         s2 = s._replace(
-            term=s.term.at[me].set(jnp.where(fire, term2, s.term[me])),
-            voted_for=s.voted_for.at[me].set(jnp.where(fire, me, s.voted_for[me])),
-            role=s.role.at[me].set(jnp.where(fire, CANDIDATE, s.role[me])),
-            votes=s.votes.at[me].set(jnp.where(fire, 1 << me, s.votes[me])),
+            term=upd(s.term, me, jnp.where(fire, term2, term_me)),
+            voted_for=upd(s.voted_for, me,
+                          jnp.where(fire, me, sel(s.voted_for, me))),
+            role=upd(s.role, me, jnp.where(fire, CANDIDATE, sel(s.role, me))),
+            votes=upd(s.votes, me, jnp.where(fire, 1 << me, sel(s.votes, me))),
         )
-        last_idx = s.log_len[me]
+        last_idx = sel(s.log_len, me)
         last_term = self._log_term_at(s, me, last_idx)
         payload = self._bcast_payload(cfg, [term2, me, last_idx, last_term])
         peers = jnp.arange(n) != me
@@ -236,7 +240,7 @@ class RaftActor:
             msg_payload=payload,
             timer_valid=epoch_ok,  # keep exactly one live election timer
             timer_kind=jnp.int32(K_ELECTION), timer_dst=me, timer_delay=delay,
-            timer_payload=self._pad(cfg, [s.elect_epoch[me]]),
+            timer_payload=self._pad(cfg, [sel(s.elect_epoch, me)]),
         )
         return s2, ob, rng, jnp.asarray(False)
 
@@ -244,7 +248,7 @@ class RaftActor:
         r = self.rcfg
         n = r.n
         me = jnp.clip(ev.dst, 0, n - 1)
-        live = (s.role[me] == LEADER) & (s.term[me] == ev.payload[0])
+        live = (sel(s.role, me) == LEADER) & (sel(s.term, me) == ev.payload[0])
         msg_valid, msg_payload = self._append_msgs(cfg, s, me)
         ob = self._outbox(
             cfg,
@@ -264,24 +268,25 @@ class RaftActor:
         t, cand = ev.payload[0], jnp.clip(ev.payload[1], 0, n - 1)
         last_idx, last_term = ev.payload[2], ev.payload[3]
         s = self._maybe_step_down(s, me, t)
-        reject = t < s.term[me]
-        my_last = s.log_len[me]
+        term_me = sel(s.term, me)
+        voted_me = sel(s.voted_for, me)
+        reject = t < term_me
+        my_last = sel(s.log_len, me)
         my_last_term = self._log_term_at(s, me, my_last)
         up_to_date = (last_term > my_last_term) | \
                      ((last_term == my_last_term) & (last_idx >= my_last))
         if r.buggy_double_vote:
             can_vote = jnp.asarray(True)
         else:
-            can_vote = (s.voted_for[me] == -1) | (s.voted_for[me] == cand)
+            can_vote = (voted_me == -1) | (voted_me == cand)
         grant = ~reject & up_to_date & can_vote
-        epoch2 = s.elect_epoch[me] + 1
+        epoch2 = sel(s.elect_epoch, me) + 1
         s2 = s._replace(
-            voted_for=s.voted_for.at[me].set(
-                jnp.where(grant, cand, s.voted_for[me])),
-            elect_epoch=s.elect_epoch.at[me].set(
-                jnp.where(grant, epoch2, s.elect_epoch[me])),
+            voted_for=upd(s.voted_for, me, jnp.where(grant, cand, voted_me)),
+            elect_epoch=upd(s.elect_epoch, me,
+                            jnp.where(grant, epoch2, sel(s.elect_epoch, me))),
         )
-        payload = self._bcast_payload(cfg, [s.term[me], grant.astype(jnp.int32), me, 0])
+        payload = self._bcast_payload(cfg, [term_me, grant.astype(jnp.int32), me, 0])
         delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
         ob = self._outbox(
             cfg,
@@ -300,19 +305,21 @@ class RaftActor:
         me = jnp.clip(ev.dst, 0, n - 1)
         t, granted, voter = ev.payload[0], ev.payload[1], jnp.clip(ev.payload[2], 0, n - 1)
         s = self._maybe_step_down(s, me, t)
-        counted = (granted != 0) & (s.role[me] == CANDIDATE) & (t == s.term[me])
-        votes2 = jnp.where(counted, s.votes[me] | (1 << voter), s.votes[me])
+        term_me = sel(s.term, me)
+        counted = (granted != 0) & (sel(s.role, me) == CANDIDATE) & (t == term_me)
+        votes2 = jnp.where(counted, sel(s.votes, me) | (1 << voter),
+                           sel(s.votes, me))
         win = counted & (jax.lax.population_count(votes2) > n // 2)
-        llen = s.log_len[me]
+        llen = sel(s.log_len, me)
         s2 = s._replace(
-            votes=s.votes.at[me].set(votes2),
-            role=s.role.at[me].set(jnp.where(win, LEADER, s.role[me])),
-            next_idx=s.next_idx.at[me].set(jnp.where(
-                win, jnp.full((n,), llen + 1, jnp.int32), s.next_idx[me])),
-            match_idx=s.match_idx.at[me].set(jnp.where(
+            votes=upd(s.votes, me, votes2),
+            role=upd(s.role, me, jnp.where(win, LEADER, sel(s.role, me))),
+            next_idx=upd(s.next_idx, me, jnp.where(
+                win, jnp.full((n,), 1, jnp.int32) + llen, sel(s.next_idx, me))),
+            match_idx=upd(s.match_idx, me, jnp.where(
                 win,
-                jnp.zeros((n,), jnp.int32).at[me].set(llen),
-                s.match_idx[me])),
+                jnp.where(jnp.arange(n) == me, llen, 0),
+                sel(s.match_idx, me))),
             first_leader_time=jnp.where(
                 win, jnp.minimum(s.first_leader_time, jnp.asarray(now, jnp.int32)),
                 s.first_leader_time),
@@ -326,7 +333,7 @@ class RaftActor:
             msg_payload=msg_payload,
             timer_valid=win, timer_kind=jnp.int32(K_HEARTBEAT), timer_dst=me,
             timer_delay=jnp.int32(r.heartbeat_us),
-            timer_payload=self._pad(cfg, [s2.term[me]]),
+            timer_payload=self._pad(cfg, [sel(s2.term, me)]),
         )
         return s2, ob, rng, jnp.asarray(False)
 
@@ -339,37 +346,40 @@ class RaftActor:
         n_ent, e_term, e_cmd, l_commit = (ev.payload[4], ev.payload[5],
                                           ev.payload[6], ev.payload[7])
         s = self._maybe_step_down(s, me, t, follower_on_equal=True)
-        reject = t < s.term[me]
-        prev_ok = (prev_idx <= s.log_len[me]) & \
-                  (self._log_term_at(s, me, prev_idx) == prev_term)
+        term_me = sel(s.term, me)
+        llen_me = sel(s.log_len, me)
+        log_term_row = sel(s.log_term, me)   # (L,)
+        log_cmd_row = sel(s.log_cmd, me)     # (L,)
+        reject = t < term_me
+        prev_ok = (prev_idx <= llen_me) & \
+                  (self._row_term_at(log_term_row, prev_idx) == prev_term)
         success = ~reject & prev_ok
         idx = prev_idx + 1
         has_room = idx <= L
         write = success & (n_ent > 0) & has_room
         pos = jnp.clip(idx - 1, 0, L - 1)
-        same = (idx <= s.log_len[me]) & \
-               (s.log_term[me, pos] == e_term) & (s.log_cmd[me, pos] == e_cmd)
-        new_len = jnp.where(write, jnp.where(same, s.log_len[me], idx),
-                            s.log_len[me])
-        log_term2 = s.log_term.at[me, pos].set(
-            jnp.where(write, e_term, s.log_term[me, pos]))
-        log_cmd2 = s.log_cmd.at[me, pos].set(
-            jnp.where(write, e_cmd, s.log_cmd[me, pos]))
+        same = (idx <= llen_me) & (sel(log_term_row, pos) == e_term) & \
+               (sel(log_cmd_row, pos) == e_cmd)
+        new_len = jnp.where(write, jnp.where(same, llen_me, idx), llen_me)
+        log_term2 = upd2(s.log_term, me, pos,
+                         jnp.where(write, e_term, sel(log_term_row, pos)))
+        log_cmd2 = upd2(s.log_cmd, me, pos,
+                        jnp.where(write, e_cmd, sel(log_cmd_row, pos)))
         match = jnp.where(write, idx, jnp.where(success, prev_idx, 0))
         commit2 = jnp.where(success,
-                            jnp.maximum(s.commit[me],
+                            jnp.maximum(sel(s.commit, me),
                                         jnp.minimum(l_commit, new_len)),
-                            s.commit[me])
-        epoch2 = s.elect_epoch[me] + 1
+                            sel(s.commit, me))
+        epoch2 = sel(s.elect_epoch, me) + 1
         s2 = s._replace(
             log_term=log_term2, log_cmd=log_cmd2,
-            log_len=s.log_len.at[me].set(new_len),
-            commit=s.commit.at[me].set(commit2),
-            elect_epoch=s.elect_epoch.at[me].set(
-                jnp.where(reject, s.elect_epoch[me], epoch2)),
+            log_len=upd(s.log_len, me, new_len),
+            commit=upd(s.commit, me, commit2),
+            elect_epoch=upd(s.elect_epoch, me,
+                            jnp.where(reject, sel(s.elect_epoch, me), epoch2)),
         )
         payload = self._bcast_payload(
-            cfg, [s.term[me], success.astype(jnp.int32), match, me])
+            cfg, [term_me, success.astype(jnp.int32), match, me])
         delay, rng = uniform_u32(rng, r.elect_min_us, r.elect_max_us)
         ob = self._outbox(
             cfg,
@@ -389,28 +399,32 @@ class RaftActor:
         t, success = ev.payload[0], ev.payload[1]
         match, follower = ev.payload[2], jnp.clip(ev.payload[3], 0, n - 1)
         s = self._maybe_step_down(s, me, t)
-        live = (s.role[me] == LEADER) & (t == s.term[me])
+        term_me = sel(s.term, me)
+        live = (sel(s.role, me) == LEADER) & (t == term_me)
         ok = live & (success != 0)
         fail = live & (success == 0)
-        match2 = jnp.maximum(s.match_idx[me, follower], match)
+        cur_match = sel2(s.match_idx, me, follower)
+        cur_next = sel2(s.next_idx, me, follower)
+        match2 = jnp.maximum(cur_match, match)
         s2 = s._replace(
-            match_idx=s.match_idx.at[me, follower].set(
-                jnp.where(ok, match2, s.match_idx[me, follower])),
-            next_idx=s.next_idx.at[me, follower].set(jnp.where(
+            match_idx=upd2(s.match_idx, me, follower,
+                           jnp.where(ok, match2, cur_match)),
+            next_idx=upd2(s.next_idx, me, follower, jnp.where(
                 ok, match2 + 1,
-                jnp.where(fail,
-                          jnp.maximum(1, s.next_idx[me, follower] - 1),
-                          s.next_idx[me, follower]))),
+                jnp.where(fail, jnp.maximum(1, cur_next - 1), cur_next))),
         )
         # Advance commit: the largest n with majority match and current-term
         # entry (models/raft.py _advance_commit).
+        match_row = sel(s2.match_idx, me)        # (N,)
+        log_term_row = sel(s2.log_term, me)      # (L,)
+        llen_me = sel(s2.log_len, me)
         ns = jnp.arange(1, L + 1)
-        counts = jnp.sum(s2.match_idx[me][:, None] >= ns[None, :], axis=0)
-        okn = (ns <= s2.log_len[me]) & (counts > n // 2) & \
-              (s2.log_term[me] == s2.term[me])
+        counts = jnp.sum(match_row[:, None] >= ns[None, :], axis=0)
+        okn = (ns <= llen_me) & (counts > n // 2) & (log_term_row == term_me)
         best = jnp.max(jnp.where(okn, ns, 0))
-        commit2 = jnp.where(live, jnp.maximum(s2.commit[me], best), s2.commit[me])
-        s3 = s2._replace(commit=s2.commit.at[me].set(commit2))
+        commit_me = sel(s2.commit, me)
+        commit2 = jnp.where(live, jnp.maximum(commit_me, best), commit_me)
+        s3 = s2._replace(commit=upd(s2.commit, me, commit2))
         return s3, Outbox.empty(cfg), rng, jnp.asarray(False)
 
     def _on_propose(self, cfg, s: RaftState, ev: Event, now, rng):
@@ -418,17 +432,18 @@ class RaftActor:
         n, L = r.n, r.log_cap
         me = jnp.clip(ev.dst, 0, n - 1)
         cmd = ev.payload[0]
-        accept = (s.role[me] == LEADER) & (s.log_len[me] < L)
-        pos = jnp.clip(s.log_len[me], 0, L - 1)
-        llen2 = s.log_len[me] + accept.astype(jnp.int32)
+        llen_me = sel(s.log_len, me)
+        accept = (sel(s.role, me) == LEADER) & (llen_me < L)
+        pos = jnp.clip(llen_me, 0, L - 1)
+        llen2 = llen_me + accept.astype(jnp.int32)
         s2 = s._replace(
-            log_term=s.log_term.at[me, pos].set(
-                jnp.where(accept, s.term[me], s.log_term[me, pos])),
-            log_cmd=s.log_cmd.at[me, pos].set(
-                jnp.where(accept, cmd, s.log_cmd[me, pos])),
-            log_len=s.log_len.at[me].set(llen2),
-            match_idx=s.match_idx.at[me, me].set(
-                jnp.where(accept, llen2, s.match_idx[me, me])),
+            log_term=upd2(s.log_term, me, pos, jnp.where(
+                accept, sel(s.term, me), sel2(s.log_term, me, pos))),
+            log_cmd=upd2(s.log_cmd, me, pos, jnp.where(
+                accept, cmd, sel2(s.log_cmd, me, pos))),
+            log_len=upd(s.log_len, me, llen2),
+            match_idx=upd2(s.match_idx, me, me, jnp.where(
+                accept, llen2, sel2(s.match_idx, me, me))),
         )
         msg_valid, msg_payload = self._append_msgs(cfg, s2, me)
         ob = self._outbox(
@@ -448,39 +463,46 @@ class RaftActor:
     def _maybe_step_down(self, s: RaftState, me, t, follower_on_equal=False):
         """Adopt a higher term (→ follower, clear vote); optionally also
         step down from CANDIDATE on an equal-term AppendEntries."""
-        higher = t > s.term[me]
-        demote = higher | (follower_on_equal & (t == s.term[me]) &
-                           (s.role[me] == CANDIDATE))
+        term_me = sel(s.term, me)
+        higher = t > term_me
+        demote = higher | (follower_on_equal & (t == term_me) &
+                           (sel(s.role, me) == CANDIDATE))
         return s._replace(
-            term=s.term.at[me].set(jnp.where(higher, t, s.term[me])),
-            voted_for=s.voted_for.at[me].set(
-                jnp.where(higher, -1, s.voted_for[me])),
-            role=s.role.at[me].set(jnp.where(demote, FOLLOWER, s.role[me])),
+            term=upd(s.term, me, jnp.where(higher, t, term_me)),
+            voted_for=upd(s.voted_for, me,
+                          jnp.where(higher, -1, sel(s.voted_for, me))),
+            role=upd(s.role, me, jnp.where(demote, FOLLOWER, sel(s.role, me))),
         )
 
     def _log_term_at(self, s: RaftState, me, idx):
         """Term of entry ``idx`` (1-based); 0 for idx == 0."""
+        return self._row_term_at(sel(s.log_term, me), idx)
+
+    def _row_term_at(self, log_term_row, idx):
         L = self.rcfg.log_cap
         pos = jnp.clip(idx - 1, 0, L - 1)
-        return jnp.where(idx <= 0, 0, s.log_term[me, pos])
+        return jnp.where(idx <= 0, 0, sel(log_term_row, pos))
 
     def _append_msgs(self, cfg, s: RaftState, me):
         """Per-peer AppendEntries payloads from the leader's next_idx row."""
         r = self.rcfg
         n, L = r.n, r.log_cap
-        nxt = jnp.clip(s.next_idx[me], 1, L + 1)      # (N,)
+        llen_me = sel(s.log_len, me)
+        log_term_row = sel(s.log_term, me)            # (L,)
+        log_cmd_row = sel(s.log_cmd, me)              # (L,)
+        nxt = jnp.clip(sel(s.next_idx, me), 1, L + 1)  # (N,)
         prev = nxt - 1
-        prev_pos = jnp.clip(prev - 1, 0, L - 1)
-        prev_term = jnp.where(prev <= 0, 0, s.log_term[me, prev_pos])
-        have = nxt <= s.log_len[me]                   # entry to ship?
+        prev_term = jnp.where(
+            prev <= 0, 0, sel_many(log_term_row, jnp.clip(prev - 1, 0, L - 1)))
+        have = nxt <= llen_me                          # entry to ship?
         pos = jnp.clip(nxt - 1, 0, L - 1)
-        e_term = jnp.where(have, s.log_term[me, pos], 0)
-        e_cmd = jnp.where(have, s.log_cmd[me, pos], 0)
-        term = jnp.full((n,), s.term[me], jnp.int32)
+        e_term = jnp.where(have, sel_many(log_term_row, pos), 0)
+        e_cmd = jnp.where(have, sel_many(log_cmd_row, pos), 0)
+        term = jnp.full((n,), sel(s.term, me), jnp.int32)
         payload = jnp.stack([
             term, jnp.full((n,), me, jnp.int32), prev, prev_term,
             have.astype(jnp.int32), e_term, e_cmd,
-            jnp.full((n,), s.commit[me], jnp.int32),
+            jnp.full((n,), sel(s.commit, me), jnp.int32),
         ], axis=1)
         pad = jnp.zeros((n, cfg.payload_words - 8), jnp.int32)
         return jnp.arange(n) != me, jnp.concatenate([payload, pad], axis=1)
