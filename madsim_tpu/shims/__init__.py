@@ -1,0 +1,22 @@
+"""Drop-in ecosystem shims (SURVEY §2.12-2.15 analogs).
+
+The reference makes real-world code simulable by shadowing its dependencies:
+madsim-tokio re-exports tokio in production and maps onto the simulator under
+``--cfg madsim`` (`madsim-tokio/src/lib.rs:1-7`); madsim-tonic reimplements
+tonic's transport over simulated Endpoints (`madsim-tonic/src/lib.rs`); and
+madsim-tokio-postgres proves a real wire-protocol client runs unchanged over
+the simulated TCP stack.
+
+The Python analogs:
+
+- :mod:`.aio` — asyncio-shaped API over the simulation, plus interpreter-
+  level patching of ``asyncio``/``time``/``random``/``os.urandom`` (the
+  analog of the reference's libc interception, scoped per SURVEY §7).
+- :mod:`.grpc_sim` — grpc.aio-shaped RPC (server/channel, 4 streaming modes,
+  status codes) over Endpoint duplex channels with boxed messages.
+- :mod:`.postgres` — a PostgreSQL v3 wire-protocol client (and an in-sim
+  test server) over the simulated TcpStream.
+"""
+from . import aio, grpc_sim, postgres
+
+__all__ = ["aio", "grpc_sim", "postgres"]

@@ -1,0 +1,328 @@
+"""asyncio-shaped API over the simulation + interpreter-level patching.
+
+The madsim-tokio analog (`madsim-tokio/src/lib.rs:32-52`): application code
+written against asyncio's surface runs deterministically inside the
+simulation. Two usage modes:
+
+1. Import this module instead of asyncio (``from madsim_tpu.shims import
+   aio as asyncio``): the shimmed subset keeps asyncio's names and
+   semantics — ``sleep``, ``wait_for``, ``gather``, ``create_task``,
+   ``Event``, ``Lock``, ``Semaphore``, ``Queue`` — on virtual time and the
+   seeded scheduler.
+
+2. ``with aio.patched():`` — monkeypatch the real ``asyncio`` module (plus
+   ``time.time``/``monotonic``/``perf_counter``/``sleep``, ``random``'s
+   global functions, and ``os.urandom``) so *unmodified* third-party async
+   code runs in-sim. This is the Python-level analog of the reference's
+   libc ``#[no_mangle]`` interception (`rand.rs:195-261`,
+   `time/system_time.rs:4-97`): outside a simulation context every patched
+   function falls through to the real implementation, exactly like the
+   reference's ``dlsym(RTLD_NEXT)`` passthrough.
+
+Not simulable at this level (documented gap, SURVEY §7): code that drives
+its own event loop (``asyncio.run``/``loop.run_until_complete`` inside the
+sim), raw selectors/sockets, and threads.
+"""
+from __future__ import annotations
+
+import builtins
+import contextlib
+from typing import Any, Awaitable, Callable, Coroutine, Iterable, List
+
+from .. import sync as _sync
+from .. import task as _task
+from .. import time as _time
+from ..core import context as _context
+from ..core.futures import Cancelled, SimFuture
+
+TimeoutError = builtins.TimeoutError  # asyncio.TimeoutError is this since 3.11
+CancelledError = Cancelled
+
+
+# ---------------------------------------------------------------------------
+# Coroutine / task API
+# ---------------------------------------------------------------------------
+
+def sleep(delay: float, result: Any = None):
+    """asyncio.sleep on virtual time."""
+
+    async def _sleep():
+        await _time.sleep(max(0.0, delay))
+        return result
+
+    return _sleep()
+
+
+class Task:
+    """asyncio.Task-flavored wrapper over a simulation JoinHandle."""
+
+    def __init__(self, handle: _task.JoinHandle, fut: SimFuture):
+        self._handle = handle
+        self._fut = fut
+
+    def cancel(self) -> bool:
+        if self._fut.done():
+            return False
+        self._handle.abort()
+        if not self._fut.done():
+            self._fut.set_exception(CancelledError())
+        return True
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def cancelled(self) -> bool:
+        return self._fut.done() and isinstance(self._fut._exception, Cancelled)
+
+    def result(self) -> Any:
+        if not self._fut.done():
+            raise RuntimeError("task is not done")
+        return self._fut.result()
+
+    def exception(self):
+        if not self._fut.done():
+            raise RuntimeError("task is not done")
+        return self._fut._exception
+
+    def __await__(self):
+        return self._fut.__await__()
+
+
+def create_task(coro: Coroutine, *, name: str = None) -> Task:
+    """Spawn on the current node's deterministic scheduler.
+
+    Exceptions are contained in the Task (asyncio semantics) rather than
+    aborting the whole simulation (the raw task.spawn semantics).
+    """
+    fut = SimFuture()
+
+    async def _guard():
+        try:
+            fut.set_result(await coro)
+        except GeneratorExit:
+            raise  # task abort: let close() unwind; cancel() sets the future
+        except Cancelled:
+            if not fut.done():
+                fut.set_exception(CancelledError())
+        except BaseException as exc:  # noqa: BLE001 — contained, like asyncio
+            if not fut.done():
+                fut.set_exception(exc)
+
+    return Task(_task.spawn(_guard()), fut)
+
+
+ensure_future = create_task
+
+
+async def gather(*aws: Awaitable, return_exceptions: bool = False) -> List[Any]:
+    tasks = [create_task(aw) if not isinstance(aw, Task) else aw for aw in aws]
+    results: List[Any] = []
+    first_exc = None
+    for t in tasks:
+        try:
+            results.append(await t)
+        except BaseException as exc:  # noqa: BLE001
+            if return_exceptions:
+                results.append(exc)
+            elif first_exc is None:
+                first_exc = exc
+                results.append(None)
+    if first_exc is not None and not return_exceptions:
+        raise first_exc
+    return results
+
+
+async def wait_for(aw: Awaitable, timeout: float) -> Any:
+    if timeout is None:
+        return await aw
+    return await _time.timeout(timeout, aw)
+
+
+async def shield(aw: Awaitable) -> Any:
+    # Cancellation granularity in the sim is the task; a shielded await is
+    # just the await (supervisor aborts drop whole tasks, not awaits).
+    return await aw
+
+
+def get_event_loop():
+    """Minimal loop object for code that calls loop.time()/create_task()."""
+    return _Loop()
+
+
+get_running_loop = get_event_loop
+
+
+class _Loop:
+    def time(self) -> float:
+        return _time.monotonic()
+
+    def create_task(self, coro: Coroutine) -> Task:
+        return create_task(coro)
+
+    def call_later(self, delay: float, cb: Callable, *args):
+        handle = _context.current_handle()
+        return handle.time.add_timer(_time.to_ns(delay), lambda: cb(*args))
+
+
+# ---------------------------------------------------------------------------
+# Synchronization (asyncio surface over madsim_tpu.sync)
+# ---------------------------------------------------------------------------
+
+class Event(_sync.Event):
+    def clear(self) -> None:
+        self._set = False
+
+
+Lock = _sync.Lock
+Semaphore = _sync.Semaphore
+
+
+# The real asyncio exception classes, so unmodified `except asyncio.QueueEmpty`
+# handlers keep working under patched().
+import asyncio as _stdlib_asyncio  # noqa: E402
+
+QueueEmpty = _stdlib_asyncio.QueueEmpty
+
+
+class Queue(_sync.Queue):
+    def get_nowait(self) -> Any:
+        ok, item = self._ch.try_recv()
+        if not ok:
+            raise QueueEmpty()
+        return item
+
+
+# ---------------------------------------------------------------------------
+# Interpreter-level patching (libc-interception analog)
+# ---------------------------------------------------------------------------
+
+def _in_sim() -> bool:
+    return _context.try_current_handle() is not None
+
+
+def _sim_rng():
+    return _context.current_handle().rand
+
+
+_PATCHES = None
+
+
+def install() -> None:
+    """Patch asyncio/time/random/os so unmodified code runs in-sim.
+
+    Each wrapper falls through to the real function when called outside a
+    simulation context (the dlsym(RTLD_NEXT) passthrough analog,
+    `rand.rs:241-253`). Idempotent; undo with :func:`uninstall`.
+    """
+    global _PATCHES
+    if _PATCHES is not None:
+        return
+    import asyncio as _aio
+    import os as _os
+    import random as _random
+    import time as _walltime
+
+    saved = {}
+
+    def patch(mod, name, fn):
+        saved[(mod, name)] = getattr(mod, name)
+        setattr(mod, name, fn)
+
+    def passthrough(orig, sim_fn):
+        def wrapper(*a, **kw):
+            if _in_sim():
+                return sim_fn(*a, **kw)
+            return orig(*a, **kw)
+
+        wrapper.__name__ = getattr(orig, "__name__", "patched")
+        return wrapper
+
+    # -- asyncio ------------------------------------------------------------
+    patch(_aio, "sleep", passthrough(_aio.sleep, sleep))
+    patch(_aio, "wait_for", passthrough(_aio.wait_for, wait_for))
+    patch(_aio, "gather", passthrough(_aio.gather, gather))
+    patch(_aio, "shield", passthrough(_aio.shield, shield))
+    patch(_aio, "get_event_loop", passthrough(_aio.get_event_loop, get_event_loop))
+    patch(_aio, "get_running_loop", passthrough(_aio.get_running_loop, get_running_loop))
+
+    def _sim_create_task(coro, **kw):
+        return create_task(coro)
+
+    patch(_aio, "create_task", passthrough(_aio.create_task, _sim_create_task))
+    patch(_aio, "ensure_future", passthrough(_aio.ensure_future, _sim_create_task))
+    for name, cls in [("Event", Event), ("Lock", Lock),
+                      ("Semaphore", Semaphore), ("Queue", Queue)]:
+        orig_cls = getattr(_aio, name)
+        patch(_aio, name, _class_passthrough(orig_cls, cls))
+
+    # -- time ---------------------------------------------------------------
+    patch(_walltime, "time", passthrough(_walltime.time, _time.system_time))
+    patch(_walltime, "time_ns", passthrough(_walltime.time_ns, _time.system_time_ns))
+    patch(_walltime, "monotonic", passthrough(_walltime.monotonic, _time.monotonic))
+    patch(_walltime, "monotonic_ns", passthrough(_walltime.monotonic_ns, _time.monotonic_ns))
+    patch(_walltime, "perf_counter", passthrough(_walltime.perf_counter, _time.monotonic))
+
+    def _sim_blocking_sleep(seconds):
+        # A blocking sleep inside the single-threaded sim just advances the
+        # virtual clock (due timers fire at the next scheduling point).
+        _context.current_handle().time.advance(int(seconds * 1e9))
+
+    patch(_walltime, "sleep", passthrough(_walltime.sleep, _sim_blocking_sleep))
+
+    # -- randomness (getrandom/getentropy interception analog) --------------
+    patch(_os, "urandom", passthrough(_os.urandom, lambda n: _sim_rng().gen_bytes(n)))
+    patch(_random, "random", passthrough(_random.random, lambda: _sim_rng().random()))
+    patch(_random, "randint",
+          passthrough(_random.randint, lambda a, b: _sim_rng().gen_range(a, b + 1)))
+    def _sim_randrange(start, stop=None, step=1):
+        if stop is None:
+            start, stop = 0, start
+        n_steps = (stop - start + step - 1) // step if step > 0 \
+            else (stop - start + step + 1) // step
+        if n_steps <= 0:
+            raise ValueError("empty range for randrange()")
+        return start + step * _sim_rng().gen_range(0, n_steps)
+
+    patch(_random, "randrange", passthrough(_random.randrange, _sim_randrange))
+    patch(_random, "choice", passthrough(_random.choice, lambda seq: _sim_rng().choice(seq)))
+    patch(_random, "shuffle", passthrough(_random.shuffle, lambda seq: _sim_rng().shuffle(seq)))
+    patch(_random, "uniform",
+          passthrough(_random.uniform, lambda a, b: _sim_rng().gen_range_f64(a, b)))
+    patch(_random, "getrandbits",
+          passthrough(_random.getrandbits,
+                      lambda k: int.from_bytes(_sim_rng().gen_bytes((k + 7) // 8),
+                                               "little") >> ((8 - k % 8) % 8)))
+
+    _PATCHES = saved
+
+
+def _class_passthrough(orig_cls, sim_cls):
+    """A callable standing in for a class: constructs the sim variant inside
+    a simulation, the original outside."""
+
+    def factory(*a, **kw):
+        return sim_cls(*a, **kw) if _in_sim() else orig_cls(*a, **kw)
+
+    factory.__name__ = orig_cls.__name__
+    return factory
+
+
+def uninstall() -> None:
+    global _PATCHES
+    if _PATCHES is None:
+        return
+    for (mod, name), orig in _PATCHES.items():
+        setattr(mod, name, orig)
+    _PATCHES = None
+
+
+@contextlib.contextmanager
+def patched():
+    """``with aio.patched():`` — install() for the duration of the block."""
+    was_installed = _PATCHES is not None
+    install()
+    try:
+        yield
+    finally:
+        if not was_installed:
+            uninstall()

@@ -1,0 +1,332 @@
+"""PostgreSQL v3 wire-protocol client over the simulated network.
+
+The madsim-tokio-postgres analog (SURVEY §2.15): the reference vendors the
+real tokio-postgres client and runs its unchanged protocol machinery over the
+simulated TcpStream, proving the shim strategy scales to a real protocol.
+This module does the Python equivalent: a faithful implementation of the
+PostgreSQL frontend/backend protocol (startup, simple-query flow,
+RowDescription/DataRow/CommandComplete/ErrorResponse/ReadyForQuery framing —
+https://www.postgresql.org/docs/current/protocol-message-formats.html)
+speaking through :class:`madsim_tpu.net.TcpStream`, so every byte crosses the
+simulated network with latency/loss/partition semantics.
+
+Where the reference needs a live out-of-process PostgreSQL server (its test
+suite is excluded from CI for exactly that reason, reference `Makefile:12-16`),
+the simulation can host the server *inside the world*: :class:`SimPostgresServer`
+is a protocol-correct backend with a toy table engine, so client↔server runs
+under seed sweeps, clock skew, and fault injection like any other workload.
+"""
+from __future__ import annotations
+
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .. import task as _task
+from ..net.netsim import BrokenPipe, ConnectionReset
+from ..net.tcp import TcpListener, TcpStream
+
+PROTOCOL_VERSION = 196608  # 3.0
+
+
+class PostgresError(Exception):
+    """Server-reported error (ErrorResponse 'E')."""
+
+    def __init__(self, severity: str, code: str, message: str):
+        super().__init__(f"{severity} {code}: {message}")
+        self.severity = severity
+        self.code = code
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# Wire helpers
+# ---------------------------------------------------------------------------
+
+def _msg(type_byte: bytes, payload: bytes) -> bytes:
+    return type_byte + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\0"
+
+
+async def _read_message(stream: TcpStream) -> Tuple[bytes, bytes]:
+    """Read one typed backend/frontend message → (type, payload)."""
+    head = await stream.read_exact(5)
+    mtype = head[:1]
+    (length,) = struct.unpack("!I", head[1:5])
+    payload = await stream.read_exact(length - 4) if length > 4 else b""
+    return mtype, payload
+
+
+def _split_cstrs(buf: bytes) -> List[str]:
+    return [p.decode() for p in buf.split(b"\0")[:-1]]
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class Row(tuple):
+    """A result row; column access by index or, via .get, by name."""
+
+    def __new__(cls, values, columns):
+        row = super().__new__(cls, values)
+        row._columns = columns
+        return row
+
+    def get(self, name: str):
+        return self[self._columns.index(name)]
+
+
+class Connection:
+    """A connected PostgreSQL session (simple-query protocol)."""
+
+    def __init__(self, stream: TcpStream, parameters: Dict[str, str]):
+        self._stream = stream
+        self.parameters = parameters  # ParameterStatus values from startup
+        self._closed = False
+
+    async def query(self, sql: str) -> List[Row]:
+        """Run one simple query; returns data rows (empty for commands)."""
+        await self._stream.write_all(_msg(b"Q", _cstr(sql)))
+        columns: List[str] = []
+        rows: List[Row] = []
+        error: Optional[PostgresError] = None
+        while True:
+            mtype, payload = await _read_message(self._stream)
+            if mtype == b"T":  # RowDescription
+                (nfields,) = struct.unpack("!H", payload[:2])
+                off = 2
+                columns = []
+                for _ in range(nfields):
+                    end = payload.index(b"\0", off)
+                    columns.append(payload[off:end].decode())
+                    off = end + 1 + 18  # fixed per-field descriptor tail
+            elif mtype == b"D":  # DataRow
+                (ncols,) = struct.unpack("!H", payload[:2])
+                off = 2
+                values = []
+                for _ in range(ncols):
+                    (vlen,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if vlen < 0:
+                        values.append(None)
+                    else:
+                        values.append(payload[off:off + vlen].decode())
+                        off += vlen
+                rows.append(Row(values, columns))
+            elif mtype == b"C":  # CommandComplete
+                pass
+            elif mtype == b"E":  # ErrorResponse
+                fields = dict((chunk[0], chunk[1:]) for chunk in
+                              _split_cstrs(payload) if chunk)
+                error = PostgresError(fields.get("S", "ERROR"),
+                                      fields.get("C", "XX000"),
+                                      fields.get("M", "unknown"))
+            elif mtype == b"Z":  # ReadyForQuery — end of the response cycle
+                break
+            elif mtype in (b"S", b"N"):  # ParameterStatus / NoticeResponse
+                continue
+            else:
+                raise PostgresError("FATAL", "08P01",
+                                    f"unexpected message {mtype!r}")
+        if error is not None:
+            raise error
+        return rows
+
+    async def execute(self, sql: str) -> None:
+        await self.query(sql)
+
+    async def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                await self._stream.write_all(_msg(b"X", b""))
+            except (BrokenPipe, ConnectionReset):
+                pass
+            self._stream.close()
+
+
+async def connect(host: str, port: int = 5432, user: str = "postgres",
+                  database: str = "postgres") -> Connection:
+    """Open a connection: TCP connect + startup handshake."""
+    stream = await TcpStream.connect((host, port))
+    try:
+        params = _cstr("user") + _cstr(user) + _cstr("database") + _cstr(database) + b"\0"
+        startup = struct.pack("!II", len(params) + 8, PROTOCOL_VERSION) + params
+        await stream.write_all(startup)
+        parameters: Dict[str, str] = {}
+        while True:
+            mtype, payload = await _read_message(stream)
+            if mtype == b"R":
+                (auth,) = struct.unpack("!I", payload[:4])
+                if auth != 0:
+                    raise PostgresError("FATAL", "28000",
+                                        f"unsupported auth method {auth}")
+            elif mtype == b"S":
+                key, value = _split_cstrs(payload)[:2]
+                parameters[key] = value
+            elif mtype == b"K":  # BackendKeyData
+                pass
+            elif mtype == b"E":
+                fields = dict((c[0], c[1:]) for c in _split_cstrs(payload) if c)
+                raise PostgresError(fields.get("S", "FATAL"),
+                                    fields.get("C", "XX000"),
+                                    fields.get("M", "startup failed"))
+            elif mtype == b"Z":
+                return Connection(stream, parameters)
+            else:
+                raise PostgresError("FATAL", "08P01",
+                                    f"unexpected startup message {mtype!r}")
+    except BaseException:
+        # Failed handshakes must not leak simulated connections (retry loops
+        # in fault-injection workloads would accumulate them).
+        stream.close()
+        raise
+
+
+# ---------------------------------------------------------------------------
+# In-sim server (protocol-correct backend, toy table engine)
+# ---------------------------------------------------------------------------
+
+_CREATE = re.compile(r"^\s*CREATE\s+TABLE\s+(\w+)\s*\(([^)]*)\)\s*;?\s*$", re.I)
+_INSERT = re.compile(r"^\s*INSERT\s+INTO\s+(\w+)\s+VALUES\s*\((.*)\)\s*;?\s*$", re.I)
+_SELECT = re.compile(r"^\s*SELECT\s+(.+?)\s+FROM\s+(\w+)"
+                     r"(?:\s+WHERE\s+(\w+)\s*=\s*'([^']*)')?\s*;?\s*$", re.I)
+_DELETE = re.compile(r"^\s*DELETE\s+FROM\s+(\w+)"
+                     r"(?:\s+WHERE\s+(\w+)\s*=\s*'([^']*)')?\s*;?\s*$", re.I)
+
+
+class SimPostgresServer:
+    """A wire-protocol-correct PostgreSQL backend living inside the world."""
+
+    def __init__(self):
+        self.tables: Dict[str, Tuple[List[str], List[List[str]]]] = {}
+        self._listener: Optional[TcpListener] = None
+
+    async def serve(self, addr) -> None:
+        self._listener = await TcpListener.bind(addr)
+        while True:
+            try:
+                stream, _src = await self._listener.accept()
+            except ConnectionReset:
+                return
+            _task.spawn(self._session(stream))
+
+    def close(self) -> None:
+        if self._listener is not None:
+            self._listener.close()
+
+    # ------------------------------------------------------------------
+    async def _session(self, stream: TcpStream) -> None:
+        try:
+            head = await stream.read_exact(8)
+            (length, version) = struct.unpack("!II", head)
+            body = await stream.read_exact(length - 8) if length > 8 else b""
+            if version != PROTOCOL_VERSION:
+                await stream.write_all(self._error("FATAL", "0A000",
+                                                   f"unsupported protocol {version}"))
+                return
+            kv = _split_cstrs(body)
+            params = dict(zip(kv[::2], kv[1::2]))
+            out = _msg(b"R", struct.pack("!I", 0))                     # AuthenticationOk
+            out += _msg(b"S", _cstr("server_version") + _cstr("15.0-sim"))
+            out += _msg(b"S", _cstr("session_user") + _cstr(params.get("user", "")))
+            out += _msg(b"Z", b"I")                                    # ReadyForQuery
+            await stream.write_all(out)
+            while True:
+                mtype, payload = await _read_message(stream)
+                if mtype == b"X":
+                    return
+                if mtype != b"Q":
+                    await stream.write_all(self._error("ERROR", "0A000",
+                                                       f"unsupported message {mtype!r}")
+                                           + _msg(b"Z", b"I"))
+                    continue
+                sql = payload.rstrip(b"\0").decode()
+                await stream.write_all(self._run(sql) + _msg(b"Z", b"I"))
+        except (ConnectionReset, BrokenPipe):
+            return  # client vanished (crash / partition): session ends
+        finally:
+            stream.close()
+
+    # -- toy engine ----------------------------------------------------
+    def _run(self, sql: str) -> bytes:
+        if m := _CREATE.match(sql):
+            name, cols = m.group(1).lower(), [c.strip().split()[0].lower()
+                                             for c in m.group(2).split(",")]
+            if name in self.tables:
+                return self._error("ERROR", "42P07", f'table "{name}" exists')
+            self.tables[name] = (cols, [])
+            return self._complete("CREATE TABLE")
+        if m := _INSERT.match(sql):
+            name = m.group(1).lower()
+            if name not in self.tables:
+                return self._error("ERROR", "42P01", f'no table "{name}"')
+            cols, data = self.tables[name]
+            values = [v.strip().strip("'") for v in m.group(2).split(",")]
+            if len(values) != len(cols):
+                return self._error("ERROR", "42601",
+                                   f"expected {len(cols)} values")
+            data.append(values)
+            return self._complete("INSERT 0 1")
+        if m := _SELECT.match(sql):
+            want, name = m.group(1), m.group(2).lower()
+            if name not in self.tables:
+                return self._error("ERROR", "42P01", f'no table "{name}"')
+            cols, data = self.tables[name]
+            out_cols = cols if want.strip() == "*" else \
+                [c.strip().lower() for c in want.split(",")]
+            for c in out_cols:
+                if c not in cols:
+                    return self._error("ERROR", "42703", f'no column "{c}"')
+            rows = self._filter(cols, data, m.group(3), m.group(4))
+            proj = [[row[cols.index(c)] for c in out_cols] for row in rows]
+            return self._rowset(out_cols, proj)
+        if m := _DELETE.match(sql):
+            name = m.group(1).lower()
+            if name not in self.tables:
+                return self._error("ERROR", "42P01", f'no table "{name}"')
+            cols, data = self.tables[name]
+            keep = [r for r in data
+                    if r not in self._filter(cols, data, m.group(2), m.group(3))]
+            removed = len(data) - len(keep)
+            self.tables[name] = (cols, keep)
+            return self._complete(f"DELETE {removed}")
+        return self._error("ERROR", "42601", f"syntax error: {sql[:40]!r}")
+
+    @staticmethod
+    def _filter(cols, data, where_col, where_val):
+        if where_col is None:
+            return list(data)
+        idx = cols.index(where_col.lower()) if where_col.lower() in cols else None
+        if idx is None:
+            return []
+        return [r for r in data if r[idx] == where_val]
+
+    # -- response builders ---------------------------------------------
+    @staticmethod
+    def _rowset(columns: List[str], rows: List[List[str]]) -> bytes:
+        desc = struct.pack("!H", len(columns))
+        for col in columns:
+            # name, table oid, attnum, type oid (25=text), typlen, typmod, fmt
+            desc += _cstr(col) + struct.pack("!IHIhih", 0, 0, 25, -1, -1, 0)
+        out = _msg(b"T", desc)
+        for row in rows:
+            body = struct.pack("!H", len(row))
+            for val in row:
+                raw = val.encode()
+                body += struct.pack("!i", len(raw)) + raw
+            out += _msg(b"D", body)
+        return out + SimPostgresServer._complete(f"SELECT {len(rows)}")
+
+    @staticmethod
+    def _complete(tag: str) -> bytes:
+        return _msg(b"C", _cstr(tag))
+
+    @staticmethod
+    def _error(severity: str, code: str, message: str) -> bytes:
+        body = _cstr("S" + severity) + _cstr("C" + code) + _cstr("M" + message) + b"\0"
+        return _msg(b"E", body)

@@ -1,0 +1,415 @@
+"""Tests for the ecosystem shims (asyncio, gRPC, postgres)."""
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import task, time
+from madsim_tpu.shims import aio, grpc_sim, postgres
+
+
+# ---------------------------------------------------------------------------
+# aio: asyncio-shaped surface
+# ---------------------------------------------------------------------------
+
+def test_aio_surface_runs_in_sim():
+    async def main():
+        q = aio.Queue()
+        ev = aio.Event()
+        results = []
+
+        async def producer():
+            for i in range(3):
+                await aio.sleep(0.01)
+                await q.put(i)
+            ev.set()
+            return "done"
+
+        t = aio.create_task(producer())
+        await ev.wait()
+        while not q.empty():
+            results.append(q.get_nowait())
+        assert await t == "done"
+        got = await aio.gather(aio.sleep(0.01, result="a"),
+                               aio.sleep(0.02, result="b"))
+        assert got == ["a", "b"]
+        with pytest.raises(TimeoutError):
+            await aio.wait_for(aio.sleep(10), timeout=0.05)
+        return results
+
+    assert ms.run(main(), seed=1) == [0, 1, 2]
+
+
+def test_aio_task_exception_contained():
+    async def main():
+        async def boom():
+            await aio.sleep(0.01)
+            raise ValueError("boom")
+
+        t = aio.create_task(boom())
+        with pytest.raises(ValueError):
+            await t
+        assert isinstance(t.exception(), ValueError)
+        # gather with return_exceptions
+        got = await aio.gather(boom(), aio.sleep(0, result=1),
+                               return_exceptions=True)
+        assert isinstance(got[0], ValueError) and got[1] == 1
+        return "survived"
+
+    assert ms.run(main(), seed=2) == "survived"
+
+
+def test_aio_task_cancel():
+    async def main():
+        hits = []
+
+        async def worker():
+            while True:
+                await aio.sleep(0.01)
+                hits.append(1)
+
+        t = aio.create_task(worker())
+        await aio.sleep(0.055)
+        assert t.cancel()
+        assert t.done() and t.cancelled()
+        n = len(hits)
+        await aio.sleep(0.05)
+        assert len(hits) == n  # really stopped
+        return True
+
+    assert ms.run(main(), seed=3)
+
+
+# ---------------------------------------------------------------------------
+# aio: interpreter-level patching (the libc-interception analog)
+# ---------------------------------------------------------------------------
+
+def unmodified_asyncio_app():
+    """Written purely against stdlib asyncio/random/time."""
+    import asyncio
+    import random
+    import time as wall
+
+    async def app():
+        t0 = wall.monotonic()
+        out = []
+
+        async def worker(i):
+            await asyncio.sleep(random.uniform(0.01, 0.05))
+            out.append((i, round(wall.monotonic() - t0, 6), wall.time()))
+
+        tasks = [asyncio.create_task(worker(i)) for i in range(4)]
+        await asyncio.gather(*tasks)
+        return out
+
+    return app()
+
+
+def test_patched_runs_unmodified_asyncio_code_deterministically():
+    with aio.patched():
+        a = ms.run(unmodified_asyncio_app(), seed=7)
+        b = ms.run(unmodified_asyncio_app(), seed=7)
+        c = ms.run(unmodified_asyncio_app(), seed=8)
+    assert a == b            # same seed ⇒ bit-identical schedule & clocks
+    assert a != c            # different seed ⇒ different world
+    # virtual wall-clock base is the seed-randomized 2022 range
+    years = {int(row[2] // (365.25 * 24 * 3600)) + 1970 for row in a}
+    assert years <= {2022, 2023}
+
+
+def test_patched_randrange_respects_step():
+    async def main():
+        import random
+
+        return [random.randrange(0, 100, 5) for _ in range(32)]
+
+    with aio.patched():
+        vals = ms.run(main(), seed=13)
+    assert all(v % 5 == 0 and 0 <= v < 100 for v in vals)
+    assert len(set(vals)) > 3
+
+
+def test_patched_queue_empty_is_asyncio_exception():
+    async def main():
+        import asyncio
+
+        q = asyncio.Queue()
+        try:
+            q.get_nowait()
+        except asyncio.QueueEmpty:
+            return "caught"
+
+    with aio.patched():
+        assert ms.run(main(), seed=14) == "caught"
+
+
+def test_patched_falls_through_outside_sim():
+    import random
+    import time as wall
+
+    with aio.patched():
+        # Outside a simulation the patched functions hit the real impls.
+        assert wall.time() > 1.5e9
+        v = random.random()
+        assert 0.0 <= v < 1.0
+    # After uninstall the originals are restored.
+    assert wall.time.__module__ == "time" or callable(wall.time)
+
+
+# ---------------------------------------------------------------------------
+# gRPC shim
+# ---------------------------------------------------------------------------
+
+class Greeter:
+    SERVICE_NAME = "helloworld.Greeter"
+
+    @grpc_sim.unary
+    async def SayHello(self, request, context):
+        if request == "error":
+            raise grpc_sim.Status(grpc_sim.StatusCode.INVALID_ARGUMENT, "bad name")
+        return f"Hello {request}! ({context.peer().split(':')[0]})"
+
+    @grpc_sim.server_streaming
+    async def LotsOfReplies(self, request, context):
+        for i in range(3):
+            await time.sleep(0.01)
+            yield f"{request}-{i}"
+
+    @grpc_sim.client_streaming
+    async def LotsOfGreetings(self, requests, context):
+        names = [r async for r in requests]
+        return f"Hello {', '.join(names)}!"
+
+    @grpc_sim.bidi
+    async def BidiHello(self, requests, context):
+        async for r in requests:
+            yield f"echo:{r}"
+
+
+def _grpc_world(client_body):
+    async def main():
+        h = ms.Handle.current()
+        server = grpc_sim.Server().add_service(Greeter())
+
+        async def serve():
+            await server.serve(("10.0.0.1", 50051))
+
+        h.create_node(name="server", ip="10.0.0.1", init=serve)
+        result = ms.sync.SimFuture()
+
+        async def client():
+            ch = await grpc_sim.Channel.connect(("10.0.0.1", 50051))
+            try:
+                result.set_result(await client_body(ch))
+            except BaseException as exc:  # noqa: BLE001
+                result.set_exception(exc)
+
+        h.create_node(name="client", ip="10.0.0.2", init=client)
+        return await time.timeout(30, _await(result))
+
+    return ms.run(main(), seed=11)
+
+
+async def _await(fut):
+    return await fut
+
+
+def test_grpc_unary():
+    async def body(ch):
+        return await ch.unary("/helloworld.Greeter/SayHello", "world")
+
+    assert _grpc_world(body) == "Hello world! (10.0.0.2)"
+
+
+def test_grpc_unary_error_status():
+    async def body(ch):
+        with pytest.raises(grpc_sim.Status) as ei:
+            await ch.unary("/helloworld.Greeter/SayHello", "error")
+        return ei.value.code
+
+    assert _grpc_world(body) == grpc_sim.StatusCode.INVALID_ARGUMENT
+
+
+def test_grpc_unknown_path():
+    async def body(ch):
+        with pytest.raises(grpc_sim.Status) as ei:
+            await ch.unary("/helloworld.Greeter/Nope", "x")
+        return ei.value.code
+
+    assert _grpc_world(body) == grpc_sim.StatusCode.UNIMPLEMENTED
+
+
+def test_grpc_server_streaming():
+    async def body(ch):
+        return [r async for r in
+                ch.server_streaming("/helloworld.Greeter/LotsOfReplies", "s")]
+
+    assert _grpc_world(body) == ["s-0", "s-1", "s-2"]
+
+
+def test_grpc_client_streaming():
+    async def body(ch):
+        async def names():
+            for n in ["alice", "bob"]:
+                await time.sleep(0.01)
+                yield n
+
+        return await ch.client_streaming("/helloworld.Greeter/LotsOfGreetings",
+                                         names())
+
+    assert _grpc_world(body) == "Hello alice, bob!"
+
+
+def test_grpc_bidi():
+    async def body(ch):
+        async def reqs():
+            for n in range(3):
+                yield n
+
+        return [r async for r in ch.bidi("/helloworld.Greeter/BidiHello", reqs())]
+
+    assert _grpc_world(body) == ["echo:0", "echo:1", "echo:2"]
+
+
+def test_grpc_end_sentinel_payload_not_truncating():
+    # A user payload equal to the internal ("end", None) terminator must
+    # cross the stream intact (requests are framed, not sent raw).
+    async def body(ch):
+        async def reqs():
+            yield ("end", None)
+            yield "after"
+
+        return [r async for r in ch.bidi("/helloworld.Greeter/BidiHello", reqs())]
+
+    assert _grpc_world(body) == ["echo:('end', None)", "echo:after"]
+
+
+def test_grpc_connection_refused():
+    async def body(ch):
+        with pytest.raises(grpc_sim.Status) as ei:
+            await ch.unary("/x/y", "z")
+        return ei.value.code
+
+    async def main():
+        h = ms.Handle.current()
+        result = ms.sync.SimFuture()
+
+        async def client():
+            ch = grpc_sim.Channel(await __import__("madsim_tpu").net.Endpoint.bind("0.0.0.0:0"),
+                                  ("10.9.9.9", 1))
+            try:
+                result.set_result(await body(ch))
+            except BaseException as exc:  # noqa: BLE001
+                result.set_exception(exc)
+
+        h.create_node(name="client", ip="10.0.0.2", init=client)
+        return await time.timeout(30, _await(result))
+
+    assert ms.run(main(), seed=12) == grpc_sim.StatusCode.UNAVAILABLE
+
+
+@ms.test(seed=1, count=5, time_limit=300)
+async def test_grpc_survives_server_restart():
+    """tonic-example client_crash analog: restart the *server* under load."""
+    h = ms.Handle.current()
+
+    async def serve():
+        # A fresh Server per incarnation (the old one died with the node).
+        srv = grpc_sim.Server().add_service(Greeter())
+        await srv.serve(("10.0.0.1", 50051))
+
+    server_node = h.create_node(name="server", ip="10.0.0.1", init=serve)
+    progress = []
+
+    async def client():
+        ch = await grpc_sim.Channel.connect(("10.0.0.1", 50051))
+        while True:
+            try:
+                rsp = await time.timeout(
+                    1.0, ch.unary("/helloworld.Greeter/SayHello", "chaos"))
+                progress.append(rsp)
+            except (grpc_sim.Status, TimeoutError):
+                await time.sleep(0.05)
+
+    h.create_node(name="client", ip="10.0.0.2", init=client)
+
+    for _ in range(3):
+        await time.sleep(ms.rand.thread_rng().gen_range_f64(0.5, 1.5))
+        h.restart(server_node)
+    await time.sleep(2.0)
+    assert len(progress) > 5  # made progress across restarts
+
+
+# ---------------------------------------------------------------------------
+# postgres shim
+# ---------------------------------------------------------------------------
+
+def _pg_world(client_body, seed=21):
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await postgres.SimPostgresServer().serve(("10.0.0.1", 5432))
+
+        h.create_node(name="db", ip="10.0.0.1", init=serve)
+        result = ms.sync.SimFuture()
+
+        async def client():
+            await time.sleep(0.1)  # let the server bind
+            conn = await postgres.connect("10.0.0.1", 5432, user="app")
+            try:
+                result.set_result(await client_body(conn))
+            except BaseException as exc:  # noqa: BLE001
+                result.set_exception(exc)
+            finally:
+                await conn.close()
+
+        h.create_node(name="app", ip="10.0.0.2", init=client)
+        return await time.timeout(60, _await(result))
+
+    return ms.run(main(), seed=seed)
+
+
+def test_postgres_roundtrip():
+    async def body(conn):
+        assert conn.parameters["server_version"] == "15.0-sim"
+        await conn.execute("CREATE TABLE users (id, name)")
+        await conn.execute("INSERT INTO users VALUES ('1', 'ada')")
+        await conn.execute("INSERT INTO users VALUES ('2', 'grace')")
+        rows = await conn.query("SELECT * FROM users")
+        assert [tuple(r) for r in rows] == [("1", "ada"), ("2", "grace")]
+        rows = await conn.query("SELECT name FROM users WHERE id = '2'")
+        assert rows[0].get("name") == "grace"
+        await conn.execute("DELETE FROM users WHERE id = '1'")
+        rows = await conn.query("SELECT * FROM users")
+        return [tuple(r) for r in rows]
+
+    assert _pg_world(body) == [("2", "grace")]
+
+
+def test_postgres_errors():
+    async def body(conn):
+        with pytest.raises(postgres.PostgresError) as ei:
+            await conn.query("SELECT * FROM nope")
+        assert ei.value.code == "42P01"
+        with pytest.raises(postgres.PostgresError):
+            await conn.query("THIS IS NOT SQL")
+        # the connection stays usable after errors (ReadyForQuery resync)
+        await conn.execute("CREATE TABLE t (a)")
+        await conn.execute("INSERT INTO t VALUES ('x')")
+        return len(await conn.query("SELECT * FROM t"))
+
+    assert _pg_world(body) == 1
+
+
+def test_postgres_deterministic_same_seed():
+    async def body(conn):
+        await conn.execute("CREATE TABLE t (a)")
+        for i in range(5):
+            await conn.execute(f"INSERT INTO t VALUES ('{i}')")
+        rows = await conn.query("SELECT * FROM t")
+        return (len(rows), time.monotonic())
+
+    a = _pg_world(body, seed=33)
+    b = _pg_world(body, seed=33)
+    c = _pg_world(body, seed=34)
+    assert a == b
+    assert a != c  # different schedule/latency draws
