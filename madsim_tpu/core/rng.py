@@ -18,7 +18,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from ..ops.threefry import derive_stream_np, draw_np, seed_to_key
+from ..ops.threefry import derive_stream_np, seed_to_key, threefry2x32_scalar
 
 # Named stream ids. The host engine draws everything from GLOBAL (matching the
 # reference's single SmallRng); the device engine uses per-purpose streams.
@@ -38,9 +38,15 @@ class GlobalRng:
     def __init__(self, seed: int, stream: int = STREAM_GLOBAL):
         self.seed = seed & ((1 << 64) - 1)
         k0, k1 = seed_to_key(self.seed)
-        self._k0, self._k1 = derive_stream_np(k0, k1, stream)
+        dk0, dk1 = derive_stream_np(k0, k1, stream)
+        self._k0, self._k1 = int(dk0), int(dk1)
         self._counter = 0
         self._buf: Optional[int] = None
+        # Draw backend: native C++ core when built, else scalar Python —
+        # both bit-exact with the numpy/jax array paths.
+        from .. import native as _native
+
+        self._lib = _native.get_lib()
         # Determinism checker state (`rand.rs:84-107`): in 'log' mode every
         # access appends hash(value ^ hash(elapsed)); in 'check' mode accesses
         # are compared against the recorded log and the first divergence panics
@@ -86,21 +92,30 @@ class GlobalRng:
             self._check_pos += 1
 
     # -- raw draws ---------------------------------------------------------
+    def _draw(self) -> int:
+        """One u64 Threefry block at the current counter."""
+        if self._lib is not None:
+            v = self._lib.threefry_draw(self._k0, self._k1, self._counter)
+        else:
+            x0, x1 = threefry2x32_scalar(
+                self._k0, self._k1,
+                self._counter & 0xFFFFFFFF, self._counter >> 32)
+            v = (x1 << 32) | x0
+        self._counter += 1
+        return v
+
     def next_u32(self) -> int:
         if self._buf is not None:
             v, self._buf = self._buf, None
         else:
-            x0, x1 = draw_np(self._k0, self._k1, self._counter)
-            self._counter += 1
-            v, self._buf = int(x0), int(x1)
+            block = self._draw()
+            v, self._buf = block & 0xFFFFFFFF, block >> 32
         self._observe(v)
         return v
 
     def next_u64(self) -> int:
-        x0, x1 = draw_np(self._k0, self._k1, self._counter)
-        self._counter += 1
+        v = self._draw()
         self._buf = None
-        v = (int(x1) << 32) | int(x0)
         self._observe(v)
         return v
 

@@ -11,12 +11,17 @@ Host implementation: a binary heap keyed by (deadline_ns, seq). Timer handles
 support cancellation (a dropped Sleep must not fire its waker). Time is kept
 as integer nanoseconds (Python ints — unbounded, no overflow); the public API
 speaks float seconds.
+
+Two interchangeable heap backends with identical ordering semantics: the C++
+native core (native/madsim_core.cpp, the reference's ⚙ naive_timer analog)
+when built, else Python heapq.
 """
 from __future__ import annotations
 
 import heapq
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import native as _native
 from .rng import GlobalRng, STREAM_TIME_BASE
 
 NANOS_PER_SEC = 1_000_000_000
@@ -44,6 +49,22 @@ class TimerEntry:
         return (self.deadline_ns, self.seq) < (other.deadline_ns, other.seq)
 
 
+class _NativeTimerEntry:
+    """Cancellation handle for a timer living in the native heap."""
+
+    __slots__ = ("seq", "_wheel")
+
+    def __init__(self, seq: int, wheel: "TimeRuntime"):
+        self.seq = seq
+        self._wheel = wheel
+
+    def cancel(self) -> None:
+        # Only mark live timers: cancelling after the pop (timeout's finally
+        # path) must not grow the native cancelled-set unboundedly.
+        if self._wheel._native_callbacks.pop(self.seq, None) is not None:
+            self._wheel._native_heap.cancel(self.seq)
+
+
 class TimeRuntime:
     """Simulated clock + timer wheel driven by the executor loop."""
 
@@ -55,6 +76,9 @@ class TimeRuntime:
         self.elapsed_ns = 0
         self._heap: List[TimerEntry] = []
         self._seq = 0
+        lib = _native.get_lib()
+        self._native_heap = _native.NativeTimerHeap(lib) if lib is not None else None
+        self._native_callbacks: Dict[int, Callable[[], None]] = {}
 
     # -- clock reads -------------------------------------------------------
     def now_ns(self) -> int:
@@ -71,16 +95,24 @@ class TimeRuntime:
         self.elapsed_ns += delta_ns
 
     # -- timers ------------------------------------------------------------
-    def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]) -> TimerEntry:
-        entry = TimerEntry(max(deadline_ns, self.elapsed_ns), self._seq, callback)
+    def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]):
+        deadline_ns = max(deadline_ns, self.elapsed_ns)
+        seq = self._seq
         self._seq += 1
+        if self._native_heap is not None:
+            self._native_heap.push(deadline_ns, seq)
+            self._native_callbacks[seq] = callback
+            return _NativeTimerEntry(seq, self)
+        entry = TimerEntry(deadline_ns, seq, callback)
         heapq.heappush(self._heap, entry)
         return entry
 
-    def add_timer(self, delay_ns: int, callback: Callable[[], None]) -> TimerEntry:
+    def add_timer(self, delay_ns: int, callback: Callable[[], None]):
         return self.add_timer_at(self.elapsed_ns + max(0, delay_ns), callback)
 
     def next_deadline_ns(self) -> Optional[int]:
+        if self._native_heap is not None:
+            return self._native_heap.peek()
         while self._heap and self._heap[0].cancelled:
             heapq.heappop(self._heap)
         return self._heap[0].deadline_ns if self._heap else None
@@ -97,6 +129,12 @@ class TimeRuntime:
         return True
 
     def _fire_due(self) -> None:
+        if self._native_heap is not None:
+            while (seq := self._native_heap.pop_due(self.elapsed_ns)) is not None:
+                cb = self._native_callbacks.pop(seq, None)
+                if cb is not None:
+                    cb()
+            return
         while self._heap:
             head = self._heap[0]
             if head.cancelled:

@@ -86,6 +86,28 @@ def threefry2x32_jax(k0, k1, c0, c1):
 # — a pure function, identical on host and device.
 # ---------------------------------------------------------------------------
 
+_M = 0xFFFFFFFF
+
+
+def threefry2x32_scalar(k0: int, k1: int, c0: int, c1: int):
+    """Threefry-2x32 (20 rounds) on plain Python ints — bit-exact with the
+    numpy/jax versions, and much faster than numpy for one block at a time
+    (the host engine's draw pattern). The C++ native core (native/
+    madsim_core.cpp) supersedes this when built."""
+    x0 = (c0 + k0) & _M
+    x1 = (c1 + k1) & _M
+    ks = (k0, k1, k0 ^ k1 ^ _PARITY)
+    for i in range(5):
+        for r in range(4):
+            x0 = (x0 + x1) & _M
+            rot = _ROTATIONS[4 * (i % 2) + r]
+            x1 = ((x1 << rot) & _M) | (x1 >> (32 - rot))
+            x1 ^= x0
+        x0 = (x0 + ks[(i + 1) % 3]) & _M
+        x1 = (x1 + ks[(i + 2) % 3] + i + 1) & _M
+    return x0, x1
+
+
 def seed_to_key(seed: int):
     """Split a u64 seed into a (k0, k1) uint32 pair."""
     seed &= (1 << 64) - 1

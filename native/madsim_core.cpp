@@ -1,0 +1,268 @@
+// Native host-engine core for madsim_tpu.
+//
+// The reference's performance-critical host components are native Rust
+// (SURVEY §2 ⚙): the Threefry-equivalent seeded RNG (madsim/src/sim/rand.rs),
+// the timer wheel (time/mod.rs via naive_timer), and the scheduler's random
+// ready-pick (utils/mpsc.rs:73-83). This file provides the same kernels,
+// exposed two ways from one translation unit:
+//
+//   1. a plain C ABI (the ms_* functions) for non-Python consumers/tests;
+//   2. a CPython extension module (`_core`) — the hot path. The C API is
+//      used rather than ctypes because per-call marshalling overhead of
+//      ctypes (~µs) exceeds the kernels' own cost and made the "native"
+//      path slower than pure Python.
+//
+// Pure-Python fallbacks exist for every function here
+// (madsim_tpu/native/__init__.py chooses at import); bit-exactness contract:
+// threefry2x32 must match ops/threefry.py's numpy and jax implementations
+// word-for-word (tested in tests/test_native.py), since host and device
+// engines share RNG streams.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -I<python-include> \
+//            -o _core.so madsim_core.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threefry-2x32, 20 rounds (Random123) — must match ops/threefry.py.
+// ---------------------------------------------------------------------------
+
+static const unsigned ROT[8] = {13, 15, 26, 6, 17, 29, 16, 24};
+
+static inline uint32_t rotl32(uint32_t x, unsigned r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline void threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0,
+                                uint32_t c1, uint32_t* out0, uint32_t* out1) {
+  uint32_t x0 = c0 + k0;
+  uint32_t x1 = c1 + k1;
+  uint32_t ks[3] = {k0, k1, k0 ^ k1 ^ 0x1BD11BDAu};
+  for (unsigned i = 0; i < 5; ++i) {
+    for (unsigned r = 0; r < 4; ++r) {
+      x0 += x1;
+      x1 = rotl32(x1, ROT[4 * (i % 2) + r]);
+      x1 ^= x0;
+    }
+    x0 += ks[(i + 1) % 3];
+    x1 += ks[(i + 2) % 3] + (uint32_t)(i + 1);
+  }
+  *out0 = x0;
+  *out1 = x1;
+}
+
+// Single draw of counter block `counter` → (x1 << 32) | x0, like draw_np.
+uint64_t ms_threefry_draw(uint32_t k0, uint32_t k1, uint64_t counter) {
+  uint32_t x0, x1;
+  threefry2x32(k0, k1, (uint32_t)(counter & 0xFFFFFFFFu),
+               (uint32_t)(counter >> 32), &x0, &x1);
+  return ((uint64_t)x1 << 32) | (uint64_t)x0;
+}
+
+// Derive a stream key (derive_stream_np): encrypt the stream id.
+uint64_t ms_derive_stream(uint32_t k0, uint32_t k1, uint64_t stream) {
+  return ms_threefry_draw(k0, k1, stream);
+}
+
+// Batch draw for bulk consumers (fault-schedule generation etc.).
+void ms_threefry_batch(uint32_t k0, uint32_t k1, uint64_t start_counter,
+                       uint64_t n, uint64_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    out[i] = ms_threefry_draw(k0, k1, start_counter + i);
+}
+
+// ---------------------------------------------------------------------------
+// Timer wheel: binary min-heap of (deadline_ns, seq) with lazy cancellation.
+// Mirrors core/timewheel.py TimeRuntime semantics exactly.
+// ---------------------------------------------------------------------------
+
+struct TimerEntry {
+  int64_t deadline_ns;
+  uint64_t seq;
+  bool operator>(const TimerEntry& o) const {
+    if (deadline_ns != o.deadline_ns) return deadline_ns > o.deadline_ns;
+    return seq > o.seq;
+  }
+};
+
+struct TimerHeap {
+  std::priority_queue<TimerEntry, std::vector<TimerEntry>,
+                      std::greater<TimerEntry>>
+      heap;
+  std::unordered_set<uint64_t> cancelled;
+};
+
+void* ms_timerheap_new() { return new TimerHeap(); }
+
+void ms_timerheap_free(void* h) { delete (TimerHeap*)h; }
+
+// All accessors tolerate a null handle (the Python wrapper passes None after
+// free during teardown races) by treating it as an empty heap.
+void ms_timerheap_push(void* h, int64_t deadline_ns, uint64_t seq) {
+  if (h) ((TimerHeap*)h)->heap.push(TimerEntry{deadline_ns, seq});
+}
+
+void ms_timerheap_cancel(void* h, uint64_t seq) {
+  if (h) ((TimerHeap*)h)->cancelled.insert(seq);
+}
+
+// Earliest live deadline → 1 and *deadline set; 0 if empty.
+int ms_timerheap_peek(void* h, int64_t* deadline_ns) {
+  auto* th = (TimerHeap*)h;
+  if (!th) return 0;
+  while (!th->heap.empty()) {
+    const TimerEntry& top = th->heap.top();
+    auto it = th->cancelled.find(top.seq);
+    if (it != th->cancelled.end()) {
+      th->cancelled.erase(it);
+      th->heap.pop();
+      continue;
+    }
+    *deadline_ns = top.deadline_ns;
+    return 1;
+  }
+  return 0;
+}
+
+// Pop the earliest live entry if deadline <= now → 1 and *seq set; else 0.
+int ms_timerheap_pop_due(void* h, int64_t now_ns, uint64_t* seq) {
+  auto* th = (TimerHeap*)h;
+  if (!th) return 0;
+  int64_t deadline;
+  while (ms_timerheap_peek(h, &deadline)) {
+    if (deadline > now_ns) return 0;
+    *seq = th->heap.top().seq;
+    th->heap.pop();
+    return 1;
+  }
+  return 0;
+}
+
+uint64_t ms_timerheap_len(void* h) {
+  return h ? ((TimerHeap*)h)->heap.size() : 0;
+}
+
+// ---------------------------------------------------------------------------
+// Seeded random ready-pick (utils/mpsc.rs:73-83 analog): uniform index from
+// one RNG draw, matching GlobalRng.gen_range's modulo method so Python and
+// native scheduling decisions are interchangeable.
+// ---------------------------------------------------------------------------
+
+uint64_t ms_pick_index(uint32_t k0, uint32_t k1, uint64_t counter,
+                       uint64_t len) {
+  return ms_threefry_draw(k0, k1, counter) % len;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// CPython extension module bindings (the fast path).
+// ===========================================================================
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static void heap_capsule_destructor(PyObject* capsule) {
+  void* h = PyCapsule_GetPointer(capsule, "madsim.TimerHeap");
+  if (h) ms_timerheap_free(h);
+}
+
+static TimerHeap* heap_from(PyObject* capsule) {
+  return (TimerHeap*)PyCapsule_GetPointer(capsule, "madsim.TimerHeap");
+}
+
+static PyObject* py_threefry_draw(PyObject*, PyObject* args) {
+  unsigned int k0, k1;
+  unsigned long long counter;
+  if (!PyArg_ParseTuple(args, "IIK", &k0, &k1, &counter)) return nullptr;
+  return PyLong_FromUnsignedLongLong(ms_threefry_draw(k0, k1, counter));
+}
+
+static PyObject* py_derive_stream(PyObject*, PyObject* args) {
+  unsigned int k0, k1;
+  unsigned long long stream;
+  if (!PyArg_ParseTuple(args, "IIK", &k0, &k1, &stream)) return nullptr;
+  return PyLong_FromUnsignedLongLong(ms_derive_stream(k0, k1, stream));
+}
+
+static PyObject* py_heap_new(PyObject*, PyObject*) {
+  return PyCapsule_New(ms_timerheap_new(), "madsim.TimerHeap",
+                       heap_capsule_destructor);
+}
+
+static PyObject* py_heap_push(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  long long deadline;
+  unsigned long long seq;
+  if (!PyArg_ParseTuple(args, "OLK", &capsule, &deadline, &seq)) return nullptr;
+  TimerHeap* h = heap_from(capsule);
+  if (!h) return nullptr;
+  ms_timerheap_push(h, deadline, seq);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_heap_cancel(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  unsigned long long seq;
+  if (!PyArg_ParseTuple(args, "OK", &capsule, &seq)) return nullptr;
+  TimerHeap* h = heap_from(capsule);
+  if (!h) return nullptr;
+  ms_timerheap_cancel(h, seq);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_heap_peek(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  TimerHeap* h = heap_from(capsule);
+  if (!h) return nullptr;
+  int64_t deadline;
+  if (!ms_timerheap_peek(h, &deadline)) Py_RETURN_NONE;
+  return PyLong_FromLongLong(deadline);
+}
+
+static PyObject* py_heap_pop_due(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  long long now;
+  if (!PyArg_ParseTuple(args, "OL", &capsule, &now)) return nullptr;
+  TimerHeap* h = heap_from(capsule);
+  if (!h) return nullptr;
+  uint64_t seq;
+  if (!ms_timerheap_pop_due(h, now, &seq)) Py_RETURN_NONE;
+  return PyLong_FromUnsignedLongLong(seq);
+}
+
+static PyObject* py_heap_len(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  TimerHeap* h = heap_from(capsule);
+  if (!h) return nullptr;
+  return PyLong_FromUnsignedLongLong(ms_timerheap_len(h));
+}
+
+static PyMethodDef core_methods[] = {
+    {"threefry_draw", py_threefry_draw, METH_VARARGS,
+     "threefry_draw(k0, k1, counter) -> u64 block (x1<<32|x0)"},
+    {"derive_stream", py_derive_stream, METH_VARARGS,
+     "derive_stream(k0, k1, stream) -> u64 derived key"},
+    {"heap_new", py_heap_new, METH_NOARGS, "new timer heap capsule"},
+    {"heap_push", py_heap_push, METH_VARARGS, "push(heap, deadline_ns, seq)"},
+    {"heap_cancel", py_heap_cancel, METH_VARARGS, "cancel(heap, seq)"},
+    {"heap_peek", py_heap_peek, METH_VARARGS,
+     "peek(heap) -> earliest live deadline_ns | None"},
+    {"heap_pop_due", py_heap_pop_due, METH_VARARGS,
+     "pop_due(heap, now_ns) -> seq | None"},
+    {"heap_len", py_heap_len, METH_VARARGS, "len(heap)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef core_module = {PyModuleDef_HEAD_INIT, "_core",
+                                         "madsim_tpu native host core",
+                                         -1, core_methods};
+
+PyMODINIT_FUNC PyInit__core(void) { return PyModule_Create(&core_module); }
