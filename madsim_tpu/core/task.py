@@ -50,7 +50,11 @@ class NodeInfo:
         self.cores = cores
         self.killed = False
         self.paused = False
-        self.tasks: set = set()
+        # Ordered set (dict keys): kill() iterates this to drop tasks, and
+        # drop runs coroutine finally-blocks with visible side effects. A
+        # plain set would iterate in address order — nondeterministic across
+        # processes — breaking the same-seed-same-trajectory contract.
+        self.tasks: Dict["Task", None] = {}
         self.paused_tasks: List["Task"] = []
         self.restarted_count = restarted_count
 
@@ -73,7 +77,7 @@ class Task:
         self.cancelled = False
         self._scheduled = False
         self._finished = False
-        node.tasks.add(self)
+        node.tasks[self] = None
 
     @property
     def done(self) -> bool:
@@ -94,7 +98,7 @@ class Task:
             # a task killing its own node. Either way the reference's Rust
             # drop would not run it further; we just abandon it.
             pass
-        self.node.tasks.discard(self)
+        self.node.tasks.pop(self, None)
         self.join_future.set_exception(Cancelled())
 
 
@@ -258,13 +262,13 @@ class Executor:
             yielded = task.coro.send(None)
         except StopIteration as stop:
             task._finished = True
-            task.node.tasks.discard(task)
+            task.node.tasks.pop(task, None)
             task.join_future.set_result(stop.value)
         except Cancelled:
             task.drop()
         except BaseException as exc:  # noqa: BLE001 — any task failure fails the sim
             task._finished = True
-            task.node.tasks.discard(task)
+            task.node.tasks.pop(task, None)
             task.join_future.set_exception(exc)
             self._uncaught = exc
         else:
@@ -276,7 +280,7 @@ class Executor:
                     "simulation task"
                 )
                 task._finished = True
-                task.node.tasks.discard(task)
+                task.node.tasks.pop(task, None)
                 task.join_future.set_exception(err)
                 self._uncaught = err
                 return

@@ -96,7 +96,11 @@ class TimeRuntime:
 
     # -- timers ------------------------------------------------------------
     def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]):
-        deadline_ns = max(deadline_ns, self.elapsed_ns)
+        # Clamp before the backend split: the native heap stores int64
+        # deadlines while Python ints are unbounded, and both backends must
+        # fire an over-range timer at the *same* (clamped) virtual time or
+        # determinism logs recorded on one backend fail replay on the other.
+        deadline_ns = min(max(deadline_ns, self.elapsed_ns), 2**63 - 1)
         seq = self._seq
         self._seq += 1
         if self._native_heap is not None:

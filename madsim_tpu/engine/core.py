@@ -150,6 +150,10 @@ class DeviceEngine:
         self.step = jax.jit(jax.vmap(self._step_one))
         self._run_steps = jax.jit(self._run_steps_impl, static_argnums=1)
         self._run = jax.jit(self._run_impl, static_argnums=1)
+        # Built once: jit's own cache keys on the fault-array shape, so
+        # repeated init() calls (and every sweep) reuse the compilation
+        # instead of paying a fresh trace per call.
+        self._init_batched = jax.jit(jax.vmap(self._init_one))
 
     # ------------------------------------------------------------------
     # Initialization
@@ -174,45 +178,45 @@ class DeviceEngine:
             faults = np.asarray(faults, np.int32)
             if faults.ndim == 2:
                 faults = np.broadcast_to(faults, (w,) + faults.shape)
-        n_faults = faults.shape[1]
 
-        def init_one(seed_lo, seed_hi, fault_rows):
-            cfg = self.cfg
-            rng = make_rng(seed_lo, seed_hi, STREAM_DEVICE)
-            q = empty_queue(cfg.queue_cap, cfg.payload_words)
-            astate, events, rng = self.actor.init(cfg, rng)
-            overflow = jnp.asarray(False)
-            for ev in events:
-                q, ok = push(q, ev)
-                overflow = overflow | ~ok
-            for f in range(n_faults):  # static unroll
-                row = fault_rows[f]
-                fev = Event(time=row[0], kind=row[1], flags=jnp.int32(FLAG_FAULT),
-                            src=row[2], dst=row[3], gen=jnp.int32(0),
-                            payload=jnp.zeros((cfg.payload_words,), jnp.int32))
-                q, ok = push(q, fev, enable=row[0] >= 0)
-                overflow = overflow | ~ok
-            n = cfg.n_nodes
-            return WorldState(
-                now=jnp.int32(0),
-                queue=q,
-                rng=rng,
-                alive=jnp.ones((n,), bool),
-                gen=jnp.zeros((n,), jnp.int32),
-                clog_node=jnp.zeros((n,), bool),
-                clog_link=jnp.zeros((n, n), bool),
-                astate=astate,
-                active=jnp.asarray(True),
-                steps=jnp.int32(0),
-                delivered=jnp.int32(0),
-                dropped=jnp.int32(0),
-                overflow=overflow,
-                bug=jnp.asarray(False),
-                bug_time=INF_TIME,
-            )
+        return self._init_batched(jnp.asarray(lo), jnp.asarray(hi),
+                                  jnp.asarray(faults))
 
-        return jax.jit(jax.vmap(init_one))(jnp.asarray(lo), jnp.asarray(hi),
-                                           jnp.asarray(faults))
+    def _init_one(self, seed_lo, seed_hi, fault_rows):
+        cfg = self.cfg
+        n_faults = fault_rows.shape[0]  # static under jit (shape-keyed cache)
+        rng = make_rng(seed_lo, seed_hi, STREAM_DEVICE)
+        q = empty_queue(cfg.queue_cap, cfg.payload_words)
+        astate, events, rng = self.actor.init(cfg, rng)
+        overflow = jnp.asarray(False)
+        for ev in events:
+            q, ok = push(q, ev)
+            overflow = overflow | ~ok
+        for f in range(n_faults):  # static unroll
+            row = fault_rows[f]
+            fev = Event(time=row[0], kind=row[1], flags=jnp.int32(FLAG_FAULT),
+                        src=row[2], dst=row[3], gen=jnp.int32(0),
+                        payload=jnp.zeros((cfg.payload_words,), jnp.int32))
+            q, ok = push(q, fev, enable=row[0] >= 0)
+            overflow = overflow | ~ok
+        n = cfg.n_nodes
+        return WorldState(
+            now=jnp.int32(0),
+            queue=q,
+            rng=rng,
+            alive=jnp.ones((n,), bool),
+            gen=jnp.zeros((n,), jnp.int32),
+            clog_node=jnp.zeros((n,), bool),
+            clog_link=jnp.zeros((n, n), bool),
+            astate=astate,
+            active=jnp.asarray(True),
+            steps=jnp.int32(0),
+            delivered=jnp.int32(0),
+            dropped=jnp.int32(0),
+            overflow=overflow,
+            bug=jnp.asarray(False),
+            bug_time=INF_TIME,
+        )
 
     # ------------------------------------------------------------------
     # The per-world step
@@ -256,7 +260,12 @@ class DeviceEngine:
                 clogged = src_clogged | sel(ws.clog_node, dst) | \
                     sel2(ws.clog_link, src, dst)
                 dropped = (~ob.is_timer[m]) & (clogged | (u < loss))
-                t = ws.now + jnp.where(ob.is_timer[m], ob.delay_us[m], lat)
+                # Saturating schedule time: now + delay can wrap int32 when
+                # t_limit_us or an actor delay is near 2^31. Both operands
+                # are <= INF_TIME, so min-before-add cannot overflow.
+                delay = jnp.maximum(
+                    jnp.where(ob.is_timer[m], ob.delay_us[m], lat), 0)
+                t = ws.now + jnp.minimum(delay, INF_TIME - ws.now)
                 ev = Event(
                     time=t, kind=ob.kind[m],
                     flags=jnp.where(ob.is_timer[m], FLAG_TIMER, 0).astype(jnp.int32),

@@ -397,13 +397,22 @@ class RaftCluster:
                 if lead is None:
                     await time.sleep(0.02)
                     continue
-                started = self.servers[lead].start(command)
+                # kill()/restart() pop the server entry; a concurrent kill
+                # during any of the sleeps below must read as "leadership
+                # lost: retry", never KeyError.
+                server = self.servers.get(lead)
+                if server is None:
+                    await time.sleep(0.02)
+                    continue
+                started = server.start(command)
                 if started is None:
                     await time.sleep(0.02)
                     continue
                 index, term = started
                 while True:
-                    server = self.servers[lead]
+                    server = self.servers.get(lead)
+                    if server is None:
+                        break  # leader killed mid-commit: retry from scratch
                     if server.commit_index >= index and \
                             server.last_log_index() >= index and \
                             server.log_term(index) == term:

@@ -205,15 +205,25 @@ class ChannelReceiver:
 
 class BindGuard:
     """Releases the bound port on close (`mod.rs:264-318`). Python has no
-    deterministic drop, so owners call ``close()`` (or use ``with``)."""
+    deterministic drop, so owners call ``close()`` (or use ``with``).
 
-    __slots__ = ("net", "node", "addr", "protocol", "_closed")
+    Deliberately NO ``__del__``: releasing the port at garbage-collection
+    time would mutate simulation state at a moment determined by the
+    process's allocation history (GC cycles), not by the seed — breaking
+    same-seed-same-trajectory. An un-closed guard's port stays bound until
+    its node resets; close() is token-checked so a stale guard can never
+    release a successor's binding.
+    """
 
-    def __init__(self, net: NetSim, node: int, addr: Addr, protocol: IpProtocol):
+    __slots__ = ("net", "node", "addr", "protocol", "socket", "_closed")
+
+    def __init__(self, net: NetSim, node: int, addr: Addr, protocol: IpProtocol,
+                 socket: Socket):
         self.net = net
         self.node = node
         self.addr = addr
         self.protocol = protocol
+        self.socket = socket
         self._closed = False
 
     @staticmethod
@@ -225,7 +235,7 @@ class BindGuard:
             await net.rand_delay()
             try:
                 bound = net.network.bind(node, candidate, protocol, socket)
-                return BindGuard(net, node, bound, protocol)
+                return BindGuard(net, node, bound, protocol, socket)
             except OSError as exc:
                 last_err = exc
         raise last_err or AddrNotAvailable("could not resolve to any addresses")
@@ -233,10 +243,8 @@ class BindGuard:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self.net.network.close(self.node, self.addr, self.protocol)
-
-    def __del__(self):
-        self.close()
+            self.net.network.close(self.node, self.addr, self.protocol,
+                                   expected=self.socket)
 
 
 def _netsim() -> NetSim:

@@ -166,10 +166,17 @@ class Network:
                 return port
         raise AddrInUse("no available ephemeral port")
 
-    def close(self, node_id: int, addr: Addr, protocol: IpProtocol) -> None:
+    def close(self, node_id: int, addr: Addr, protocol: IpProtocol,
+              expected: Optional[Socket] = None) -> None:
+        """Release a binding. With ``expected``, only release if the table
+        still holds that socket — a stale guard (its node reset and the port
+        rebound since) must not close the successor's binding."""
         node = self.nodes.get(node_id)
-        if node is not None:
-            node.sockets.pop((addr, protocol), None)
+        if node is None:
+            return
+        key = (addr, protocol)
+        if expected is None or node.sockets.get(key) is expected:
+            node.sockets.pop(key, None)
 
     # -- sending (`network.rs:249-301`) ------------------------------------
     def test_link(self, src: int, dst: int) -> Optional[int]:
