@@ -1,21 +1,27 @@
-"""Benchmark: MadRaft 3-node seeds/sec, batched device engine vs host engine.
+"""Benchmark suite: all five BASELINE.json configs + backend crosscheck.
 
-The BASELINE.json headline: how many seeded MadRaft simulations per wall
-second can the framework explore, and the speedup over single-seed host
-(CPU) execution (the reference's one-thread-per-seed model,
-`madsim/src/sim/runtime/builder.rs:118-136`).
+The headline (BASELINE.json metric): MadRaft 3-node seeds/sec on the batched
+device engine, and its speedup over single-seed host (CPU) execution — the
+reference's one-thread-per-seed model (`madsim/src/sim/runtime/builder.rs:
+118-136`). The reference publishes no numbers (BASELINE.md); the other
+configs mirror its harness definitions:
 
-One *seed* = one full simulation of a 3-node Raft cluster for 1 virtual
-second: randomized election timeouts, leader election, then steady-state
-heartbeats, over the simulated network (1-10 ms latency). The device engine
-runs W of these vmapped on the accelerator; the host baseline runs the
-arbitrary-Python MadRaft model (madsim_tpu/models/raft.py) one seed at a
-time, exactly like the reference.
+  1. rpc_pingpong       2-node RPC ping-pong, single seed, host engine
+                        (`madsim/benches/rpc.rs:11-26`)
+  2. madraft_3node      3-node leader election, W seeds vmapped (headline)
+  3. grpc_chaos         gRPC echo under partition chaos
+                        (`tonic-example/src/server.rs:281-332`)
+  4. postgres_skew      postgres client<->server with clock-skew injection
+  5. madraft_5node      5-node log replication x failure-schedule sweep
+                        (device engine, per-world fault schedules)
 
-Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "seeds/s", "vs_baseline": N}
-vs_baseline = device seeds/s ÷ host single-seed seeds/s (≥100 is the
-BASELINE.json north-star bar). Details go to stderr.
+Plus two cross-engine validations VERDICT r1 required:
+  - crosscheck          TPU vs CPU bit-exact trajectory equality
+  - time_to_first_bug   host vs device finding the same injected Raft bug
+                        (buggy_double_vote), wall-clock to first detection
+
+Prints ONE JSON line (driver contract): the headline metric with the other
+config results embedded under "configs". Details go to stderr.
 """
 import argparse
 import json
@@ -24,7 +30,7 @@ import time as walltime
 
 import numpy as np
 
-SIM_SECONDS = 1.0  # virtual seconds of Raft per seed
+SIM_SECONDS = 1.0  # virtual seconds of Raft per seed (headline config)
 
 
 def log(msg: str) -> None:
@@ -32,7 +38,88 @@ def log(msg: str) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Host baseline: single-seed MadRaft, one world at a time
+# Config 1: RPC ping-pong, 2 nodes, single seed, host engine
+# ---------------------------------------------------------------------------
+
+def bench_rpc_pingpong(n_rounds: int) -> dict:
+    """Round-trips/sec of the built-in RPC over the simulated network, plus
+    payload-throughput points mirroring `benches/rpc.rs:28-54` sizes."""
+    import madsim_tpu as ms
+    from madsim_tpu.net import Endpoint, rpc
+    from madsim_tpu import time as simtime
+
+    class Ping:
+        __slots__ = ("n",)
+
+        def __init__(self, n):
+            self.n = n
+
+    def world(payload: bytes, rounds: int):
+        rt = ms.Runtime(seed=1)
+
+        async def main():
+            h = ms.Handle.current()
+
+            async def server_init():
+                ep = await Endpoint.bind("10.0.0.1:9000")
+
+                async def handle(req, data):
+                    return Ping(req.n + 1), data
+
+                rpc.add_rpc_handler_with_data(ep, Ping, handle)
+                await simtime.sleep(1e6)
+
+            h.create_node(name="server", ip="10.0.0.1", init=server_init)
+            client = h.create_node(name="client", ip="10.0.0.2")
+            done = ms.sync.SimFuture()
+
+            async def client_body():
+                ep = await Endpoint.bind("10.0.0.2:0")
+                # Datagram sends are not retransmitted: the very first call
+                # can race the server's bind, so retry it until the server
+                # is up (the reference's tests use the same retry idiom).
+                while True:
+                    try:
+                        await rpc.call_with_data(
+                            ep, "10.0.0.1:9000", Ping(0), payload, timeout=0.2)
+                        break
+                    except TimeoutError:
+                        pass
+                # Virtual latency measured over the counted rounds only
+                # (startup + retry traffic excluded).
+                t_start = simtime.monotonic()
+                for i in range(rounds):
+                    await rpc.call_with_data(
+                        ep, "10.0.0.1:9000", Ping(i), payload, timeout=5.0)
+                done.set_result(simtime.monotonic() - t_start)
+
+            client.spawn(client_body())
+            return await done
+
+        return rt.block_on(main())
+
+    t0 = walltime.perf_counter()
+    virt = world(b"", n_rounds)
+    dt = walltime.perf_counter() - t0
+    out = {"empty_rpc_roundtrips_per_sec": round(n_rounds / dt, 2),
+           "virtual_latency_ms": round(virt / n_rounds * 1e3, 3)}
+
+    sizes = [16, 256, 4096, 65536, 1 << 20]
+    data_rounds = max(16, n_rounds // 8)
+    rates = {}
+    for size in sizes:
+        payload = b"\xab" * size
+        t0 = walltime.perf_counter()
+        world(payload, data_rounds)
+        dt = walltime.perf_counter() - t0
+        rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
+    out["payload_mb_per_sec"] = rates
+    log(f"rpc_pingpong: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config 2 (headline): MadRaft 3-node, device engine vs host single-seed
 # ---------------------------------------------------------------------------
 
 def host_seed_rate(n_seeds: int) -> float:
@@ -64,10 +151,6 @@ def host_seed_rate(n_seeds: int) -> float:
     return n_seeds / dt
 
 
-# ---------------------------------------------------------------------------
-# Device engine: W worlds vmapped
-# ---------------------------------------------------------------------------
-
 def device_seed_rate(n_worlds: int, max_steps: int = 2_000) -> float:
     import jax
 
@@ -98,27 +181,417 @@ def device_seed_rate(n_worlds: int, max_steps: int = 2_000) -> float:
     return n_worlds / dt
 
 
+# ---------------------------------------------------------------------------
+# Config 3: gRPC echo under partition chaos
+# ---------------------------------------------------------------------------
+
+def bench_grpc_chaos(n_clients: int, sim_seconds: float) -> dict:
+    """Echoes/sec completed while a supervisor partitions and heals the
+    network and restarts client nodes (`tonic-example/src/server.rs:281-332`
+    semantics: progress must continue across chaos)."""
+    import madsim_tpu as ms
+    from madsim_tpu.net import NetSim
+    from madsim_tpu.shims import grpc_sim
+    from madsim_tpu import time as simtime
+
+    class Echo:
+        SERVICE_NAME = "bench.Echo"
+
+        @grpc_sim.unary
+        async def Say(self, request, context):
+            return request
+
+        @grpc_sim.bidi
+        async def Stream(self, requests, context):
+            async for r in requests:
+                yield r
+
+    completed = [0]
+
+    def world():
+        rt = ms.Runtime(seed=7)
+        rt.set_time_limit(sim_seconds * 10 + 60)
+
+        async def main():
+            h = ms.Handle.current()
+            server = grpc_sim.Server().add_service(Echo())
+
+            async def serve():
+                await server.serve(("10.0.0.1", 50051))
+
+            srv = h.create_node(name="server", ip="10.0.0.1", init=serve)
+
+            def client_init(i):
+                async def body():
+                    while True:
+                        try:
+                            ch = await grpc_sim.Channel.connect(("10.0.0.1", 50051))
+                            while True:
+                                rsp = await simtime.timeout(
+                                    1.0, ch.unary("/bench.Echo/Say", completed[0]))
+                                assert rsp is not None
+                                completed[0] += 1
+                        except (OSError, TimeoutError, grpc_sim.Status):
+                            await simtime.sleep(0.05)
+
+                return body
+
+            clients = [h.create_node(name=f"cli{i}", ip=f"10.0.0.{i + 2}",
+                                     init=client_init(i))
+                       for i in range(n_clients)]
+
+            sim = ms.simulator(NetSim)
+            from madsim_tpu import rand
+            rng = rand.thread_rng()
+            t_end = sim_seconds
+            while simtime.monotonic() < t_end:
+                await simtime.sleep(rng.gen_range_f64(0.1, 0.3))
+                act = rng.gen_range(0, 3)
+                victim = clients[rng.gen_range(0, n_clients)]
+                if act == 0:
+                    sim.disconnect2(srv.id, victim.id)
+                    await simtime.sleep(rng.gen_range_f64(0.05, 0.2))
+                    sim.connect2(srv.id, victim.id)
+                elif act == 1:
+                    ms.Handle.current().restart(victim)
+                else:
+                    sim.disconnect(victim.id)   # clog the whole node
+                    await simtime.sleep(rng.gen_range_f64(0.05, 0.2))
+                    sim.connect(victim.id)
+
+        rt.block_on(main())
+
+    t0 = walltime.perf_counter()
+    world()
+    dt = walltime.perf_counter() - t0
+    assert completed[0] > 0, "no gRPC progress under chaos"
+    out = {"echoes_completed": completed[0],
+           "echoes_per_wall_sec": round(completed[0] / dt, 2),
+           "sim_seconds": sim_seconds, "n_clients": n_clients}
+    log(f"grpc_chaos: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config 4: postgres client<->server with clock-skew injection
+# ---------------------------------------------------------------------------
+
+def bench_postgres_skew(n_queries: int) -> dict:
+    """Queries/sec against the in-sim postgres server while the client and
+    server wall clocks are skewed apart (and re-skewed mid-run). Asserts the
+    client observes the skew via the server's now() and that queries keep
+    succeeding — wall-clock skew must not affect protocol correctness."""
+    import madsim_tpu as ms
+    from madsim_tpu.shims import postgres
+    from madsim_tpu import time as simtime
+
+    stats = {}
+
+    def world():
+        rt = ms.Runtime(seed=3)
+        rt.set_time_limit(600)
+
+        async def main():
+            h = ms.Handle.current()
+            server = postgres.SimPostgresServer()
+
+            async def serve():
+                await server.serve(("10.0.0.1", 5432))
+
+            srv = h.create_node(name="pg", ip="10.0.0.1", init=serve)
+            app = h.create_node(name="app", ip="10.0.0.2")
+            # Inject: server clock 30 s ahead, client 5 s behind.
+            h.set_clock_skew(srv, +30.0)
+            h.set_clock_skew(app, -5.0)
+            done = ms.sync.SimFuture()
+
+            async def body():
+                while True:  # server bind race: retry the initial connect
+                    try:
+                        conn = await postgres.connect("10.0.0.1", user="bench")
+                        break
+                    except OSError:
+                        await simtime.sleep(0.05)
+                await conn.execute("CREATE TABLE kv (k, v)")
+                for i in range(n_queries):
+                    await conn.execute(f"INSERT INTO kv VALUES ('{i}', 'v{i}')")
+                    rows = await conn.query(f"SELECT v FROM kv WHERE k = '{i}'")
+                    assert rows[0].get("v") == f"v{i}"
+                    if i == n_queries // 2:
+                        # Hot re-skew mid-connection.
+                        ms.Handle.current().set_clock_skew(srv, -45.0)
+                srv_now = await conn.query("SELECT now()")
+                await conn.close()
+                done.set_result((srv_now[0][0], simtime.system_time()))
+
+            app.spawn(body())
+            srv_now, app_now = await done
+            stats["server_now"] = srv_now
+            stats["client_observed_skew_s"] = round(
+                float(srv_now) - app_now, 1) if _floatable(srv_now) else None
+
+        rt.block_on(main())
+
+    t0 = walltime.perf_counter()
+    world()
+    dt = walltime.perf_counter() - t0
+    out = {"queries_per_wall_sec": round(2 * n_queries / dt, 2),
+           "n_queries": 2 * n_queries,
+           "client_observed_skew_s": stats.get("client_observed_skew_s")}
+    log(f"postgres_skew: {out}")
+    return out
+
+
+def _floatable(v) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Config 5: MadRaft 5-node log replication x failure-schedule sweep (device)
+# ---------------------------------------------------------------------------
+
+def make_fault_schedules(n_worlds: int, n_nodes: int, t_limit_us: int,
+                         seed: int = 0) -> np.ndarray:
+    """Per-world fault rows [time_us, op, a, b]: one kill+restart pair and
+    one link clog+unclog window per world, at schedule-swept times."""
+    from madsim_tpu.engine.core import (
+        FAULT_KILL, FAULT_RESTART, FAULT_CLOG_LINK, FAULT_UNCLOG_LINK)
+
+    rng = np.random.default_rng(seed)
+    t_kill = rng.integers(t_limit_us // 10, t_limit_us // 2, n_worlds)
+    t_restart = t_kill + rng.integers(50_000, t_limit_us // 4, n_worlds)
+    victim = rng.integers(0, n_nodes, n_worlds)
+    t_clog = rng.integers(t_limit_us // 10, t_limit_us // 2, n_worlds)
+    t_unclog = t_clog + rng.integers(50_000, t_limit_us // 4, n_worlds)
+    a = rng.integers(0, n_nodes, n_worlds)
+    b = (a + 1 + rng.integers(0, n_nodes - 1, n_worlds)) % n_nodes
+    rows = np.stack([
+        np.stack([t_kill, np.full(n_worlds, FAULT_KILL), victim,
+                  np.zeros(n_worlds)], axis=1),
+        np.stack([t_restart, np.full(n_worlds, FAULT_RESTART), victim,
+                  np.zeros(n_worlds)], axis=1),
+        np.stack([t_clog, np.full(n_worlds, FAULT_CLOG_LINK), a, b], axis=1),
+        np.stack([t_unclog, np.full(n_worlds, FAULT_UNCLOG_LINK), a, b], axis=1),
+    ], axis=1).astype(np.int32)
+    return rows
+
+
+def bench_madraft_5node(n_worlds: int) -> dict:
+    """5-node Raft with client proposals + per-world failure schedules,
+    swept on the device engine (BASELINE config 5; the reference's analog is
+    MADSIM_TEST_NUM=100000 with chaos, one thread per seed)."""
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig
+    from madsim_tpu.parallel.sweep import sweep
+
+    t_limit_us = 3_000_000
+    rcfg = RaftDeviceConfig(n=5, n_proposals=4, log_cap=16,
+                            propose_start_us=1_000_000,
+                            propose_interval_us=200_000)
+    cfg = EngineConfig(n_nodes=5, outbox_cap=6, queue_cap=96,
+                       t_limit_us=t_limit_us)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    faults = make_fault_schedules(n_worlds, 5, t_limit_us)
+
+    # Warmup compile on the SAME batch shape as the timed run (jit
+    # specializes on shapes; a smaller warmup batch would leave the real
+    # compile inside the timed window).
+    res = sweep(None, cfg, np.arange(n_worlds), faults=faults, engine=eng,
+                chunk_steps=512, max_steps=20_000)
+
+    t0 = walltime.perf_counter()
+    res = sweep(None, cfg, np.arange(n_worlds), faults=faults, engine=eng,
+                chunk_steps=512, max_steps=20_000)
+    dt = walltime.perf_counter() - t0
+
+    obs = res.observations
+    n_bug = int(obs["bug"].sum())
+    assert n_bug == 0, f"clean 5-node config flagged {n_bug} bugs"
+    committed = obs["max_commit"]
+    out = {"seeds_per_sec": round(n_worlds / dt, 2),
+           "n_worlds": n_worlds,
+           "mean_committed": round(float(committed.mean()), 2),
+           "worlds_with_commits": int((committed > 0).sum()),
+           "elected_frac": round(float(obs["leader_elected"].mean()), 4)}
+    log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine validation: TPU<->CPU bit-exactness
+# ---------------------------------------------------------------------------
+
+def bench_crosscheck(n_worlds: int) -> dict:
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig
+    from madsim_tpu.engine.crosscheck import crosscheck_backends
+
+    rcfg = RaftDeviceConfig(n=3)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_000_000)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    out = crosscheck_backends(eng, np.arange(n_worlds), max_steps=5_000)
+    # Also crosscheck under fault schedules (exercises the fault path).
+    faults = make_fault_schedules(n_worlds, 3, 1_000_000, seed=1)
+    eng2 = DeviceEngine(RaftActor(rcfg), cfg)
+    out_f = crosscheck_backends(eng2, np.arange(n_worlds), faults=faults,
+                                max_steps=5_000)
+    out["bitwise_equal_with_faults"] = out_f["bitwise_equal"]
+    log(f"crosscheck: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine validation: time to first bug, host vs device
+# ---------------------------------------------------------------------------
+
+def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
+    """Both engines hunt the same injected bug (double voting breaking
+    election safety, the buggy_double_vote switch present in BOTH
+    models/raft.py and engine/raft_actor.py). Host = sequential seeds,
+    reference style; device = one vmapped batch.
+
+    Reported as *expected* wall seconds to first detection, derived from
+    each engine's measured per-seed bug rate and seeds/sec (a single
+    measured first-hit time is one geometric sample — pure luck). Also
+    cross-validates that the two engines find the bug at comparable
+    per-seed densities (the BASELINE.json second metric)."""
+    import jax
+
+    import madsim_tpu as ms
+    from madsim_tpu.models.raft import (
+        RaftCluster, RaftOptions, RaftInvariantViolation)
+    from madsim_tpu.engine import DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig
+
+    # Host: fixed number of seeds; count hits.
+    async def world():
+        from madsim_tpu import time as simtime
+
+        cluster = RaftCluster(3, RaftOptions(persist=False,
+                                             buggy_double_vote=True))
+        while simtime.monotonic() < 2.0:
+            await simtime.sleep(0.05)
+
+    t0 = walltime.perf_counter()
+    host_hits = 0
+    for seed in range(host_seeds_n):
+        rt = ms.Runtime(seed=seed)
+        rt.set_time_limit(60.0)
+        try:
+            rt.block_on(world())
+        except RaftInvariantViolation:
+            host_hits += 1
+    host_dt = walltime.perf_counter() - t0
+    host_rate = host_hits / host_seeds_n
+    host_sps = host_seeds_n / host_dt
+    host_expected = (1.0 / host_rate) / host_sps if host_hits else None
+    log(f"host bug hunt: {host_hits}/{host_seeds_n} seeds hit "
+        f"({host_sps:.1f} seeds/s)")
+
+    # Device: one batch of worlds with the same bug switch.
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000, stop_on_bug=False)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    warm = eng.run(eng.init(np.arange(device_worlds)), max_steps=4_000)
+    jax.block_until_ready(warm)
+
+    t0 = walltime.perf_counter()
+    state = eng.init(np.arange(device_worlds))
+    state = eng.run(state, max_steps=4_000)
+    jax.block_until_ready(state)
+    obs = eng.observe(state)
+    dev_dt = walltime.perf_counter() - t0
+    n_bugs = int(obs["bug"].sum())
+    assert n_bugs > 0, "device engine failed to find the injected bug"
+    dev_rate = n_bugs / device_worlds
+    # Expected seeds to first bug = 1/rate; the device explores
+    # device_worlds/dev_dt seeds per second.
+    dev_expected = (1.0 / dev_rate) / (device_worlds / dev_dt)
+    out = {
+        "host_bug_rate": round(host_rate, 4),
+        "host_seeds_per_sec": round(host_sps, 2),
+        "host_expected_s_to_first_bug": (round(host_expected, 3)
+                                         if host_expected else None),
+        "device_bug_rate": round(dev_rate, 4),
+        "device_seeds_per_sec": round(device_worlds / dev_dt, 1),
+        "device_expected_s_to_first_bug": round(dev_expected, 4),
+        "device_first_failing_seed": int(np.argmax(obs["bug"])),
+        "rates_comparable": bool(
+            host_rate > 0 and dev_rate > 0
+            and 0.1 <= host_rate / dev_rate <= 10.0),
+        "speedup": (round(host_expected / dev_expected, 1)
+                    if host_expected else None),
+    }
+    log(f"time_to_first_bug: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="small fast run (CI/verify)")
     ap.add_argument("--worlds", type=int, default=None)
     ap.add_argument("--host-seeds", type=int, default=None)
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma list: 3node,rpc,grpc,postgres,5node,"
+                         "crosscheck,bug (3node = the headline)")
     args = ap.parse_args()
 
+    smoke = args.smoke
     # 256k worlds is the measured single-chip sweet spot (HBM-resident, past
     # the per-iteration overhead knee; larger starts spilling).
-    n_worlds = args.worlds or (256 if args.smoke else 262_144)
-    n_host = args.host_seeds or (2 if args.smoke else 8)
+    n_worlds = args.worlds or (256 if smoke else 262_144)
+    n_host = args.host_seeds or (2 if smoke else 8)
+    only = set(args.only.split(",")) if args.only else None
 
-    dev_rate = device_seed_rate(n_worlds)
-    host_rate = host_seed_rate(n_host)
+    def want(name: str) -> bool:
+        return only is None or name in only
+
+    configs = {}
+    if want("rpc"):
+        configs["rpc_pingpong"] = bench_rpc_pingpong(64 if smoke else 1_000)
+    if want("grpc"):
+        configs["grpc_chaos"] = bench_grpc_chaos(
+            n_clients=2 if smoke else 5, sim_seconds=2.0 if smoke else 10.0)
+    if want("postgres"):
+        configs["postgres_skew"] = bench_postgres_skew(16 if smoke else 200)
+    if want("crosscheck"):
+        configs["crosscheck"] = bench_crosscheck(128 if smoke else 4_096)
+    if want("bug"):
+        configs["time_to_first_bug"] = bench_time_to_first_bug(
+            host_seeds_n=16 if smoke else 128,
+            device_worlds=1_024 if smoke else 65_536)
+    if want("5node"):
+        configs["madraft_5node"] = bench_madraft_5node(
+            256 if smoke else 100_000)
+
+    if want("3node"):
+        dev_rate = device_seed_rate(n_worlds)
+        host_rate = host_seed_rate(n_host)
+    else:
+        dev_rate = host_rate = None
 
     print(json.dumps({
         "metric": "madraft_3node_1s_seeds_per_sec",
-        "value": round(dev_rate, 2),
+        "value": round(dev_rate, 2) if dev_rate else None,
         "unit": "seeds/s",
-        "vs_baseline": round(dev_rate / host_rate, 2),
+        "vs_baseline": round(dev_rate / host_rate, 2) if dev_rate else None,
+        # vs_baseline denominator caveat (VERDICT r1): the baseline is THIS
+        # repo's pure-Python host engine, not the reference's Rust engine
+        # (not runnable here); the Rust engine would be faster per seed.
+        "baseline_note": "host = this repo's Python engine, single-seed",
+        "configs": configs,
     }), flush=True)
 
 

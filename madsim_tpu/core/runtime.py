@@ -48,6 +48,12 @@ class Handle:
     def resume(self, node: Union[int, "NodeHandle"]) -> None:
         self.task.resume(_node_id(node))
 
+    def set_clock_skew(self, node: Union[int, "NodeHandle"], seconds: float) -> None:
+        """Skew a node's observed wall clock (system_time) by ``seconds``
+        (positive = that node's clock runs ahead). Monotonic time and timer
+        ordering are unaffected, as on real skewed machines."""
+        self.time.set_clock_skew(_node_id(node), round(seconds * 1e9))
+
     # -- topology ----------------------------------------------------------
     def create_node(self, name: Optional[str] = None, ip: Optional[str] = None,
                     cores: int = 1, init: Optional[Callable[[], Coroutine]] = None) -> "NodeHandle":

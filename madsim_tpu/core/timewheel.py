@@ -74,6 +74,11 @@ class TimeRuntime:
         base_rng = GlobalRng(rng.seed, stream=STREAM_TIME_BASE)
         self.base_time_ns = (_UNIX_2022 + base_rng.gen_range(0, _SECS_IN_2022)) * NANOS_PER_SEC
         self.elapsed_ns = 0
+        # Per-node wall-clock skew (ns), the fault knob for clock-skew
+        # chaos: skews the *system* clock a node observes, never the
+        # monotonic clock or timer order (real skewed machines still have
+        # monotonic local timers). BASELINE config 4's injection point.
+        self.node_skew_ns: Dict[int, int] = {}
         self._heap: List[TimerEntry] = []
         self._seq = 0
         lib = _native.get_lib()
@@ -85,9 +90,15 @@ class TimeRuntime:
         """Monotonic elapsed virtual nanoseconds since runtime start."""
         return self.elapsed_ns
 
-    def system_time_ns(self) -> int:
-        """Simulated wall-clock (unix epoch) nanoseconds."""
-        return self.base_time_ns + self.elapsed_ns
+    def system_time_ns(self, node_id: Optional[int] = None) -> int:
+        """Simulated wall-clock (unix epoch) nanoseconds, as observed by
+        ``node_id`` (applying its configured skew)."""
+        skew = self.node_skew_ns.get(node_id, 0) if node_id is not None else 0
+        return self.base_time_ns + self.elapsed_ns + skew
+
+    def set_clock_skew(self, node_id: int, skew_ns: int) -> None:
+        """Skew a node's wall clock by ``skew_ns`` (positive = fast)."""
+        self.node_skew_ns[node_id] = skew_ns
 
     # -- clock writes ------------------------------------------------------
     def advance(self, delta_ns: int) -> None:

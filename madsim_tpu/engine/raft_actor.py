@@ -77,6 +77,14 @@ class RaftState(NamedTuple):
     elect_epoch: jnp.ndarray  # (N,) i32 — invalidates stale election timers
     first_leader_time: jnp.ndarray  # i32 µs, INF if never
     elections_won: jnp.ndarray      # i32
+    # Historical election-safety record: bitset of terms each node has EVER
+    # won (word 0 = terms 0-31, word 1 = terms 32-63, higher terms saturate
+    # into bit 63). The device analog of the host checker's full
+    # leaders_by_term dict (models/raft.py InvariantChecker): a second win
+    # of an already-won term is flagged at win time even if the first
+    # winner stepped down — or won newer terms — since (a purely
+    # simultaneous check misses those).
+    won_terms: jnp.ndarray          # (N, 2) i32 bitmask
 
 
 class RaftActor:
@@ -115,6 +123,7 @@ class RaftActor:
             elect_epoch=jnp.zeros((n,), jnp.int32),
             first_leader_time=INF_TIME,
             elections_won=jnp.int32(0),
+            won_terms=jnp.zeros((n, 2), jnp.int32),
         )
         events: List[Event] = []
         for i in range(n):
@@ -310,9 +319,17 @@ class RaftActor:
         votes2 = jnp.where(counted, sel(s.votes, me) | (1 << voter),
                            sel(s.votes, me))
         win = counted & (jax.lax.population_count(votes2) > n // 2)
+        # Historical election safety, checked at win time (the host
+        # checker's on_become_leader semantics): another node already won
+        # this same term ⇒ violation, even if it stepped down since.
+        other_won_same = jnp.any((jnp.arange(n) != me) &
+                                 (s.last_won_term == term_me))
+        hist_bug = win & other_won_same
         llen = sel(s.log_len, me)
         s2 = s._replace(
             votes=upd(s.votes, me, votes2),
+            last_won_term=upd(s.last_won_term, me, jnp.where(
+                win, term_me, sel(s.last_won_term, me))),
             role=upd(s.role, me, jnp.where(win, LEADER, sel(s.role, me))),
             next_idx=upd(s.next_idx, me, jnp.where(
                 win, jnp.full((n,), 1, jnp.int32) + llen, sel(s.next_idx, me))),
@@ -335,7 +352,7 @@ class RaftActor:
             timer_delay=jnp.int32(r.heartbeat_us),
             timer_payload=self._pad(cfg, [sel(s2.term, me)]),
         )
-        return s2, ob, rng, jnp.asarray(False)
+        return s2, ob, rng, hist_bug
 
     def _on_append(self, cfg, s: RaftState, ev: Event, now, rng):
         r = self.rcfg

@@ -97,6 +97,11 @@ class RaftOptions:
     rpc_timeout: float = 0.10
     port: int = 7000
     persist: bool = True  # durable term/vote/log via the simulated fs
+    # Injected bug (same switch as engine/raft_actor.py RaftDeviceConfig):
+    # grant votes ignoring the one-vote-per-term rule, so seed sweeps have a
+    # real election-safety violation to find. Used by the host↔device
+    # cross-validation benchmark (bench.py time-to-first-bug).
+    buggy_double_vote: bool = False
 
 
 class RaftServer:
@@ -287,7 +292,9 @@ class RaftServer:
             return VoteReply(self.term, False)
         up_to_date = (req.last_log_term, req.last_log_index) >= (
             self.log_term(self.last_log_index()), self.last_log_index())
-        if up_to_date and self.voted_for in (None, req.candidate):
+        can_vote = (True if self.opts.buggy_double_vote
+                    else self.voted_for in (None, req.candidate))
+        if up_to_date and can_vote:
             self.voted_for = req.candidate
             await self._persist()
             self._last_heartbeat = time.monotonic()

@@ -254,6 +254,14 @@ class SimPostgresServer:
 
     # -- toy engine ----------------------------------------------------
     def _run(self, sql: str) -> bytes:
+        if sql.strip().rstrip(";").lower() in ("select now()", "select current_timestamp"):
+            # Server-side wall-clock read: observes this node's simulated
+            # system time *including injected clock skew*
+            # (Handle.set_clock_skew) — the observation surface for the
+            # clock-skew chaos config (BASELINE config 4).
+            from .. import time as simtime
+
+            return self._rowset(["now"], [[repr(simtime.system_time())]])
         if m := _CREATE.match(sql):
             name, cols = m.group(1).lower(), [c.strip().split()[0].lower()
                                              for c in m.group(2).split(",")]

@@ -40,8 +40,9 @@ def monotonic() -> float:
 
 def system_time_ns() -> int:
     """Simulated wall-clock unix-epoch nanoseconds (seed-randomized base in
-    2022, `time/mod.rs:27-32`)."""
-    return _time().system_time_ns()
+    2022, `time/mod.rs:27-32`), as observed by the current node — i.e. with
+    the node's injected clock skew applied (``Handle.set_clock_skew``)."""
+    return _time().system_time_ns(context.current_node_id())
 
 
 def system_time() -> float:
