@@ -34,6 +34,10 @@ from .rng import DevRng, uniform_u32
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
+# Words in the per-node won-terms bitset: 32*WON_WORDS distinct terms before
+# the saturating top bit can alias two high terms into one.
+WON_WORDS = 4
+
 # Event kinds.
 K_ELECTION = 0      # timer [epoch]
 K_HEARTBEAT = 1     # timer [term]
@@ -78,13 +82,14 @@ class RaftState(NamedTuple):
     first_leader_time: jnp.ndarray  # i32 µs, INF if never
     elections_won: jnp.ndarray      # i32
     # Historical election-safety record: bitset of terms each node has EVER
-    # won (word 0 = terms 0-31, word 1 = terms 32-63, higher terms saturate
-    # into bit 63). The device analog of the host checker's full
-    # leaders_by_term dict (models/raft.py InvariantChecker): a second win
-    # of an already-won term is flagged at win time even if the first
-    # winner stepped down — or won newer terms — since (a purely
-    # simultaneous check misses those).
-    won_terms: jnp.ndarray          # (N, 2) i32 bitmask
+    # won (word w = terms 32w..32w+31; terms beyond the last word saturate
+    # into its top bit, an over-approximation that can only fire after
+    # WON_WORDS*32 real elections in one world). The device analog of the
+    # host checker's full leaders_by_term dict (models/raft.py
+    # InvariantChecker): a second win of an already-won term is flagged at
+    # win time even if the first winner stepped down — or won newer terms —
+    # since (a purely simultaneous check misses those).
+    won_terms: jnp.ndarray          # (N, WON_WORDS) i32 bitmask
 
 
 class RaftActor:
@@ -123,7 +128,7 @@ class RaftActor:
             elect_epoch=jnp.zeros((n,), jnp.int32),
             first_leader_time=INF_TIME,
             elections_won=jnp.int32(0),
-            won_terms=jnp.zeros((n, 2), jnp.int32),
+            won_terms=jnp.zeros((n, WON_WORDS), jnp.int32),
         )
         events: List[Event] = []
         for i in range(n):
@@ -321,15 +326,24 @@ class RaftActor:
         win = counted & (jax.lax.population_count(votes2) > n // 2)
         # Historical election safety, checked at win time (the host
         # checker's on_become_leader semantics): another node already won
-        # this same term ⇒ violation, even if it stepped down since.
-        other_won_same = jnp.any((jnp.arange(n) != me) &
-                                 (s.last_won_term == term_me))
+        # this same term ⇒ violation, even if it stepped down — or won
+        # newer terms — since. won_terms is the full per-term bitset, so
+        # no later win can erase the record.
+        bit_index = jnp.clip(term_me, 0, 32 * WON_WORDS - 1)
+        word = bit_index // 32
+        term_mask = jnp.where(jnp.arange(WON_WORDS) == word,
+                              jnp.int32(1) << (bit_index % 32),
+                              jnp.int32(0))                       # (W,)
+        node_won_term = jnp.any((s.won_terms & term_mask[None, :]) != 0,
+                                axis=1)                           # (N,)
+        other_won_same = jnp.any((jnp.arange(n) != me) & node_won_term)
         hist_bug = win & other_won_same
+        my_won = sel(s.won_terms, me)                             # (W,)
         llen = sel(s.log_len, me)
         s2 = s._replace(
             votes=upd(s.votes, me, votes2),
-            last_won_term=upd(s.last_won_term, me, jnp.where(
-                win, term_me, sel(s.last_won_term, me))),
+            won_terms=upd(s.won_terms, me,
+                          jnp.where(win, my_won | term_mask, my_won)),
             role=upd(s.role, me, jnp.where(win, LEADER, sel(s.role, me))),
             next_idx=upd(s.next_idx, me, jnp.where(
                 win, jnp.full((n,), 1, jnp.int32) + llen, sel(s.next_idx, me))),

@@ -176,6 +176,58 @@ def test_injected_bug_is_found_and_stops_world():
     assert (obs["bug_time_us"][~hit] == int(INF_TIME)).all()
 
 
+def test_won_terms_bitset_catches_historical_double_win():
+    # Election-safety history must survive later wins: node A wins term 2,
+    # then term 3; node B then wins term 2. A scalar last-won-term record
+    # is overwritten by A's term-3 win and misses B's duplicate; the
+    # won_terms bitset keeps the full history and flags it at win time.
+    from madsim_tpu.engine.raft_actor import (
+        CANDIDATE, K_VOTEREPLY, WON_WORDS, RaftActor)
+
+    rcfg = RaftDeviceConfig(n=3)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000)
+    actor = RaftActor(rcfg)
+    rng = make_rng(jnp.uint32(0), jnp.uint32(0), STREAM_DEVICE)
+    s, _, rng = actor.init(cfg, rng)
+
+    def force_win(s, rng, me, term):
+        # Put `me` in CANDIDATE at `term` holding its own vote, then deliver
+        # a granted VoteReply from one peer -> majority (2/3) -> win.
+        s = s._replace(
+            term=s.term.at[me].set(term),
+            role=s.role.at[me].set(CANDIDATE),
+            votes=s.votes.at[me].set(1 << me),
+            voted_for=s.voted_for.at[me].set(me))
+        voter = (me + 1) % 3
+        ev = Event.make(time=0, kind=K_VOTEREPLY,
+                        payload_words=cfg.payload_words,
+                        src=voter, dst=me, payload=[term, 1, voter])
+        s, _ob, rng, bug = actor.handle(cfg, s, ev, jnp.int32(0), rng)
+        return s, rng, bool(bug)
+
+    s, rng, bug = force_win(s, rng, 0, 2)     # A wins term 2
+    assert not bug
+    s, rng, bug = force_win(s, rng, 0, 3)     # A wins term 3 too
+    assert not bug
+    s, rng, bug = force_win(s, rng, 1, 2)     # B re-wins term 2: violation
+    assert bug
+    # Higher words track independently of word 0.
+    s, rng, bug = force_win(s, rng, 0, 40)
+    assert not bug
+    s, rng, bug = force_win(s, rng, 2, 40)
+    assert bug
+    s, rng, bug = force_win(s, rng, 0, 100)
+    assert not bug
+    s, rng, bug = force_win(s, rng, 1, 101)
+    assert not bug
+    # Terms >= 32*WON_WORDS saturate into the top bit: distinct huge terms
+    # alias (a documented over-approximation is still a caught duplicate).
+    s, rng, bug = force_win(s, rng, 0, 32 * WON_WORDS + 6)
+    assert not bug
+    s, rng, bug = force_win(s, rng, 1, 32 * WON_WORDS + 99)
+    assert bug
+
+
 def test_five_node_cluster():
     # Proposals are scheduled after the restarts settle: scheduled client
     # proposals have no retry loop, so ones fired into a leaderless window
