@@ -537,6 +537,17 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
 # Main
 # ---------------------------------------------------------------------------
 
+def _isolated(configs: dict, name: str, fn, *args, **kwargs):
+    """Run one benchmark config with failure isolation (VERDICT r2 item 3):
+    a crashing config records {"error": ...} instead of killing the run, so
+    the headline JSON line is always emitted with rc=0."""
+    try:
+        configs[name] = fn(*args, **kwargs)
+    except Exception as exc:
+        log(f"{name} FAILED: {type(exc).__name__}: {exc}")
+        configs[name] = {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -546,6 +557,9 @@ def main() -> None:
     ap.add_argument("--only", type=str, default=None,
                     help="comma list: 3node,rpc,grpc,postgres,5node,"
                          "crosscheck,bug (3node = the headline)")
+    ap.add_argument("--break-config", type=str, default=None,
+                    help="(testing) name of a config to force-fail, proving "
+                         "failure isolation keeps the headline alive")
     args = ap.parse_args()
 
     smoke = args.smoke
@@ -559,34 +573,60 @@ def main() -> None:
         return only is None or name in only
 
     configs = {}
-    if want("rpc"):
-        configs["rpc_pingpong"] = bench_rpc_pingpong(64 if smoke else 1_000)
-    if want("grpc"):
-        configs["grpc_chaos"] = bench_grpc_chaos(
-            n_clients=2 if smoke else 5, sim_seconds=2.0 if smoke else 10.0)
-    if want("postgres"):
-        configs["postgres_skew"] = bench_postgres_skew(16 if smoke else 200)
-    if want("crosscheck"):
-        configs["crosscheck"] = bench_crosscheck(128 if smoke else 4_096)
-    if want("bug"):
-        configs["time_to_first_bug"] = bench_time_to_first_bug(
-            host_seeds_n=16 if smoke else 128,
-            device_worlds=1_024 if smoke else 65_536)
-    if want("5node"):
-        configs["madraft_5node"] = bench_madraft_5node(
-            256 if smoke else 100_000)
 
+    _BREAKABLE = {"3node_device", "3node_host", "rpc", "grpc", "postgres",
+                  "crosscheck", "bug", "5node"}
+    if args.break_config is not None and args.break_config not in _BREAKABLE:
+        ap.error(f"--break-config must be one of {sorted(_BREAKABLE)}")
+
+    def boom(*_a, **_kw):
+        raise RuntimeError("forced failure (--break-config)")
+
+    def pick(name, fn):
+        return boom if args.break_config == name else fn
+
+    # Headline FIRST: a later config crashing must never lose the number.
+    dev_rate = host_rate = None
     if want("3node"):
-        dev_rate = device_seed_rate(n_worlds)
-        host_rate = host_seed_rate(n_host)
-    else:
-        dev_rate = host_rate = None
+        try:
+            dev_rate = pick("3node_device", device_seed_rate)(n_worlds)
+        except Exception as exc:
+            log(f"headline device FAILED: {type(exc).__name__}: {exc}")
+            configs["headline_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            host_rate = pick("3node_host", host_seed_rate)(n_host)
+        except Exception as exc:
+            log(f"headline host baseline FAILED: {type(exc).__name__}: {exc}")
+            configs["baseline_error"] = f"{type(exc).__name__}: {exc}"
+
+    if want("rpc"):
+        _isolated(configs, "rpc_pingpong", pick("rpc", bench_rpc_pingpong),
+                  64 if smoke else 1_000)
+    if want("grpc"):
+        _isolated(configs, "grpc_chaos", pick("grpc", bench_grpc_chaos),
+                  n_clients=2 if smoke else 5,
+                  sim_seconds=2.0 if smoke else 10.0)
+    if want("postgres"):
+        _isolated(configs, "postgres_skew",
+                  pick("postgres", bench_postgres_skew), 16 if smoke else 200)
+    if want("crosscheck"):
+        _isolated(configs, "crosscheck", pick("crosscheck", bench_crosscheck),
+                  128 if smoke else 4_096)
+    if want("bug"):
+        _isolated(configs, "time_to_first_bug",
+                  pick("bug", bench_time_to_first_bug),
+                  host_seeds_n=16 if smoke else 128,
+                  device_worlds=1_024 if smoke else 65_536)
+    if want("5node"):
+        _isolated(configs, "madraft_5node", pick("5node", bench_madraft_5node),
+                  256 if smoke else 100_000)
 
     print(json.dumps({
         "metric": "madraft_3node_1s_seeds_per_sec",
         "value": round(dev_rate, 2) if dev_rate else None,
         "unit": "seeds/s",
-        "vs_baseline": round(dev_rate / host_rate, 2) if dev_rate else None,
+        "vs_baseline": (round(dev_rate / host_rate, 2)
+                        if dev_rate and host_rate else None),
         # vs_baseline denominator caveat (VERDICT r1): the baseline is THIS
         # repo's pure-Python host engine, not the reference's Rust engine
         # (not runnable here); the Rust engine would be faster per seed.
