@@ -1,0 +1,44 @@
+# CI harness (reference analog: .github/workflows/ci.yml:66-125 + Makefile).
+# `make check` is the snapshot gate: every target must pass before a commit
+# that touches runtime behavior ships. Nonzero exit on any failure.
+
+PY ?= python
+# Tests and the determinism sweep run on a virtual 8-device CPU mesh so they
+# pass anywhere (tests/conftest.py pins this too; exporting here covers the
+# non-pytest entry points).
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: check test smoke dryrun determinism native clean
+
+check: test smoke dryrun determinism
+	@echo "ALL CHECKS PASSED"
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+smoke:
+	$(PY) bench.py --smoke > /tmp/bench_smoke.json
+	@tail -1 /tmp/bench_smoke.json | $(PY) -c "import json,sys; \
+	d=json.load(sys.stdin); assert d['value'], d; \
+	bad={k: v for k, v in d['configs'].items() \
+	     if isinstance(v, dict) and 'error' in v}; \
+	assert not bad, f'configs failed: {bad}'; \
+	print('smoke ok:', d['value'], d['unit'])"
+
+dryrun:
+	$(PY) -c "from __graft_entry__ import dryrun_multichip, entry; \
+	          dryrun_multichip(8); print('dryrun_multichip(8) ok'); \
+	          import jax; fn, args = entry(); \
+	          jax.jit(fn).lower(*args).compile(); print('entry() compiles')"
+
+determinism:
+	$(CPU_ENV) MADSIM_TEST_NUM=8 MADSIM_TEST_SEED=0 \
+	MADSIM_TEST_CHECK_DETERMINISM=1 $(PY) tools/determinism_sweep.py
+
+native:
+	$(PY) -c "from madsim_tpu import native; \
+	          assert native.available(), 'native core failed to build'; \
+	          print('native core built:', native._SO)"
+
+clean:
+	rm -f madsim_tpu/native/_core.so /tmp/bench_smoke.json

@@ -25,6 +25,7 @@ config results embedded under "configs". Details go to stderr.
 """
 import argparse
 import json
+import subprocess
 import sys
 import time as walltime
 
@@ -537,15 +538,84 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
 # Main
 # ---------------------------------------------------------------------------
 
-def _isolated(configs: dict, name: str, fn, *args, **kwargs):
-    """Run one benchmark config with failure isolation (VERDICT r2 item 3):
-    a crashing config records {"error": ...} instead of killing the run, so
-    the headline JSON line is always emitted with rc=0."""
+# (short name, JSON key, runner). Short names are the --only/--break-config
+# vocabulary; runners take the parsed args.
+_CONFIGS = [
+    ("rpc", "rpc_pingpong",
+     lambda a: bench_rpc_pingpong(64 if a.smoke else 1_000)),
+    ("grpc", "grpc_chaos",
+     lambda a: bench_grpc_chaos(n_clients=2 if a.smoke else 5,
+                                sim_seconds=2.0 if a.smoke else 10.0)),
+    ("postgres", "postgres_skew",
+     lambda a: bench_postgres_skew(16 if a.smoke else 200)),
+    ("crosscheck", "crosscheck",
+     lambda a: bench_crosscheck(128 if a.smoke else 4_096)),
+    ("bug", "time_to_first_bug",
+     lambda a: bench_time_to_first_bug(
+         host_seeds_n=16 if a.smoke else 128,
+         device_worlds=1_024 if a.smoke else 65_536)),
+    ("5node", "madraft_5node",
+     lambda a: bench_madraft_5node(256 if a.smoke else 100_000)),
+]
+
+
+def _child_argv(args, short: str) -> list:
+    argv = [sys.executable, __file__, "--run-config", short]
+    if args.smoke:
+        argv.append("--smoke")
+    if short == "3node":
+        # Only the headline child consumes the sizing overrides.
+        if args.worlds:
+            argv += ["--worlds", str(args.worlds)]
+        if args.host_seeds:
+            argv += ["--host-seeds", str(args.host_seeds)]
+    if args.break_config:
+        argv += ["--break-config", args.break_config]
+    return argv
+
+
+def _run_config_subprocess(args, short: str, key: str) -> dict:
+    """Run one config in a child process (VERDICT r2 item 3, hardened).
+
+    Process isolation covers the crash classes in-process try/except cannot
+    — XLA/C++ aborts, SIGSEGV, OOM kills — and, because the parent itself
+    never initializes JAX, sequential children can each acquire the
+    (single-process-locked) TPU cleanly."""
+    import threading
+
+    cmd = _child_argv(args, short)
+    limit = 600 if args.smoke else 3600
+    # Stream the child's stderr live (progress logs) while also keeping it
+    # for the error tail; capture stdout (the one JSON line) separately.
+    child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+    err_lines: list = []
+
+    def pump():
+        for line in child.stderr:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            err_lines.append(line)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
     try:
-        configs[name] = fn(*args, **kwargs)
-    except Exception as exc:
-        log(f"{name} FAILED: {type(exc).__name__}: {exc}")
-        configs[name] = {"error": f"{type(exc).__name__}: {exc}"}
+        stdout, _ = child.communicate(timeout=limit)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        child.communicate()
+        log(f"{key} FAILED: timeout after {limit}s")
+        return {"error": f"timeout after {limit}s"}
+    finally:
+        t.join(timeout=5)
+    if child.returncode != 0:
+        tail = [ln.strip() for ln in err_lines[-3:]]
+        log(f"{key} FAILED: rc={child.returncode}")
+        return {"error": f"rc={child.returncode}: " + " | ".join(tail)}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as exc:
+        return {"error": f"bad child output: {exc}"}
 
 
 def main() -> None:
@@ -560,22 +630,16 @@ def main() -> None:
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "
                          "failure isolation keeps the headline alive")
+    ap.add_argument("--run-config", type=str, default=None,
+                    help="(internal) child mode: run ONE config, print its "
+                         "JSON dict, exit nonzero on failure")
+    ap.add_argument("--in-process", action="store_true",
+                    help="run configs in-process (debugging; loses native-"
+                         "crash isolation)")
     args = ap.parse_args()
 
-    smoke = args.smoke
-    # 256k worlds is the measured single-chip sweet spot (HBM-resident, past
-    # the per-iteration overhead knee; larger starts spilling).
-    n_worlds = args.worlds or (256 if smoke else 262_144)
-    n_host = args.host_seeds or (2 if smoke else 8)
-    only = set(args.only.split(",")) if args.only else None
-
-    def want(name: str) -> bool:
-        return only is None or name in only
-
-    configs = {}
-
-    _BREAKABLE = {"3node_device", "3node_host", "rpc", "grpc", "postgres",
-                  "crosscheck", "bug", "5node"}
+    shorts = {c[0] for c in _CONFIGS}
+    _BREAKABLE = shorts | {"3node_device", "3node_host"}
     if args.break_config is not None and args.break_config not in _BREAKABLE:
         ap.error(f"--break-config must be one of {sorted(_BREAKABLE)}")
 
@@ -585,41 +649,71 @@ def main() -> None:
     def pick(name, fn):
         return boom if args.break_config == name else fn
 
-    # Headline FIRST: a later config crashing must never lose the number.
-    dev_rate = host_rate = None
-    if want("3node"):
+    def headline(args) -> dict:
+        """Device + host headline rates; per-half errors go in the dict."""
+        smoke = args.smoke
+        # 256k worlds is the measured single-chip sweet spot (HBM-resident,
+        # past the per-iteration overhead knee; larger starts spilling).
+        n_worlds = args.worlds or (256 if smoke else 262_144)
+        n_host = args.host_seeds or (2 if smoke else 8)
+        out = {}
         try:
-            dev_rate = pick("3node_device", device_seed_rate)(n_worlds)
+            out["dev_rate"] = pick("3node_device", device_seed_rate)(n_worlds)
         except Exception as exc:
             log(f"headline device FAILED: {type(exc).__name__}: {exc}")
-            configs["headline_error"] = f"{type(exc).__name__}: {exc}"
+            out["dev_error"] = f"{type(exc).__name__}: {exc}"
         try:
-            host_rate = pick("3node_host", host_seed_rate)(n_host)
+            out["host_rate"] = pick("3node_host", host_seed_rate)(n_host)
         except Exception as exc:
             log(f"headline host baseline FAILED: {type(exc).__name__}: {exc}")
-            configs["baseline_error"] = f"{type(exc).__name__}: {exc}"
+            out["host_error"] = f"{type(exc).__name__}: {exc}"
+        return out
 
-    if want("rpc"):
-        _isolated(configs, "rpc_pingpong", pick("rpc", bench_rpc_pingpong),
-                  64 if smoke else 1_000)
-    if want("grpc"):
-        _isolated(configs, "grpc_chaos", pick("grpc", bench_grpc_chaos),
-                  n_clients=2 if smoke else 5,
-                  sim_seconds=2.0 if smoke else 10.0)
-    if want("postgres"):
-        _isolated(configs, "postgres_skew",
-                  pick("postgres", bench_postgres_skew), 16 if smoke else 200)
-    if want("crosscheck"):
-        _isolated(configs, "crosscheck", pick("crosscheck", bench_crosscheck),
-                  128 if smoke else 4_096)
-    if want("bug"):
-        _isolated(configs, "time_to_first_bug",
-                  pick("bug", bench_time_to_first_bug),
-                  host_seeds_n=16 if smoke else 128,
-                  device_worlds=1_024 if smoke else 65_536)
-    if want("5node"):
-        _isolated(configs, "madraft_5node", pick("5node", bench_madraft_5node),
-                  256 if smoke else 100_000)
+    if args.run_config is not None:
+        # Child mode: one config, one JSON line, rc=1 on any failure.
+        if args.run_config == "3node":
+            print(json.dumps(headline(args)), flush=True)
+            return
+        for short, _key, runner in _CONFIGS:
+            if short == args.run_config:
+                print(json.dumps(pick(short, runner)(args)), flush=True)
+                return
+        ap.error(f"--run-config must be one of {sorted(shorts | {'3node'})}")
+
+    only = set(args.only.split(",")) if args.only else None
+
+    def want(name: str) -> bool:
+        return only is None or name in only
+
+    # Headline FIRST (its number must survive anything later), then each
+    # other config in its own child process, so a native-level crash
+    # (SIGSEGV/abort/OOM) in any config cannot take the others down — and
+    # the parent stays JAX-free throughout (the TPU is a single-process
+    # resource, released as each sequential child exits).
+    configs = {}
+    dev_rate = host_rate = None
+    if want("3node"):
+        if args.in_process:
+            h = headline(args)
+        else:
+            h = _run_config_subprocess(args, "3node", "headline")
+        dev_rate, host_rate = h.get("dev_rate"), h.get("host_rate")
+        errs = {k: v for k, v in h.items()
+                if k in ("error", "dev_error", "host_error")}
+        if errs:
+            configs["headline_errors"] = errs
+
+    for short, key, runner in _CONFIGS:
+        if not want(short):
+            continue
+        if args.in_process:
+            try:
+                configs[key] = pick(short, runner)(args)
+            except Exception as exc:
+                log(f"{key} FAILED: {type(exc).__name__}: {exc}")
+                configs[key] = {"error": f"{type(exc).__name__}: {exc}"}
+        else:
+            configs[key] = _run_config_subprocess(args, short, key)
 
     print(json.dumps({
         "metric": "madraft_3node_1s_seeds_per_sec",
