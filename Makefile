@@ -8,7 +8,7 @@ PY ?= python
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check test smoke dryrun determinism native clean
+.PHONY: check test smoke dryrun determinism dualmode native clean
 
 check: test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -16,12 +16,17 @@ check: test smoke dryrun determinism
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# The sim/real matrix on its own (also part of `test`): the same worlds
+# executed inside a seeded simulation AND over real asyncio + TCP.
+dualmode:
+	$(PY) -m pytest tests/test_dualmode.py -q
+
 smoke:
 	$(PY) bench.py --smoke > /tmp/bench_smoke.json
 	@tail -1 /tmp/bench_smoke.json | $(PY) -c "import json,sys; \
 	d=json.load(sys.stdin); assert d['value'], d; \
-	bad={k: v for k, v in d['configs'].items() \
-	     if isinstance(v, dict) and 'error' in v}; \
+	bad={k: v for k, v in d['configs'].items() if isinstance(v, dict) \
+	     and ({'error', 'dev_error', 'host_error'} & set(v))}; \
 	assert not bad, f'configs failed: {bad}'; \
 	print('smoke ok:', d['value'], d['unit'])"
 

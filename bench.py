@@ -587,33 +587,42 @@ def _run_config_subprocess(args, short: str, key: str) -> dict:
     limit = 600 if args.smoke else 3600
     # Stream the child's stderr live (progress logs) while also keeping it
     # for the error tail; capture stdout (the one JSON line) separately.
+    # Each pipe has exactly one reader thread — communicate() would race
+    # the stderr pump for the same fd.
     child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE, text=True)
     err_lines: list = []
+    out_box: list = []
 
-    def pump():
+    def pump_err():
         for line in child.stderr:
             sys.stderr.write(line)
             sys.stderr.flush()
             err_lines.append(line)
 
-    t = threading.Thread(target=pump, daemon=True)
-    t.start()
+    def pump_out():
+        out_box.append(child.stdout.read())
+
+    threads = [threading.Thread(target=pump_err, daemon=True),
+               threading.Thread(target=pump_out, daemon=True)]
+    for t in threads:
+        t.start()
     try:
-        stdout, _ = child.communicate(timeout=limit)
+        child.wait(timeout=limit)
     except subprocess.TimeoutExpired:
         child.kill()
-        child.communicate()
+        child.wait()
         log(f"{key} FAILED: timeout after {limit}s")
         return {"error": f"timeout after {limit}s"}
     finally:
-        t.join(timeout=5)
+        for t in threads:
+            t.join(timeout=5)
     if child.returncode != 0:
         tail = [ln.strip() for ln in err_lines[-3:]]
         log(f"{key} FAILED: rc={child.returncode}")
         return {"error": f"rc={child.returncode}: " + " | ".join(tail)}
     try:
-        return json.loads(stdout.strip().splitlines()[-1])
+        return json.loads(out_box[0].strip().splitlines()[-1])
     except (ValueError, IndexError) as exc:
         return {"error": f"bad child output: {exc}"}
 

@@ -1,0 +1,31 @@
+"""Backend-mode resolution: simulation vs production ("real") execution.
+
+The reference compiles every API twice — sim under ``--cfg madsim``, real
+otherwise — and switches at build time (`madsim/src/lib.rs:14-23`). Python
+has no build cfg, so the switch is resolved at call time:
+
+- inside a :class:`~madsim_tpu.core.runtime.Runtime` context (a simulation
+  is running on this thread) → **sim**, always;
+- otherwise, ``MADSIM_BACKEND=real`` in the environment → **real**: the
+  same facades (Endpoint, rpc, time, task, rand, fs, sync) execute over
+  asyncio, framed TCP sockets, the OS clock, and OS entropy
+  (`madsim/src/std/mod.rs:1-7` analog);
+- otherwise → **sim-required**: the APIs raise
+  :class:`~madsim_tpu.core.context.NoRuntimeError` as before, so test code
+  cannot silently run unsimulated.
+
+The same application code therefore runs in both modes unchanged — the
+"same binary, sim for tests, real for prod" contract.
+"""
+from __future__ import annotations
+
+import os
+
+from . import context
+
+
+def is_real() -> bool:
+    """True when APIs should execute on the production (asyncio) backend."""
+    if context.try_current_handle() is not None:
+        return False
+    return os.environ.get("MADSIM_BACKEND", "sim").lower() == "real"

@@ -5,9 +5,19 @@ builds its endpoint mailboxes and relay tasks from (`net/endpoint.rs:241-306`,
 `net/mod.rs:224-260`). They are deliberately *not* asyncio futures: wakeups
 must route through the simulation's ready queue so the seeded random scheduler
 stays the single source of interleaving.
+
+Real-mode bridge: when a SimFuture is awaited while an asyncio event loop is
+running (production backend, ``MADSIM_BACKEND=real`` — the sim executor
+drives coroutines directly and never has a running loop), ``__await__``
+parks on an asyncio future instead of yielding itself. This one hook makes
+every primitive built on SimFuture — Channel, Event, Lock, Semaphore,
+Notify, oneshot — work unchanged on the real backend, the analog of the
+reference passing tokio::sync straight through in std mode
+(`madsim-tokio/src/lib.rs:40-52`).
 """
 from __future__ import annotations
 
+import asyncio
 from collections import deque
 from typing import Any, Callable, Deque, List, Optional
 
@@ -67,7 +77,32 @@ class SimFuture:
 
     def __await__(self):
         if not self.done():
-            yield self
+            loop = None
+            # The sim context wins unconditionally: under aio.patched() the
+            # shim substitutes asyncio.get_running_loop, so the loop probe
+            # alone cannot distinguish the backends.
+            from . import context
+
+            if context.try_current_handle() is None:
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+            if loop is None:
+                yield self  # sim executor: wake via the random scheduler
+            else:
+                bridge = loop.create_future()
+
+                def _complete(_f, loop=loop, bridge=bridge):
+                    # set_result may fire from a worker thread (e.g.
+                    # spawn_blocking); only call_soon_threadsafe wakes the
+                    # loop's selector from a foreign thread.
+                    loop.call_soon_threadsafe(
+                        lambda: bridge.set_result(None)
+                        if not bridge.done() else None)
+
+                self.add_done_callback(_complete)
+                yield from bridge.__await__()
         return self.result()
 
 

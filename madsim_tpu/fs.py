@@ -78,6 +78,17 @@ class Metadata:
         self.len = length
 
 
+def _real_fs():
+    """Real-backend twin (``std/fs.rs`` analog) or None when simulating."""
+    from .core.backend import is_real
+
+    if is_real():
+        from .real import fs as real_fs
+
+        return real_fs
+    return None
+
+
 class File:
     """Positional-I/O file handle (`fs.rs:161-229`)."""
 
@@ -87,6 +98,9 @@ class File:
 
     @staticmethod
     async def create(path: str) -> "File":
+        real = _real_fs()
+        if real is not None:
+            return await real.RealFile.create(path)
         sim = _fs()
         await sim._io_delay()
         inode = _INode()
@@ -95,6 +109,9 @@ class File:
 
     @staticmethod
     async def open(path: str) -> "File":
+        real = _real_fs()
+        if real is not None:
+            return await real.RealFile.open(path)
         sim = _fs()
         await sim._io_delay()
         inode = sim._disk().get(str(path))
@@ -104,6 +121,9 @@ class File:
 
     @staticmethod
     async def open_or_create(path: str) -> "File":
+        real = _real_fs()
+        if real is not None:
+            return await real.RealFile.open_or_create(path)
         sim = _fs()
         await sim._io_delay()
         inode = sim._disk().setdefault(str(path), _INode())
@@ -148,19 +168,31 @@ class File:
 
 async def read(path: str) -> bytes:
     """Read a whole file (`fs.rs:232-238`)."""
+    real = _real_fs()
+    if real is not None:
+        return await real.read(path)
     f = await File.open(path)
     return await f.read_all()
 
 async def write(path: str, data: bytes) -> None:
+    real = _real_fs()
+    if real is not None:
+        return await real.write(path, data)
     f = await File.open_or_create(path)
     await f.set_len(0)
     await f.write_all_at(bytes(data), 0)
 
 async def metadata(path: str) -> Metadata:
+    real = _real_fs()
+    if real is not None:
+        return await real.metadata(path)
     f = await File.open(path)
     return await f.metadata()
 
 async def remove_file(path: str) -> None:
+    real = _real_fs()
+    if real is not None:
+        return await real.remove_file(path)
     sim = _fs()
     await sim._io_delay()
     if sim._disk().pop(str(path), None) is None:

@@ -107,12 +107,26 @@ class Endpoint:
     # -- constructors ------------------------------------------------------
     @staticmethod
     async def bind(addr: AddrLike) -> "Endpoint":
+        from ..core.backend import is_real
+
+        if is_real():
+            # Production backend: the same tag-matching API over framed
+            # real TCP (`std/net/tcp.rs:20-324` analog).
+            from ..real.net import RealEndpoint
+
+            return await RealEndpoint.bind(addr)
         socket = _EndpointSocket()
         guard = await BindGuard.bind(addr, IpProtocol.UDP, socket)
         return Endpoint(guard, socket)
 
     @staticmethod
     async def connect(addr: AddrLike) -> "Endpoint":
+        from ..core.backend import is_real
+
+        if is_real():
+            from ..real.net import RealEndpoint
+
+            return await RealEndpoint.connect(addr)
         peer = (await lookup_host(addr))[0]
         ep = await Endpoint.bind("0.0.0.0:0")
         ep._peer = peer

@@ -43,10 +43,11 @@ async def call(ep: Endpoint, dst: AddrLike, request: Any, timeout: Optional[floa
 async def call_with_data(ep: Endpoint, dst: AddrLike, request: Any, data: bytes,
                          timeout: Optional[float] = None) -> Tuple[Any, bytes]:
     """Send an RPC with a raw data sidecar → (response, response_data)."""
+    from .. import rand as _rand
     from .. import time as vtime
 
     dst_addr = (await lookup_host(dst))[0]
-    rsp_tag = context.current_handle().rand.next_u64()
+    rsp_tag = _rand.thread_rng().next_u64()
     await ep.send_to_raw(dst_addr, type_tag(type(request)), (rsp_tag, request, data))
 
     async def _recv():
@@ -77,9 +78,11 @@ def add_rpc_handler_with_data(ep: Endpoint, req_type: Type,
 
     Spawns a dispatcher loop on the current node; each request runs in a
     fresh task so slow handlers don't serialize the endpoint
-    (`rpc.rs:134-166`).
+    (`rpc.rs:134-166`). Works on both backends: spawn routes to the sim
+    executor in-sim and to asyncio tasks in real mode.
     """
-    executor = context.current_handle().task
+    from .. import task as _task
+
     tag = type_tag(req_type)
 
     async def dispatcher():
@@ -95,11 +98,14 @@ def add_rpc_handler_with_data(ep: Endpoint, req_type: Type,
                     resp, rsp_data = await handler(request, data)
                 except RpcError as exc:
                     resp, rsp_data = _RpcFault(str(exc)), b""
-                await ep.send_to_raw(from_addr, rsp_tag, (resp, rsp_data))
+                try:
+                    await ep.send_to_raw(from_addr, rsp_tag, (resp, rsp_data))
+                except (BrokenPipe, ConnectionReset, OSError):
+                    pass  # caller vanished; response undeliverable
 
-            executor.spawn(handle_one())
+            _task.spawn(handle_one())
 
-    executor.spawn(dispatcher())
+    _task.spawn(dispatcher())
 
 
 class RpcError(Exception):
@@ -114,7 +120,9 @@ class _RpcFault:
 
 
 # Ergonomic method-style access, mirroring the reference's trait impls on
-# Endpoint (`rpc.rs:94-166`).
+# Endpoint (`rpc.rs:94-166`). RealEndpoint gets the same methods attached
+# from real/net.py when the real backend actually loads — sim-only runs
+# never import the real twin.
 Endpoint.call = call  # type: ignore[attr-defined]
 Endpoint.call_with_data = call_with_data  # type: ignore[attr-defined]
 Endpoint.add_rpc_handler = add_rpc_handler  # type: ignore[attr-defined]

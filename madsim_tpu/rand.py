@@ -7,6 +7,7 @@ simulated world comes from the seed.
 from __future__ import annotations
 
 from .core import context
+from .core.backend import is_real
 from .core.rng import DeterminismError, GlobalRng  # noqa: F401 (re-export)
 
 __all__ = ["thread_rng", "random", "gen_range", "gen_bool", "shuffle", "choice",
@@ -14,7 +15,12 @@ __all__ = ["thread_rng", "random", "gen_range", "gen_bool", "shuffle", "choice",
 
 
 def thread_rng() -> GlobalRng:
-    """The current simulation's global RNG."""
+    """The current simulation's global RNG (real backend: OS entropy with
+    the same call surface, `std/mod.rs:5` re-export analog)."""
+    if is_real():
+        from .real import thread_rng as real_thread_rng
+
+        return real_thread_rng()
     return context.current_handle().rand
 
 

@@ -1,0 +1,64 @@
+"""Production ("real") execution backend.
+
+The twin of the simulation that the reference keeps under
+``madsim/src/std/`` (`std/mod.rs:1-7`): when code written against the
+madsim_tpu facades runs outside a simulation with ``MADSIM_BACKEND=real``,
+the facades delegate here — real asyncio tasks and sleeps, the OS clock,
+OS entropy, real files, and a tag-matching Endpoint over framed TCP
+(`std/net/tcp.rs:20-324` analog in :mod:`madsim_tpu.real.net`).
+
+Nothing in this package is deterministic — that is the point: the same
+application binary that was exhaustively seed-swept in simulation runs
+here against the real world.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+from typing import Any, List, Sequence
+
+
+class RealRng:
+    """OS-entropy-seeded RNG with the GlobalRng call surface.
+
+    The real-mode analog of the reference re-exporting the real ``rand``
+    crate outside sim (`madsim/src/std/mod.rs:5`): same method names as
+    :class:`madsim_tpu.core.rng.GlobalRng`, nondeterministic values.
+    """
+
+    def __init__(self):
+        self._rng = _pyrandom.Random(int.from_bytes(os.urandom(16), "little"))
+
+    # -- GlobalRng surface -------------------------------------------------
+    def next_u64(self) -> int:
+        return self._rng.getrandbits(64)
+
+    def random(self) -> float:
+        return self._rng.random()
+
+    def gen_range(self, low: int, high: int) -> int:
+        if high <= low:
+            raise ValueError("empty range")
+        return self._rng.randrange(low, high)
+
+    def gen_range_f64(self, low: float, high: float) -> float:
+        return self._rng.uniform(low, high)
+
+    def gen_bool(self, p: float) -> bool:
+        return self._rng.random() < p
+
+    def shuffle(self, seq: List[Any]) -> None:
+        self._rng.shuffle(seq)
+
+    def choice(self, seq: Sequence[Any]) -> Any:
+        return self._rng.choice(seq)
+
+    def gen_bytes(self, n: int) -> bytes:
+        return os.urandom(n)
+
+
+_thread_rng: RealRng = RealRng()
+
+
+def thread_rng() -> RealRng:
+    return _thread_rng

@@ -1,0 +1,113 @@
+"""Real-filesystem backend for the positional-I/O File API.
+
+The production twin of :mod:`madsim_tpu.fs` (`madsim/src/std/fs.rs` analog:
+the same create/open/read_at/write_all_at/set_len/sync_all surface over the
+real disk). I/O runs on worker threads via ``asyncio.to_thread`` — the
+tokio::fs model — so the event loop never blocks on disk.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+
+class Metadata:
+    __slots__ = ("len",)
+
+    def __init__(self, length: int):
+        self.len = length
+
+
+class RealFile:
+    """Positional-I/O handle over a real OS file."""
+
+    def __init__(self, fd: int, path: str):
+        self._fd = fd
+        self.path = path
+        self._closed = False
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    async def create(path: str) -> "RealFile":
+        fd = await asyncio.to_thread(
+            os.open, str(path), os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o644)
+        return RealFile(fd, str(path))
+
+    @staticmethod
+    async def open(path: str) -> "RealFile":
+        fd = await asyncio.to_thread(os.open, str(path), os.O_RDWR)
+        return RealFile(fd, str(path))
+
+    @staticmethod
+    async def open_or_create(path: str) -> "RealFile":
+        fd = await asyncio.to_thread(
+            os.open, str(path), os.O_RDWR | os.O_CREAT, 0o644)
+        return RealFile(fd, str(path))
+
+    # -- I/O ---------------------------------------------------------------
+    async def read_at(self, offset: int, length: int) -> bytes:
+        return await asyncio.to_thread(os.pread, self._fd, length, offset)
+
+    async def read_all(self) -> bytes:
+        def _read():
+            size = os.fstat(self._fd).st_size
+            return os.pread(self._fd, size, 0)
+
+        return await asyncio.to_thread(_read)
+
+    async def write_all_at(self, data: bytes, offset: int) -> None:
+        def _write():
+            view = memoryview(bytes(data))
+            pos = offset
+            while view:
+                n = os.pwrite(self._fd, view, pos)
+                view = view[n:]
+                pos += n
+
+        await asyncio.to_thread(_write)
+
+    async def set_len(self, length: int) -> None:
+        await asyncio.to_thread(os.ftruncate, self._fd, length)
+
+    async def sync_all(self) -> None:
+        await asyncio.to_thread(os.fsync, self._fd)
+
+    async def metadata(self) -> Metadata:
+        st = await asyncio.to_thread(os.fstat, self._fd)
+        return Metadata(st.st_size)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+
+    def __del__(self):
+        try:
+            self.close()
+        except OSError:
+            pass
+
+
+async def read(path: str) -> bytes:
+    f = await RealFile.open(path)
+    try:
+        return await f.read_all()
+    finally:
+        f.close()
+
+
+async def write(path: str, data: bytes) -> None:
+    f = await RealFile.create(path)
+    try:
+        await f.write_all_at(bytes(data), 0)
+    finally:
+        f.close()
+
+
+async def metadata(path: str) -> Metadata:
+    st = await asyncio.to_thread(os.stat, str(path))
+    return Metadata(st.st_size)
+
+
+async def remove_file(path: str) -> None:
+    await asyncio.to_thread(os.remove, str(path))

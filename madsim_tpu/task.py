@@ -4,28 +4,64 @@ Reference: `madsim/src/sim/task.rs:369-459` — tokio-style spawn returning an
 abortable, awaitable JoinHandle. ``spawn_local`` is an alias (the whole world
 is one thread); ``spawn_blocking`` wraps a sync callable as a task that runs
 to completion at its scheduling point.
+
+Real backend: spawn delegates to asyncio tasks (the reference's std mode
+re-exporting tokio::task, `std/mod.rs:7`); spawn_blocking uses a worker
+thread, like tokio's.
 """
 from __future__ import annotations
 
 from typing import Any, Callable, Coroutine
 
 from .core import context
+from .core.backend import is_real
 from .core.task import JoinHandle  # noqa: F401 (re-export)
 
 __all__ = ["spawn", "spawn_local", "spawn_blocking", "JoinHandle",
            "available_parallelism", "current_node"]
 
 
-def spawn(coro: Coroutine) -> JoinHandle:
+class RealJoinHandle:
+    """JoinHandle surface over an asyncio task (abort/detach/await)."""
+
+    __slots__ = ("_task",)
+
+    def __init__(self, task):
+        self._task = task
+
+    def abort(self) -> None:
+        self._task.cancel()
+
+    def detach(self) -> None:
+        pass
+
+    def done(self) -> bool:
+        return self._task.done()
+
+    def __await__(self):
+        return self._task.__await__()
+
+
+def spawn(coro: Coroutine) -> "JoinHandle | RealJoinHandle":
     """Spawn a coroutine as a task on the current node."""
+    if is_real():
+        import asyncio
+
+        return RealJoinHandle(asyncio.get_running_loop().create_task(coro))
     return context.current_handle().task.spawn(coro)
 
 
-def spawn_local(coro: Coroutine) -> JoinHandle:
+def spawn_local(coro: Coroutine) -> "JoinHandle | RealJoinHandle":
     return spawn(coro)
 
 
-def spawn_blocking(fn: Callable[[], Any]) -> JoinHandle:
+def spawn_blocking(fn: Callable[[], Any]) -> "JoinHandle | RealJoinHandle":
+    if is_real():
+        import asyncio
+
+        return RealJoinHandle(
+            asyncio.get_running_loop().create_task(asyncio.to_thread(fn)))
+
     async def _runner():
         return fn()
 
@@ -35,6 +71,10 @@ def spawn_blocking(fn: Callable[[], Any]) -> JoinHandle:
 def available_parallelism() -> int:
     """The current node's configured core count (the analog of the
     sched_getaffinity/sysconf interception at `task.rs:508-560`)."""
+    if is_real():
+        import os
+
+        return os.cpu_count() or 1
     return context.current_task().node.cores
 
 

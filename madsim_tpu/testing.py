@@ -83,7 +83,25 @@ class Builder:
         """Run the simulation for each seed; returns the last result.
 
         On failure, prints the reproduction banner and re-raises.
+
+        Real backend (MADSIM_BACKEND=real, the reference's std-mode
+        ``#[tokio::test]`` rewrite, `madsim-macros/src/lib.rs:115-153`):
+        no seeds exist — the body runs once on asyncio against the real
+        world; this is what the dual-mode CI matrix exercises.
         """
+        from .core.backend import is_real
+
+        if is_real():
+            import asyncio
+
+            coro = make_coro()
+            if self.time_limit is not None:
+                async def _limited(c=coro, limit=self.time_limit):
+                    return await asyncio.wait_for(c, limit)
+
+                return asyncio.run(_limited())
+            return asyncio.run(coro)
+
         result: Any = None
         seeds = range(self.seed, self.seed + self.count)
 
@@ -181,7 +199,21 @@ def main(fn: Callable[..., Coroutine]) -> Callable:
 
 def run(coro: Coroutine, seed: int = 0, config: Optional[Config] = None,
         time_limit: Optional[float] = None) -> Any:
-    """One-shot convenience: run a coroutine in a fresh seeded Runtime."""
+    """One-shot convenience: run a coroutine in a fresh seeded Runtime.
+
+    Real backend: runs the same coroutine on asyncio (seed/config ignored
+    — there is no simulated world to seed)."""
+    from .core.backend import is_real
+
+    if is_real():
+        import asyncio
+
+        if time_limit is not None:
+            async def _limited():
+                return await asyncio.wait_for(coro, time_limit)
+
+            return asyncio.run(_limited())
+        return asyncio.run(coro)
     rt = Runtime(seed=seed, config=config)
     if time_limit is not None:
         rt.set_time_limit(time_limit)

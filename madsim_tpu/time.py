@@ -5,14 +5,22 @@ Reference: `madsim/src/sim/time/{mod,sleep,interval}.rs` — ``sleep``,
 tokio-style ``Interval`` with the three MissedTickBehavior variants
 (`interval.rs:38-188`), plus ``Instant``/``SystemTime`` reads of the mock
 clock. Durations are float seconds at the API; integer nanoseconds inside.
+
+Real backend (``MADSIM_BACKEND=real`` outside a simulation): the same
+functions read the OS clocks and delegate sleeping/timeouts to asyncio —
+the reference's std mode re-exporting tokio::time (`std/mod.rs:1-7`,
+`std/time.rs`). Interval and Instant are clock-generic and work in both
+modes unmodified.
 """
 from __future__ import annotations
 
 import enum
+import time as _ostime
 from functools import total_ordering
 from typing import Any, Awaitable, Optional
 
 from .core import context
+from .core.backend import is_real
 from .core.futures import SimFuture
 from .core.timewheel import NANOS_PER_SEC, to_ns
 
@@ -30,7 +38,10 @@ def _time():
 # -- clock reads -----------------------------------------------------------
 
 def monotonic_ns() -> int:
-    """Virtual monotonic nanoseconds since simulation start."""
+    """Virtual monotonic nanoseconds since simulation start (real backend:
+    the OS monotonic clock)."""
+    if is_real():
+        return _ostime.monotonic_ns()
     return _time().now_ns()
 
 
@@ -41,7 +52,10 @@ def monotonic() -> float:
 def system_time_ns() -> int:
     """Simulated wall-clock unix-epoch nanoseconds (seed-randomized base in
     2022, `time/mod.rs:27-32`), as observed by the current node — i.e. with
-    the node's injected clock skew applied (``Handle.set_clock_skew``)."""
+    the node's injected clock skew applied (``Handle.set_clock_skew``).
+    Real backend: the OS wall clock."""
+    if is_real():
+        return _ostime.time_ns()
     return _time().system_time_ns(context.current_node_id())
 
 
@@ -91,19 +105,31 @@ class Instant:
 
 # -- sleeping --------------------------------------------------------------
 
-def sleep(seconds: float) -> SimFuture:
+def sleep(seconds: float) -> Awaitable[None]:
     """Awaitable that completes after virtual ``seconds``. The timer is
     registered at call time (tokio Sleep semantics)."""
-    return sleep_until_ns(_time().now_ns() + to_ns(seconds))
+    return sleep_until_ns(monotonic_ns() + to_ns(seconds))
 
 
-def sleep_until(instant: "Instant | float") -> SimFuture:
+def sleep_until(instant: "Instant | float") -> Awaitable[None]:
     """Sleep until an :class:`Instant` (or float virtual-monotonic seconds)."""
     ns = instant.ns if isinstance(instant, Instant) else to_ns(instant)
     return sleep_until_ns(ns)
 
 
-def sleep_until_ns(deadline_ns: int) -> SimFuture:
+def sleep_until_ns(deadline_ns: int) -> Awaitable[None]:
+    if is_real():
+        import asyncio
+
+        # The deadline is fixed at call time (tokio Sleep semantics); the
+        # remaining delta is computed at await time so awaiting late does
+        # not extend the sleep.
+        async def _sleep():
+            delta = (deadline_ns - _ostime.monotonic_ns()) / NANOS_PER_SEC
+            if delta > 0:
+                await asyncio.sleep(delta)
+
+        return _sleep()
     time = _time()
     fut = SimFuture()
     if deadline_ns <= time.now_ns():
@@ -118,7 +144,15 @@ def sleep_until_ns(deadline_ns: int) -> SimFuture:
 async def timeout(seconds: float, awaitable: Awaitable[Any]) -> Any:
     """Run ``awaitable`` with a virtual-time deadline; raises
     :class:`TimeoutError` if the deadline elapses first
-    (`time/mod.rs:122-134`)."""
+    (`time/mod.rs:122-134`). Real backend: asyncio.wait_for (same abort-
+    the-inner-future semantics on expiry)."""
+    if is_real():
+        import asyncio
+
+        try:
+            return await asyncio.wait_for(awaitable, seconds)
+        except asyncio.TimeoutError:
+            raise TimeoutError() from None
     handle = context.current_handle()
     result: SimFuture = SimFuture()
 
@@ -160,13 +194,13 @@ class Interval:
             raise ValueError("interval period must be positive")
         self.period_ns = to_ns(period)
         self.missed_tick_behavior = missed_tick_behavior
-        self._next_ns = start_ns if start_ns is not None else _time().now_ns()
+        self._next_ns = start_ns if start_ns is not None else monotonic_ns()
 
     async def tick(self) -> Instant:
         """Wait for the next tick; returns its scheduled timestamp."""
         await sleep_until_ns(self._next_ns)
         scheduled = self._next_ns
-        now = _time().now_ns()
+        now = monotonic_ns()
         behavior = self.missed_tick_behavior
         if behavior is MissedTickBehavior.BURST:
             self._next_ns = scheduled + self.period_ns
@@ -178,7 +212,7 @@ class Interval:
         return Instant(scheduled)
 
     def reset(self) -> None:
-        self._next_ns = _time().now_ns() + self.period_ns
+        self._next_ns = monotonic_ns() + self.period_ns
 
 
 def interval(period: float) -> Interval:
