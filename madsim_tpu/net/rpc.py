@@ -46,9 +46,9 @@ async def call_with_data(ep: Endpoint, dst: AddrLike, request: Any, data: bytes,
     from .. import rand as _rand
     from .. import time as vtime
 
-    dst_addr = (await lookup_host(dst))[0]
     rsp_tag = _rand.thread_rng().next_u64()
-    await ep.send_to_raw(dst_addr, type_tag(type(request)), (rsp_tag, request, data))
+    # send_to resolves the address per backend (sim parser vs real DNS).
+    await ep.send_to(dst, type_tag(type(request)), (rsp_tag, request, data))
 
     async def _recv():
         payload, from_addr = await ep.recv_from_raw(rsp_tag)

@@ -23,11 +23,34 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import socket as _socket
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..net.addr import Addr, AddrLike, lookup_host
+from ..net.addr import Addr, AddrLike, AddrParseError, lookup_host
 from ..net.network import BrokenPipe, NetworkError
+
+
+async def real_lookup(addr: AddrLike) -> Addr:
+    """Resolve an address for the real backend, including DNS hostnames.
+
+    The sim parser only accepts numeric IPs (no DNS inside a simulation);
+    production addresses are names, so fall back to getaddrinfo — the
+    `std/net/addr` path resolving through tokio's lookup_host.
+    """
+    try:
+        return (await lookup_host(addr))[0]
+    except AddrParseError:
+        if isinstance(addr, tuple):
+            host, port = addr
+        else:
+            host, _, port = str(addr).rpartition(":")
+        infos = await asyncio.get_running_loop().getaddrinfo(
+            host, int(port), type=_socket.SOCK_STREAM)
+        if not infos:
+            raise OSError(f"cannot resolve {addr!r}") from None
+        ip, rport = infos[0][4][:2]
+        return (ip, rport)
 
 _HDR = struct.Struct(">I")        # frame length
 _TAGFMT = struct.Struct(">QB")    # tag u64 + fmt u8
@@ -125,7 +148,7 @@ class RealEndpoint:
     # -- constructors ------------------------------------------------------
     @staticmethod
     async def bind(addr: AddrLike) -> "RealEndpoint":
-        host, port = (await lookup_host(addr))[0]
+        host, port = await real_lookup(addr)
         ep = RealEndpoint()
         ep._server = await asyncio.start_server(ep._on_accept, host, port)
         sock = ep._server.sockets[0]
@@ -139,7 +162,7 @@ class RealEndpoint:
 
     @staticmethod
     async def connect(addr: AddrLike) -> "RealEndpoint":
-        peer = (await lookup_host(addr))[0]
+        peer = await real_lookup(addr)
         ep = await RealEndpoint.bind("0.0.0.0:0")
         ep._peer = peer
         return ep
@@ -170,7 +193,13 @@ class RealEndpoint:
             return
         fut = asyncio.get_running_loop().create_future()
         fut.set_result(_Conn(writer))
+        prev = self._conns.get(peer)
         self._conns[peer] = fut
+        if prev is not None and prev.done() and prev.exception() is None:
+            # A stale duplicate connection loses to the fresh one
+            # (`tcp.rs:99-101` warns on duplicates); close it so its fd
+            # doesn't leak.
+            prev.result().writer.close()
         self._spawn_reader(reader, writer, peer)
 
     def _spawn_reader(self, reader, writer, peer: Addr) -> None:
@@ -195,8 +224,14 @@ class RealEndpoint:
             pass
         finally:
             # Closed by remote: drop the cached sender so later sends
-            # reconnect (`tcp.rs:144-150`).
-            self._conns.pop(peer, None)
+            # reconnect (`tcp.rs:144-150`) — but only if the cache still
+            # points at THIS connection; a newer one must not be evicted
+            # by a stale teardown.
+            cached = self._conns.get(peer)
+            if (cached is not None and cached.done()
+                    and cached.exception() is None
+                    and cached.result().writer is writer):
+                self._conns.pop(peer, None)
             writer.close()
 
     async def _get_or_connect(self, dst: Addr) -> _Conn:
@@ -206,6 +241,18 @@ class RealEndpoint:
             self._conns[dst] = fut
             try:
                 reader, writer = await asyncio.open_connection(dst[0], dst[1])
+            except BaseException as exc:
+                # Cancellation (or any failure) must not leave a forever-
+                # pending future cached: later senders would await it and
+                # hang. Evict and fail it before propagating.
+                if self._conns.get(dst) is fut:
+                    self._conns.pop(dst, None)
+                if not fut.done():
+                    fut.set_exception(
+                        exc if isinstance(exc, (ConnectionError, OSError))
+                        else BrokenPipe(f"connect cancelled: {exc!r}"))
+                raise
+            try:
                 # Handshake: advertise the address the peer can reach our
                 # listener at. For a wildcard bind the bound IP is not
                 # routable, so use this connection's local interface IP —
@@ -218,17 +265,20 @@ class RealEndpoint:
                 await writer.drain()
                 self._spawn_reader(reader, writer, dst)
                 fut.set_result(_Conn(writer))
-            except (ConnectionError, OSError) as exc:
-                self._conns.pop(dst, None)
+            except BaseException as exc:
+                if self._conns.get(dst) is fut:
+                    self._conns.pop(dst, None)
                 if not fut.done():
-                    fut.set_exception(exc)
+                    fut.set_exception(
+                        exc if isinstance(exc, (ConnectionError, OSError))
+                        else BrokenPipe(f"handshake failed: {exc!r}"))
+                writer.close()
                 raise
         return await asyncio.shield(fut)
 
     # -- datagram path -----------------------------------------------------
     async def send_to(self, dst: AddrLike, tag: int, data: Any) -> None:
-        dst_addr = (await lookup_host(dst))[0]
-        await self.send_to_raw(dst_addr, tag, data)
+        await self.send_to_raw(await real_lookup(dst), tag, data)
 
     async def send_to_raw(self, dst: Addr, tag: int, data: Any) -> None:
         if self._closed:

@@ -1,0 +1,435 @@
+"""Drop-in ``grpc.aio`` surface over the simulated network.
+
+The madsim-tonic model (`madsim-tonic/src/lib.rs:1-8`): *outside* a
+simulation the real grpc package is untouched; *inside* one, the patched
+``grpc.aio.server()`` / ``grpc.aio.insecure_channel()`` return sim
+implementations speaking grpc_sim's boxed-message protocol — so unmodified
+code written against grpcio's async API (including protoc/grpcio-generated
+stubs, which only consume this surface) runs deterministically in-sim.
+
+What generated code needs, and what is provided here:
+
+- client side: ``channel.unary_unary/unary_stream/stream_unary/
+  stream_stream(path, request_serializer=..., response_deserializer=...)``
+  multicallables (+ async context manager on the channel);
+- server side: ``server.add_generic_rpc_handlers(...)`` (the object built
+  by ``grpc.method_handlers_generic_handler``), grpcio>=1.60's
+  ``add_registered_method_handlers``, ``add_insecure_port``, ``start``,
+  ``wait_for_termination``, ``stop``;
+- errors: sim failures raise a ``grpc.RpcError`` subclass with
+  ``code()``/``details()`` so unmodified ``except grpc.RpcError`` handlers
+  work.
+
+Serializers are honored when present — messages cross the simulated wire
+as real serialized bytes (protobuf or otherwise), exercising the app's
+codec exactly as the real transport would (`madsim-tonic`'s BoxMessage
+skips this; bytes are the stronger fidelity choice for Python where the
+serializer is first-class).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, AsyncIterator, Callable, Dict, Optional, Tuple
+
+import grpc as _grpc
+
+from .. import task as _task
+from .. import time as _vtime
+from ..core import context as _context
+from ..core.futures import Cancelled, ChannelClosed
+from ..net import Endpoint
+from ..net.addr import AddrLike, lookup_host
+from ..net.netsim import BrokenPipe, ConnectionRefused, ConnectionReset
+from . import grpc_sim
+from .grpc_sim import _END, _pump, _request_stream
+
+_KINDS = {
+    (False, False): "unary_unary",
+    (False, True): "unary_stream",
+    (True, False): "stream_unary",
+    (True, True): "stream_stream",
+}
+
+
+class SimAioRpcError(_grpc.RpcError):
+    """In-sim RPC failure, catchable as grpc.RpcError by unmodified code."""
+
+    def __init__(self, code: _grpc.StatusCode, details: str = ""):
+        super().__init__(f"{code.name}: {details}")
+        self._code = code
+        self._details = details
+
+    def code(self) -> _grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+
+def _to_grpc_code(code) -> _grpc.StatusCode:
+    return getattr(_grpc.StatusCode, code.name, _grpc.StatusCode.UNKNOWN)
+
+
+def _raise_status(status: grpc_sim.Status) -> None:
+    raise SimAioRpcError(_to_grpc_code(status.code), status.details)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class _HandlerCallDetails:
+    __slots__ = ("method", "invocation_metadata")
+
+    def __init__(self, method: str):
+        self.method = method
+        self.invocation_metadata = ()
+
+
+class SimAioServer:
+    """grpc.aio.Server-shaped server over the sim endpoint transport."""
+
+    def __init__(self):
+        self._generic_handlers = []
+        self._registered: Dict[str, Any] = {}
+        self._ports = []
+        self._ep: Optional[Endpoint] = None
+        self._accept_task = None
+        self._stopped = None
+
+    # -- registration (both grpcio generated-code generations) -------------
+    def add_generic_rpc_handlers(self, handlers) -> None:
+        self._generic_handlers.extend(handlers)
+
+    def add_registered_method_handlers(self, service_name: str,
+                                       method_handlers: Dict[str, Any]) -> None:
+        for method, handler in method_handlers.items():
+            self._registered[f"/{service_name}/{method}"] = handler
+
+    def add_insecure_port(self, address: str) -> int:
+        port = int(str(address).rsplit(":", 1)[1])
+        if port == 0:
+            # Ephemeral ports can't be returned from this sync call in-sim
+            # (binding is async); simulations own their address space, so a
+            # fixed virtual port is the idiom. Fail loudly over misrouting.
+            raise ValueError(
+                "in-sim grpc server cannot bind port 0; pick a fixed "
+                "virtual port (the simulation owns the address space)")
+        if self._ports:
+            raise ValueError("in-sim grpc server supports a single port")
+        self._ports.append(address)
+        return port
+
+    def add_secure_port(self, address: str, credentials=None) -> int:
+        # TLS has no meaning in-sim (`madsim-tonic` accepts and ignores it).
+        return self.add_insecure_port(address)
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        if not self._ports:
+            raise RuntimeError("add_insecure_port before start")
+        from .. import sync as _sync
+
+        self._stopped = _sync.Event()
+        self._ep = await Endpoint.bind(self._ports[0])
+        self._accept_task = _task.spawn(self._accept_loop())
+
+    async def wait_for_termination(self, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            await self._stopped.wait()
+            return True
+        try:
+            await _vtime.timeout(timeout, self._stopped.wait())
+            return True
+        except TimeoutError:
+            return False
+
+    async def stop(self, grace: Optional[float] = None) -> None:
+        if self._accept_task is not None:
+            self._accept_task.abort()
+        if self._ep is not None:
+            self._ep.close()
+        if self._stopped is not None:
+            self._stopped.set()
+
+    # -- dispatch -----------------------------------------------------------
+    def _resolve(self, path: str):
+        handler = self._registered.get(path)
+        if handler is None:
+            details = _HandlerCallDetails(path)
+            for gh in self._generic_handlers:
+                handler = gh.service(details)
+                if handler is not None:
+                    break
+        return handler
+
+    async def _accept_loop(self) -> None:
+        while True:
+            try:
+                tx, rx, src = await self._ep.accept1()
+            except (ConnectionReset, ChannelClosed):
+                return
+            _task.spawn(self._handle_conn(tx, rx, src))
+
+    async def _handle_conn(self, tx, rx, src) -> None:
+        try:
+            path, first = await rx.recv()
+        except (ChannelClosed, BrokenPipe, ConnectionReset):
+            return
+        ctx = grpc_sim.ServicerContext(src)
+        try:
+            handler = self._resolve(path)
+            if handler is None:
+                raise grpc_sim.Status(grpc_sim.StatusCode.UNIMPLEMENTED,
+                                      f"unknown path {path}")
+            deser = handler.request_deserializer or (lambda b: b)
+            ser = handler.response_serializer or (lambda m: m)
+            kind = _KINDS[(handler.request_streaming,
+                           handler.response_streaming)]
+            fn = getattr(handler, kind)
+
+            async def req_iter():
+                async for raw in _request_stream(rx):
+                    yield deser(raw)
+
+            if kind == "unary_unary":
+                rsp = await fn(deser(first), ctx)
+                await tx.send(("ok", ser(rsp)))
+            elif kind == "unary_stream":
+                async for rsp in fn(deser(first), ctx):
+                    await tx.send(("ok", ser(rsp)))
+                await tx.send(_END)
+            elif kind == "stream_unary":
+                rsp = await fn(req_iter(), ctx)
+                await tx.send(("ok", ser(rsp)))
+            else:  # stream_stream
+                async for rsp in fn(req_iter(), ctx):
+                    await tx.send(("ok", ser(rsp)))
+                await tx.send(_END)
+        except grpc_sim.Status as status:
+            await grpc_sim._try_send(tx, ("err", status))
+        except (ChannelClosed, BrokenPipe, ConnectionReset, Cancelled):
+            pass
+        except Exception as exc:  # noqa: BLE001 — surface as INTERNAL
+            await grpc_sim._try_send(
+                tx, ("err", grpc_sim.Status(grpc_sim.StatusCode.INTERNAL,
+                                            repr(exc))))
+        finally:
+            tx.close()
+
+
+# ---------------------------------------------------------------------------
+# Channel + multicallables
+# ---------------------------------------------------------------------------
+
+class _MultiCallable:
+    def __init__(self, channel: "SimAioChannel", path: str,
+                 request_serializer, response_deserializer,
+                 req_streaming: bool, rsp_streaming: bool):
+        self._channel = channel
+        self._path = path
+        self._ser = request_serializer or (lambda m: m)
+        self._deser = response_deserializer or (lambda b: b)
+        self._req_streaming = req_streaming
+        self._rsp_streaming = rsp_streaming
+
+    def __call__(self, request=None, *, timeout: Optional[float] = None,
+                 metadata=None, credentials=None, wait_for_ready=None,
+                 compression=None):
+        if self._rsp_streaming:
+            return self._stream_call(request, timeout)
+        return self._unary_call(request, timeout)
+
+    async def _open(self, request):
+        ch = self._channel
+        # Lazy endpoint bind: generated stubs construct multicallables
+        # synchronously in Stub.__init__, before any loop exists.
+        await ch._ensure()
+        try:
+            tx, rx = await ch._ep.connect1(ch._target)
+            if self._req_streaming:
+                await tx.send((self._path, None))
+            else:
+                await tx.send((self._path, self._ser(request)))
+        except (BrokenPipe, ConnectionRefused, ConnectionReset,
+                ChannelClosed) as exc:
+            raise SimAioRpcError(_grpc.StatusCode.UNAVAILABLE,
+                                 f"connect: {exc}") from exc
+        return tx, rx
+
+    async def _serialized(self, request_iterator):
+        async for req in request_iterator:
+            yield self._ser(req)
+
+    async def _unary_call(self, request, timeout):
+        async def _go():
+            tx, rx = await self._open(request)
+            try:
+                if self._req_streaming:
+                    await _pump(tx, self._serialized(request))
+                return self._deser(self._unwrap(await self._recv(rx)))
+            finally:
+                tx.close()
+
+        if timeout is None:
+            return await _go()
+        try:
+            return await _vtime.timeout(timeout, _go())
+        except TimeoutError:
+            raise SimAioRpcError(_grpc.StatusCode.DEADLINE_EXCEEDED,
+                                 f"{self._path}") from None
+
+    async def _stream_call(self, request, timeout) -> AsyncIterator[Any]:
+        # Per-message deadline is not simulated; stream calls ignore timeout
+        # (matching madsim-tonic, which ignores transport knobs wholesale).
+        tx, rx = await self._open(request)
+        pump = None
+        if self._req_streaming:
+            pump = _task.spawn(_pump(tx, self._serialized(request)))
+        try:
+            while True:
+                try:
+                    frame = await rx.recv()
+                except (ChannelClosed, BrokenPipe, ConnectionReset) as exc:
+                    # Connection lost before the _END frame: real grpc.aio
+                    # raises UNAVAILABLE; a silent clean EOF would hand
+                    # unmodified code truncated streams.
+                    raise SimAioRpcError(_grpc.StatusCode.UNAVAILABLE,
+                                         f"stream broken: {exc}") from exc
+                if frame == _END:
+                    return
+                yield self._deser(self._unwrap(frame))
+        finally:
+            if pump is not None:
+                pump.abort()
+            tx.close()
+
+    async def _recv(self, rx):
+        try:
+            return await rx.recv()
+        except (ChannelClosed, BrokenPipe, ConnectionReset) as exc:
+            raise SimAioRpcError(_grpc.StatusCode.UNAVAILABLE,
+                                 f"recv: {exc}") from exc
+
+    @staticmethod
+    def _unwrap(frame):
+        kind, value = frame
+        if kind == "ok":
+            return value
+        if kind == "err":
+            _raise_status(value)
+        raise SimAioRpcError(_grpc.StatusCode.INTERNAL,
+                             f"unexpected frame {kind!r}")
+
+
+class SimAioChannel:
+    """grpc.aio.Channel-shaped client over the sim endpoint transport."""
+
+    def __init__(self, target: str):
+        self._target_str = target
+        self._target = None
+        self._ep: Optional[Endpoint] = None
+
+    async def _ensure(self) -> None:
+        if self._ep is None:
+            self._ep = await Endpoint.bind("0.0.0.0:0")
+            self._target = (await lookup_host(self._target_str))[0]
+
+    def _mc(self, path, req_ser, rsp_deser, req_s, rsp_s) -> _MultiCallable:
+        return _MultiCallable(self, path, req_ser, rsp_deser, req_s, rsp_s)
+
+    def unary_unary(self, path, request_serializer=None,
+                    response_deserializer=None, **_kw):
+        return self._mc(path, request_serializer, response_deserializer,
+                        False, False)
+
+    def unary_stream(self, path, request_serializer=None,
+                     response_deserializer=None, **_kw):
+        return self._mc(path, request_serializer, response_deserializer,
+                        False, True)
+
+    def stream_unary(self, path, request_serializer=None,
+                     response_deserializer=None, **_kw):
+        return self._mc(path, request_serializer, response_deserializer,
+                        True, False)
+
+    def stream_stream(self, path, request_serializer=None,
+                      response_deserializer=None, **_kw):
+        return self._mc(path, request_serializer, response_deserializer,
+                        True, True)
+
+    async def channel_ready(self) -> None:
+        await self._ensure()
+
+    async def close(self, grace: Optional[float] = None) -> None:
+        if self._ep is not None:
+            self._ep.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The import hook: patch grpc.aio with in-sim passthrough wrappers
+# ---------------------------------------------------------------------------
+
+def _in_sim() -> bool:
+    return _context.try_current_handle() is not None
+
+
+_PATCHES = None
+
+
+def install() -> None:
+    """Patch ``grpc.aio.server``/``insecure_channel`` so unmodified grpcio
+    client/server code runs in-sim; outside a simulation the real grpc
+    implementations are called unchanged (`madsim-tonic/src/lib.rs:1-8`)."""
+    global _PATCHES
+    if _PATCHES is not None:
+        return
+    aio = _grpc.aio
+    saved = {"server": aio.server, "insecure_channel": aio.insecure_channel,
+             "secure_channel": aio.secure_channel}
+
+    def server(*args, **kwargs):
+        return SimAioServer() if _in_sim() else saved["server"](*args, **kwargs)
+
+    def insecure_channel(target, *args, **kwargs):
+        if _in_sim():
+            return SimAioChannel(target)
+        return saved["insecure_channel"](target, *args, **kwargs)
+
+    def secure_channel(target, credentials, *args, **kwargs):
+        if _in_sim():
+            return SimAioChannel(target)  # TLS ignored in-sim
+        return saved["secure_channel"](target, credentials, *args, **kwargs)
+
+    aio.server = server
+    aio.insecure_channel = insecure_channel
+    aio.secure_channel = secure_channel
+    _PATCHES = saved
+
+
+def uninstall() -> None:
+    global _PATCHES
+    if _PATCHES is None:
+        return
+    for name, orig in _PATCHES.items():
+        setattr(_grpc.aio, name, orig)
+    _PATCHES = None
+
+
+@contextlib.contextmanager
+def patched():
+    """``with grpc_aio.patched():`` — install() for the block's duration."""
+    was_installed = _PATCHES is not None
+    install()
+    try:
+        yield
+    finally:
+        if not was_installed:
+            uninstall()

@@ -1,0 +1,245 @@
+"""Drop-in shimming of UNMODIFIED third-party packages (VERDICT r2 item 4).
+
+The reference bar: madsim-tonic runs unmodified tonic-generated apps in-sim
+(`madsim-tonic/src/lib.rs:1-8`), madsim-tokio runs unmodified tokio code
+(`madsim-tokio/src/lib.rs:32-52`). The Python analogs proven here:
+
+- ``aio.patched()`` runs the real pip-installed **tenacity** retry library
+  (its own asyncio.sleep backoffs and random jitter) inside the sim,
+  seed-deterministically, under packet-loss fault injection;
+- ``grpc_aio.patched()`` runs client/server code written against the real
+  **grpcio** ``grpc.aio`` API — handler objects built by the real
+  ``grpc.method_handlers_generic_handler`` exactly as protoc-generated
+  code does — over the simulated network, under chaos, deterministically.
+"""
+import dataclasses
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import time as mtime
+from madsim_tpu.net import Endpoint, NetSim, rpc
+from madsim_tpu.shims import aio, grpc_aio
+
+tenacity = pytest.importorskip("tenacity")
+grpc = pytest.importorskip("grpc")
+
+
+@dataclasses.dataclass
+class Ping:
+    n: int
+
+
+# ---------------------------------------------------------------------------
+# 1. tenacity: real pip package, unmodified, in-sim under fault injection
+# ---------------------------------------------------------------------------
+
+def _tenacity_world(seed: int):
+    """Flaky RPC (30% packet loss) driven by tenacity's AsyncRetrying with
+    exponential jitter — every sleep and every jitter draw comes from the
+    sim. Returns the full (virtual-time, attempt-count) trace."""
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = 0.3
+    rt = ms.Runtime(seed=seed, config=cfg)
+    trace = []
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def server_init():
+            ep = await Endpoint.bind("10.0.0.1:700")
+
+            async def pong(req):
+                return Ping(req.n + 1)
+
+            rpc.add_rpc_handler(ep, Ping, pong)
+            await mtime.sleep(3600)
+
+        h.create_node(name="srv", ip="10.0.0.1", init=server_init)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+        done = ms.sync.SimFuture()
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            for i in range(10):
+                retryer = tenacity.AsyncRetrying(
+                    stop=tenacity.stop_after_attempt(12),
+                    wait=tenacity.wait_exponential_jitter(
+                        initial=0.02, max=0.5, jitter=0.05),
+                    retry=tenacity.retry_if_exception_type(TimeoutError),
+                )
+                async for attempt in retryer:
+                    with attempt:
+                        r = await rpc.call(ep, "10.0.0.1:700", Ping(i),
+                                           timeout=0.1)
+                        assert r.n == i + 1
+                trace.append((round(mtime.monotonic(), 9),
+                              attempt.retry_state.attempt_number))
+            done.set_result(True)
+
+        cli.spawn(client())
+        assert await done
+
+    with aio.patched():
+        rt.block_on(main())
+    return trace
+
+
+def test_tenacity_runs_in_sim_deterministically():
+    t1 = _tenacity_world(42)
+    t2 = _tenacity_world(42)
+    t3 = _tenacity_world(43)
+    assert len(t1) == 10
+    assert t1 == t2, "same seed must reproduce tenacity's retries bit-exactly"
+    assert t1 != t3, "different seeds must explore different schedules"
+    # The loss actually bit: some call needed more than one attempt.
+    assert any(attempts > 1 for _, attempts in t1)
+
+
+# ---------------------------------------------------------------------------
+# 2. grpcio surface: generated-style code under grpc_aio.patched()
+# ---------------------------------------------------------------------------
+# The servicer/stub below are written exactly as `protoc --grpc_python_out`
+# emits them (modulo protobuf classes — string codecs stand in), consuming
+# only the real grpc package's public API.
+
+class GreeterServicer:
+    async def SayHello(self, request, context):
+        return f"Hello, {request}!"
+
+    async def LotsOfReplies(self, request, context):
+        for i in range(3):
+            yield f"{request}-{i}"
+
+
+def add_GreeterServicer_to_server(servicer, server):
+    rpc_method_handlers = {
+        "SayHello": grpc.unary_unary_rpc_method_handler(
+            servicer.SayHello,
+            request_deserializer=lambda b: b.decode(),
+            response_serializer=lambda s: s.encode(),
+        ),
+        "LotsOfReplies": grpc.unary_stream_rpc_method_handler(
+            servicer.LotsOfReplies,
+            request_deserializer=lambda b: b.decode(),
+            response_serializer=lambda s: s.encode(),
+        ),
+    }
+    generic_handler = grpc.method_handlers_generic_handler(
+        "helloworld.Greeter", rpc_method_handlers)
+    server.add_generic_rpc_handlers((generic_handler,))
+
+
+class GreeterStub:
+    def __init__(self, channel):
+        self.SayHello = channel.unary_unary(
+            "/helloworld.Greeter/SayHello",
+            request_serializer=lambda s: s.encode(),
+            response_deserializer=lambda b: b.decode(),
+        )
+        self.LotsOfReplies = channel.unary_stream(
+            "/helloworld.Greeter/LotsOfReplies",
+            request_serializer=lambda s: s.encode(),
+            response_deserializer=lambda b: b.decode(),
+        )
+
+
+def _grpc_world(seed: int, chaos: bool):
+    rt = ms.Runtime(seed=seed)
+    rt.set_time_limit(300)
+    trace = []
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            server = grpc.aio.server()
+            add_GreeterServicer_to_server(GreeterServicer(), server)
+            server.add_insecure_port("10.0.0.1:50051")
+            await server.start()
+            await server.wait_for_termination()
+
+        srv = h.create_node(name="server", ip="10.0.0.1", init=serve)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+        done = ms.sync.SimFuture()
+
+        async def client():
+            ok = 0
+            while ok < 20:
+                try:
+                    async with grpc.aio.insecure_channel("10.0.0.1:50051") as ch:
+                        stub = GreeterStub(ch)
+                        while ok < 20:
+                            rsp = await stub.SayHello(f"w{ok}", timeout=1.0)
+                            assert rsp == f"Hello, w{ok}!"
+                            streamed = [x async for x in
+                                        stub.LotsOfReplies(f"s{ok}")]
+                            assert streamed == [f"s{ok}-{i}" for i in range(3)]
+                            trace.append((round(mtime.monotonic(), 9), ok))
+                            ok += 1
+                except grpc.RpcError:
+                    await mtime.sleep(0.05)
+            done.set_result(ok)
+
+        cli.spawn(client())
+
+        if chaos:
+            sim = ms.simulator(NetSim)
+            for _ in range(4):
+                await mtime.sleep(ms.rand.thread_rng().gen_range_f64(0.2, 0.5))
+                sim.disconnect2(srv.id, cli.id)
+                await mtime.sleep(ms.rand.thread_rng().gen_range_f64(0.1, 0.3))
+                sim.connect2(srv.id, cli.id)
+        return await done
+
+    with grpc_aio.patched():
+        got = rt.block_on(main())
+    return got, trace
+
+
+def test_grpcio_generated_style_code_runs_in_sim():
+    got, trace = _grpc_world(1, chaos=False)
+    assert got == 20 and len(trace) == 20
+
+
+def test_grpcio_survives_chaos_and_is_deterministic():
+    a = _grpc_world(5, chaos=True)
+    b = _grpc_world(5, chaos=True)
+    c = _grpc_world(6, chaos=True)
+    assert a[0] == 20
+    assert a == b, "same seed must reproduce the whole gRPC world"
+    assert a[1] != c[1]
+
+
+def test_grpc_unimplemented_path_raises_rpc_error():
+    rt = ms.Runtime(seed=2)
+
+    async def main():
+        server = grpc.aio.server()
+        add_GreeterServicer_to_server(GreeterServicer(), server)
+        server.add_insecure_port("127.0.0.1:50051")
+        await server.start()
+        ch = grpc.aio.insecure_channel("127.0.0.1:50051")
+        mc = ch.unary_unary("/helloworld.Greeter/Nope",
+                            request_serializer=lambda s: s.encode(),
+                            response_deserializer=lambda b: b.decode())
+        with pytest.raises(grpc.RpcError) as ei:
+            await mc("x")
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        await ch.close()
+        await server.stop()
+
+    with grpc_aio.patched():
+        rt.block_on(main())
+
+
+def test_grpc_patch_passthrough_outside_sim():
+    # Outside a simulation the patched names must return the REAL grpcio
+    # objects (the `pub use tonic::*` re-export analog).
+    with grpc_aio.patched():
+        ch = grpc.aio.insecure_channel("127.0.0.1:1")
+        try:
+            assert not isinstance(ch, grpc_aio.SimAioChannel)
+        finally:
+            # Real aio channel close needs a loop; just drop it.
+            del ch
