@@ -251,6 +251,7 @@ class RealEndpoint:
                     fut.set_exception(
                         exc if isinstance(exc, (ConnectionError, OSError))
                         else BrokenPipe(f"connect cancelled: {exc!r}"))
+                    fut.exception()  # mark retrieved: no waiter may exist
                 raise
             try:
                 # Handshake: advertise the address the peer can reach our
@@ -272,6 +273,7 @@ class RealEndpoint:
                     fut.set_exception(
                         exc if isinstance(exc, (ConnectionError, OSError))
                         else BrokenPipe(f"handshake failed: {exc!r}"))
+                    fut.exception()  # mark retrieved: no waiter may exist
                 writer.close()
                 raise
         return await asyncio.shield(fut)

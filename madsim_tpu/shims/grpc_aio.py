@@ -194,19 +194,26 @@ class SimAioServer:
 
             if kind == "unary_unary":
                 rsp = await fn(deser(first), ctx)
-                await tx.send(("ok", ser(rsp)))
+                await self._finish_unary(tx, ctx, ser, rsp)
             elif kind == "unary_stream":
                 async for rsp in fn(deser(first), ctx):
                     await tx.send(("ok", ser(rsp)))
-                await tx.send(_END)
+                await self._finish_stream(tx, ctx)
             elif kind == "stream_unary":
                 rsp = await fn(req_iter(), ctx)
-                await tx.send(("ok", ser(rsp)))
+                await self._finish_unary(tx, ctx, ser, rsp)
             else:  # stream_stream
                 async for rsp in fn(req_iter(), ctx):
                     await tx.send(("ok", ser(rsp)))
-                await tx.send(_END)
+                await self._finish_stream(tx, ctx)
         except grpc_sim.Status as status:
+            await grpc_sim._try_send(tx, ("err", status))
+        except NotImplementedError as exc:
+            # protoc-generated Servicer bases raise this after
+            # context.set_code(UNIMPLEMENTED); real grpcio surfaces the
+            # context code, so mirror that here.
+            status = ctx.trailing_status() or grpc_sim.Status(
+                grpc_sim.StatusCode.UNIMPLEMENTED, str(exc))
             await grpc_sim._try_send(tx, ("err", status))
         except (ChannelClosed, BrokenPipe, ConnectionReset, Cancelled):
             pass
@@ -216,6 +223,22 @@ class SimAioServer:
                                             repr(exc))))
         finally:
             tx.close()
+
+    @staticmethod
+    async def _finish_unary(tx, ctx, ser, rsp) -> None:
+        status = ctx.trailing_status()
+        if status is not None:
+            await grpc_sim._try_send(tx, ("err", status))
+        else:
+            await tx.send(("ok", ser(rsp)))
+
+    @staticmethod
+    async def _finish_stream(tx, ctx) -> None:
+        status = ctx.trailing_status()
+        if status is not None:
+            await grpc_sim._try_send(tx, ("err", status))
+        else:
+            await tx.send(_END)
 
 
 # ---------------------------------------------------------------------------
@@ -264,11 +287,17 @@ class _MultiCallable:
     async def _unary_call(self, request, timeout):
         async def _go():
             tx, rx = await self._open(request)
+            pump = None
             try:
                 if self._req_streaming:
-                    await _pump(tx, self._serialized(request))
+                    # Concurrent pump: the server may respond (or error)
+                    # after consuming only part of the request stream, and
+                    # the iterator may be gated on application progress.
+                    pump = _task.spawn(_pump(tx, self._serialized(request)))
                 return self._deser(self._unwrap(await self._recv(rx)))
             finally:
+                if pump is not None:
+                    pump.abort()
                 tx.close()
 
         if timeout is None:
@@ -329,11 +358,28 @@ class SimAioChannel:
         self._target_str = target
         self._target = None
         self._ep: Optional[Endpoint] = None
+        self._ensuring = None
 
     async def _ensure(self) -> None:
-        if self._ep is None:
-            self._ep = await Endpoint.bind("0.0.0.0:0")
+        # Single-flight: concurrent first RPCs (gather of stub calls) must
+        # not each bind an endpoint and leak the loser's port.
+        from ..core.futures import SimFuture
+
+        if self._ep is not None:
+            return
+        if self._ensuring is not None:
+            await self._ensuring
+            return
+        self._ensuring = SimFuture()
+        try:
+            ep = await Endpoint.bind("0.0.0.0:0")
             self._target = (await lookup_host(self._target_str))[0]
+            self._ep = ep
+            self._ensuring.set_result(None)
+        except BaseException as exc:
+            self._ensuring.set_exception(exc)
+            self._ensuring = None
+            raise
 
     def _mc(self, path, req_ser, rsp_deser, req_s, rsp_s) -> _MultiCallable:
         return _MultiCallable(self, path, req_ser, rsp_deser, req_s, rsp_s)

@@ -78,13 +78,48 @@ bidi = _method(BIDI)
 
 
 class ServicerContext:
-    """Per-call context handed to handlers."""
+    """Per-call context handed to handlers.
+
+    Carries the grpcio ServicerContext error surface (set_code/set_details/
+    abort) so handlers written for real grpc.aio — including protoc-style
+    generated bases — behave identically in-sim."""
 
     def __init__(self, peer: Addr):
         self._peer = peer
+        self._code = None
+        self._details = ""
 
     def peer(self) -> str:
         return f"{self._peer[0]}:{self._peer[1]}"
+
+    def set_code(self, code) -> None:
+        self._code = code
+
+    def set_details(self, details: str) -> None:
+        self._details = details
+
+    def abort(self, code, details: str = "") -> None:
+        self.set_code(code)
+        self.set_details(details)
+        raise Status(_to_sim_code(code), details)
+
+    def trailing_status(self) -> Optional["Status"]:
+        """A non-OK status the handler set without raising, else None."""
+        if self._code is None:
+            return None
+        sim_code = _to_sim_code(self._code)
+        if sim_code == StatusCode.OK:
+            return None
+        return Status(sim_code, self._details)
+
+
+def _to_sim_code(code) -> StatusCode:
+    """Map a grpc.StatusCode (or sim StatusCode) by name; unknown → UNKNOWN."""
+    name = getattr(code, "name", str(code))
+    try:
+        return StatusCode[name]
+    except KeyError:
+        return StatusCode.UNKNOWN
 
 
 class Server:

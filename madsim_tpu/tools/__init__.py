@@ -1,0 +1,1 @@
+"""Build-time tooling (codegen). Import side-effect free."""

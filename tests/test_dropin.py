@@ -236,10 +236,14 @@ def test_grpc_unimplemented_path_raises_rpc_error():
 def test_grpc_patch_passthrough_outside_sim():
     # Outside a simulation the patched names must return the REAL grpcio
     # objects (the `pub use tonic::*` re-export analog).
-    with grpc_aio.patched():
-        ch = grpc.aio.insecure_channel("127.0.0.1:1")
-        try:
-            assert not isinstance(ch, grpc_aio.SimAioChannel)
-        finally:
-            # Real aio channel close needs a loop; just drop it.
-            del ch
+    import asyncio
+
+    async def main():
+        with grpc_aio.patched():
+            ch = grpc.aio.insecure_channel("127.0.0.1:1")
+            try:
+                assert not isinstance(ch, grpc_aio.SimAioChannel)
+            finally:
+                await ch.close()
+
+    asyncio.run(main())

@@ -1,0 +1,208 @@
+"""gRPC codegen (.proto on-ramp): one generated file, two transports.
+
+madsim-tonic-build parity (`madsim-tonic-build/src/{client,server}.rs`): a
+``.proto`` service definition compiles — via the system protoc + this
+repo's stub generator — into code that runs BOTH on real grpcio
+(production transport, no simulation) and inside the simulated network
+under ``grpc_aio.patched()``, unchanged.
+"""
+import sys
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import time as mtime
+from madsim_tpu.shims import grpc_aio
+from madsim_tpu.tools.protogen import compile_protos
+
+grpc = pytest.importorskip("grpc")
+pytest.importorskip("google.protobuf")
+
+PROTO = """
+syntax = "proto3";
+package helloworld;
+
+message HelloRequest { string name = 1; int32 id = 2; }
+message HelloReply { string message = 1; }
+
+service Greeter {
+  rpc SayHello (HelloRequest) returns (HelloReply);
+  rpc LotsOfReplies (HelloRequest) returns (stream HelloReply);
+  rpc LotsOfGreetings (stream HelloRequest) returns (HelloReply);
+  rpc BidiHello (stream HelloRequest) returns (stream HelloReply);
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def gen(tmp_path_factory):
+    out = tmp_path_factory.mktemp("protogen")
+    proto = out / "greeter.proto"
+    proto.write_text(PROTO)
+    paths = compile_protos([str(proto)], str(out))
+    assert any(p.endswith("greeter_pb2.py") for p in paths)
+    assert any(p.endswith("greeter_pb2_grpc.py") for p in paths)
+    sys.path.insert(0, str(out))
+    try:
+        import greeter_pb2
+        import greeter_pb2_grpc
+
+        yield greeter_pb2, greeter_pb2_grpc
+    finally:
+        sys.path.remove(str(out))
+        sys.modules.pop("greeter_pb2", None)
+        sys.modules.pop("greeter_pb2_grpc", None)
+
+
+def _make_servicer(pb2, grpc_mod):
+    class Greeter(grpc_mod.GreeterServicer):
+        async def SayHello(self, request, context):
+            return pb2.HelloReply(message=f"Hello, {request.name}!")
+
+        async def LotsOfReplies(self, request, context):
+            for i in range(3):
+                yield pb2.HelloReply(message=f"{request.name}-{i}")
+
+        async def LotsOfGreetings(self, request_iterator, context):
+            names = [r.name async for r in request_iterator]
+            return pb2.HelloReply(message=",".join(names))
+
+        async def BidiHello(self, request_iterator, context):
+            async for r in request_iterator:
+                yield pb2.HelloReply(message=f"hi {r.name}")
+
+    return Greeter()
+
+
+async def _drive(pb2, stub):
+    """Exercise all four streaming modes through a generated stub."""
+    r = await stub.SayHello(pb2.HelloRequest(name="world", id=7))
+    assert r.message == "Hello, world!"
+    streamed = [x.message async for x in
+                stub.LotsOfReplies(pb2.HelloRequest(name="s"))]
+    assert streamed == ["s-0", "s-1", "s-2"]
+
+    async def reqs():
+        for n in ("a", "b", "c"):
+            yield pb2.HelloRequest(name=n)
+
+    r = await stub.LotsOfGreetings(reqs())
+    assert r.message == "a,b,c"
+    bidi = [x.message async for x in stub.BidiHello(reqs())]
+    assert bidi == ["hi a", "hi b", "hi c"]
+    return True
+
+
+def test_generated_code_runs_in_sim(gen):
+    pb2, pb2_grpc = gen
+    rt = ms.Runtime(seed=3)
+    rt.set_time_limit(300)
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            server = grpc.aio.server()
+            pb2_grpc.add_GreeterServicer_to_server(
+                _make_servicer(pb2, pb2_grpc), server)
+            server.add_insecure_port("10.0.0.1:50051")
+            await server.start()
+            await server.wait_for_termination()
+
+        h.create_node(name="server", ip="10.0.0.1", init=serve)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+        done = ms.sync.SimFuture()
+
+        async def client():
+            while True:
+                try:
+                    async with grpc.aio.insecure_channel("10.0.0.1:50051") as ch:
+                        stub = pb2_grpc.GreeterStub(ch)
+                        done.set_result(await _drive(pb2, stub))
+                        return
+                except grpc.RpcError:
+                    await mtime.sleep(0.05)  # server bind race: retry
+
+        cli.spawn(client())
+        return await done
+
+    with grpc_aio.patched():
+        assert rt.block_on(main())
+
+
+def test_generated_code_runs_on_real_grpcio(gen):
+    # The SAME generated file against the real grpcio transport (no sim) —
+    # the `pub use tonic::*` half of the dual-transport contract.
+    import asyncio
+
+    pb2, pb2_grpc = gen
+
+    async def main():
+        server = grpc.aio.server()
+        pb2_grpc.add_GreeterServicer_to_server(
+            _make_servicer(pb2, pb2_grpc), server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                stub = pb2_grpc.GreeterStub(ch)
+                return await _drive(pb2, stub)
+        finally:
+            await server.stop(None)
+
+    assert asyncio.run(main())
+
+
+def test_unoverridden_servicer_method_is_unimplemented(gen):
+    # The generated Servicer base must surface UNIMPLEMENTED (the
+    # grpc_python_plugin contract), not INTERNAL/UNKNOWN.
+    pb2, pb2_grpc = gen
+    rt = ms.Runtime(seed=8)
+
+    async def main():
+        server = grpc.aio.server()
+        # Register the BASE servicer: nothing overridden.
+        pb2_grpc.add_GreeterServicer_to_server(pb2_grpc.GreeterServicer(),
+                                               server)
+        server.add_insecure_port("127.0.0.1:50051")
+        await server.start()
+        ch = grpc.aio.insecure_channel("127.0.0.1:50051")
+        stub = pb2_grpc.GreeterStub(ch)
+        with pytest.raises(grpc.RpcError) as ei:
+            await stub.SayHello(pb2.HelloRequest(name="x"))
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        await ch.close()
+        await server.stop()
+
+    with grpc_aio.patched():
+        rt.block_on(main())
+
+
+def test_generated_code_is_deterministic_in_sim(gen):
+    pb2, pb2_grpc = gen
+
+    def world(seed):
+        rt = ms.Runtime(seed=seed)
+        trace = []
+
+        async def main():
+            server = grpc.aio.server()
+            pb2_grpc.add_GreeterServicer_to_server(
+                _make_servicer(pb2, pb2_grpc), server)
+            server.add_insecure_port("127.0.0.1:50051")
+            await server.start()
+            ch = grpc.aio.insecure_channel("127.0.0.1:50051")
+            stub = pb2_grpc.GreeterStub(ch)
+            for i in range(5):
+                r = await stub.SayHello(pb2.HelloRequest(name=f"n{i}"))
+                trace.append((round(mtime.monotonic(), 9), r.message))
+            await ch.close()
+            await server.stop()
+
+        with grpc_aio.patched():
+            rt.block_on(main())
+        return trace
+
+    a, b, c = world(11), world(11), world(12)
+    assert a == b and len(a) == 5
+    assert a != c
