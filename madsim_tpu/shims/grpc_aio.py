@@ -78,6 +78,24 @@ def _raise_status(status: grpc_sim.Status) -> None:
 # Server
 # ---------------------------------------------------------------------------
 
+async def _as_aiter(result):
+    """Adapt a streaming handler's return into an async iterator.
+
+    An async-generator method yields directly; a plain coroutine (e.g. an
+    unoverridden protoc-style Servicer base method, which raises
+    NotImplementedError when awaited) is awaited first — so unimplemented
+    streaming methods surface UNIMPLEMENTED, not a TypeError→INTERNAL."""
+    if hasattr(result, "__aiter__"):
+        async for item in result:
+            yield item
+        return
+    awaited = await result
+    if awaited is None:
+        return
+    async for item in awaited:
+        yield item
+
+
 class _HandlerCallDetails:
     __slots__ = ("method", "invocation_metadata")
 
@@ -196,14 +214,14 @@ class SimAioServer:
                 rsp = await fn(deser(first), ctx)
                 await self._finish_unary(tx, ctx, ser, rsp)
             elif kind == "unary_stream":
-                async for rsp in fn(deser(first), ctx):
+                async for rsp in _as_aiter(fn(deser(first), ctx)):
                     await tx.send(("ok", ser(rsp)))
                 await self._finish_stream(tx, ctx)
             elif kind == "stream_unary":
                 rsp = await fn(req_iter(), ctx)
                 await self._finish_unary(tx, ctx, ser, rsp)
             else:  # stream_stream
-                async for rsp in fn(req_iter(), ctx):
+                async for rsp in _as_aiter(fn(req_iter(), ctx)):
                     await tx.send(("ok", ser(rsp)))
                 await self._finish_stream(tx, ctx)
         except grpc_sim.Status as status:
@@ -284,17 +302,39 @@ class _MultiCallable:
         async for req in request_iterator:
             yield self._ser(req)
 
+    def _spawn_pump(self, tx, requests):
+        """Spawn the request pump with exception containment: an app-level
+        error in the caller's request iterator must propagate to the stub
+        caller, not crash the whole simulation via an uncaught-task path."""
+        box: list = []
+
+        async def run():
+            try:
+                await _pump(tx, requests)
+            except Cancelled:
+                raise
+            except Exception as exc:  # noqa: BLE001 — rethrown to caller
+                box.append(exc)
+                tx.close()  # unblock the server / our recv
+
+        return _task.spawn(run()), box
+
     async def _unary_call(self, request, timeout):
         async def _go():
             tx, rx = await self._open(request)
-            pump = None
+            pump, box = None, []
             try:
                 if self._req_streaming:
                     # Concurrent pump: the server may respond (or error)
                     # after consuming only part of the request stream, and
                     # the iterator may be gated on application progress.
-                    pump = _task.spawn(_pump(tx, self._serialized(request)))
-                return self._deser(self._unwrap(await self._recv(rx)))
+                    pump, box = self._spawn_pump(tx, self._serialized(request))
+                try:
+                    return self._deser(self._unwrap(await self._recv(rx)))
+                except SimAioRpcError:
+                    if box:
+                        raise box[0] from None
+                    raise
             finally:
                 if pump is not None:
                     pump.abort()
@@ -312,14 +352,16 @@ class _MultiCallable:
         # Per-message deadline is not simulated; stream calls ignore timeout
         # (matching madsim-tonic, which ignores transport knobs wholesale).
         tx, rx = await self._open(request)
-        pump = None
+        pump, box = None, []
         if self._req_streaming:
-            pump = _task.spawn(_pump(tx, self._serialized(request)))
+            pump, box = self._spawn_pump(tx, self._serialized(request))
         try:
             while True:
                 try:
                     frame = await rx.recv()
                 except (ChannelClosed, BrokenPipe, ConnectionReset) as exc:
+                    if box:
+                        raise box[0] from None  # the app's iterator error
                     # Connection lost before the _END frame: real grpc.aio
                     # raises UNAVAILABLE; a silent clean EOF would hand
                     # unmodified code truncated streams.
@@ -372,9 +414,10 @@ class SimAioChannel:
             return
         self._ensuring = SimFuture()
         try:
-            ep = await Endpoint.bind("0.0.0.0:0")
+            # Resolve first: a bad target must not leak a bound endpoint
+            # on every retry.
             self._target = (await lookup_host(self._target_str))[0]
-            self._ep = ep
+            self._ep = await Endpoint.bind("0.0.0.0:0")
             self._ensuring.set_result(None)
         except BaseException as exc:
             self._ensuring.set_exception(exc)

@@ -4,11 +4,20 @@ The madsim-tokio-postgres analog (SURVEY §2.15): the reference vendors the
 real tokio-postgres client and runs its unchanged protocol machinery over the
 simulated TcpStream, proving the shim strategy scales to a real protocol.
 This module does the Python equivalent: a faithful implementation of the
-PostgreSQL frontend/backend protocol (startup, simple-query flow,
-RowDescription/DataRow/CommandComplete/ErrorResponse/ReadyForQuery framing —
-https://www.postgresql.org/docs/current/protocol-message-formats.html)
-speaking through :class:`madsim_tpu.net.TcpStream`, so every byte crosses the
-simulated network with latency/loss/partition semantics.
+PostgreSQL frontend/backend protocol (startup, simple-query flow, AND the
+extended-query flow — Parse/Bind/Describe/Execute/Close/Sync with
+ParseComplete/BindComplete/ParameterDescription/NoData/PortalSuspended
+framing, per
+https://www.postgresql.org/docs/current/protocol-message-formats.html —
+matching what the vendored reference client exercises in prepare.rs /
+transaction.rs / codec.rs) speaking through
+:class:`madsim_tpu.net.TcpStream`, so every byte crosses the simulated
+network with latency/loss/partition semantics.
+
+Transactions follow the backend contract: ReadyForQuery carries the
+transaction status byte (I idle / T in-transaction / E failed), errors
+inside a transaction poison it (further statements fail with sqlstate
+25P02) until ROLLBACK, and extended-protocol errors skip to Sync.
 
 Where the reference needs a live out-of-process PostgreSQL server (its test
 suite is excluded from CI for exactly that reason, reference `Makefile:12-16`),
@@ -80,19 +89,56 @@ class Row(tuple):
         return self[self._columns.index(name)]
 
 
+class PreparedStatement:
+    """A server-side prepared statement (Parse'd and Describe'd)."""
+
+    __slots__ = ("name", "sql", "columns", "n_params")
+
+    def __init__(self, name: str, sql: str, columns: List[str], n_params: int):
+        self.name = name
+        self.sql = sql
+        self.columns = columns  # [] for statements returning no rows
+        self.n_params = n_params
+
+
+class Transaction:
+    """``async with conn.transaction():`` — BEGIN, then COMMIT on clean
+    exit / ROLLBACK on exception (reference transaction.rs semantics)."""
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+
+    async def __aenter__(self) -> "Connection":
+        await self._conn.execute("BEGIN")
+        return self._conn
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            await self._conn.execute("COMMIT")
+        else:
+            try:
+                await self._conn.execute("ROLLBACK")
+            except (PostgresError, BrokenPipe, ConnectionReset):
+                pass  # the original exception matters more
+        return False
+
+
 class Connection:
-    """A connected PostgreSQL session (simple-query protocol)."""
+    """A connected PostgreSQL session (simple + extended query protocol)."""
 
     def __init__(self, stream: TcpStream, parameters: Dict[str, str]):
         self._stream = stream
         self.parameters = parameters  # ParameterStatus values from startup
         self._closed = False
+        self.txn_status = "I"  # ReadyForQuery status: I / T / E
+        self._stmt_counter = 0  # deterministic auto-generated stmt names
 
-    async def query(self, sql: str) -> List[Row]:
-        """Run one simple query; returns data rows (empty for commands)."""
-        await self._stream.write_all(_msg(b"Q", _cstr(sql)))
+    # -- shared response pump ---------------------------------------------
+    async def _read_until_ready(self) -> Tuple[List[Row], List[str], int]:
+        """Consume messages until ReadyForQuery; raise the first error."""
         columns: List[str] = []
         rows: List[Row] = []
+        n_params = 0
         error: Optional[PostgresError] = None
         while True:
             mtype, payload = await _read_message(self._stream)
@@ -117,7 +163,11 @@ class Connection:
                         values.append(payload[off:off + vlen].decode())
                         off += vlen
                 rows.append(Row(values, columns))
-            elif mtype == b"C":  # CommandComplete
+            elif mtype == b"t":  # ParameterDescription
+                (n_params,) = struct.unpack("!H", payload[:2])
+            elif mtype in (b"C", b"1", b"2", b"3", b"n", b"s", b"I"):
+                # CommandComplete / ParseComplete / BindComplete /
+                # CloseComplete / NoData / PortalSuspended / EmptyQuery
                 pass
             elif mtype == b"E":  # ErrorResponse
                 fields = dict((chunk[0], chunk[1:]) for chunk in
@@ -126,6 +176,7 @@ class Connection:
                                       fields.get("C", "XX000"),
                                       fields.get("M", "unknown"))
             elif mtype == b"Z":  # ReadyForQuery — end of the response cycle
+                self.txn_status = payload[:1].decode() or "I"
                 break
             elif mtype in (b"S", b"N"):  # ParameterStatus / NoticeResponse
                 continue
@@ -134,10 +185,67 @@ class Connection:
                                     f"unexpected message {mtype!r}")
         if error is not None:
             raise error
+        return rows, columns, n_params
+
+    # -- simple query protocol --------------------------------------------
+    async def query(self, sql: str) -> List[Row]:
+        """Run one simple query; returns data rows (empty for commands)."""
+        await self._stream.write_all(_msg(b"Q", _cstr(sql)))
+        rows, _cols, _np = await self._read_until_ready()
         return rows
 
     async def execute(self, sql: str) -> None:
         await self.query(sql)
+
+    # -- extended query protocol (prepare.rs / codec.rs analog) -----------
+    async def prepare(self, sql: str, name: Optional[str] = None) -> PreparedStatement:
+        """Parse + Describe a statement with $1..$n placeholders."""
+        if name is None:
+            # Deterministic per-connection naming: statement names go over
+            # the wire, so id()/hash()-derived names would leak process-
+            # level nondeterminism into byte-level traces.
+            self._stmt_counter += 1
+            name = f"s{self._stmt_counter}"
+        stmt = name
+        parse = _cstr(stmt) + _cstr(sql) + struct.pack("!H", 0)
+        describe = b"S" + _cstr(stmt)
+        await self._stream.write_all(
+            _msg(b"P", parse) + _msg(b"D", describe) + _msg(b"S", b""))
+        _rows, columns, n_params = await self._read_until_ready()
+        return PreparedStatement(stmt, sql, columns, n_params)
+
+    async def query_prepared(self, stmt: "PreparedStatement | str",
+                             params: List[Optional[str]] = ()) -> List[Row]:
+        """Bind + Execute a prepared statement on the unnamed portal."""
+        name = stmt.name if isinstance(stmt, PreparedStatement) else stmt
+        bind = _cstr("") + _cstr(name) + struct.pack("!H", 0)  # text format
+        bind += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack("!i", -1)
+            else:
+                raw = str(p).encode()
+                bind += struct.pack("!i", len(raw)) + raw
+        bind += struct.pack("!H", 0)  # result formats: all text
+        execute = _cstr("") + struct.pack("!i", 0)  # no row limit
+        await self._stream.write_all(
+            _msg(b"B", bind) + _msg(b"E", execute) + _msg(b"S", b""))
+        rows, _cols, _np = await self._read_until_ready()
+        return rows
+
+    async def execute_prepared(self, stmt: "PreparedStatement | str",
+                               params: List[Optional[str]] = ()) -> None:
+        await self.query_prepared(stmt, params)
+
+    async def close_statement(self, stmt: "PreparedStatement | str") -> None:
+        name = stmt.name if isinstance(stmt, PreparedStatement) else stmt
+        await self._stream.write_all(
+            _msg(b"C", b"S" + _cstr(name)) + _msg(b"S", b""))
+        await self._read_until_ready()
+
+    # -- transactions ------------------------------------------------------
+    def transaction(self) -> Transaction:
+        return Transaction(self)
 
     async def close(self) -> None:
         if not self._closed:
@@ -193,10 +301,78 @@ async def connect(host: str, port: int = 5432, user: str = "postgres",
 
 _CREATE = re.compile(r"^\s*CREATE\s+TABLE\s+(\w+)\s*\(([^)]*)\)\s*;?\s*$", re.I)
 _INSERT = re.compile(r"^\s*INSERT\s+INTO\s+(\w+)\s+VALUES\s*\((.*)\)\s*;?\s*$", re.I)
-_SELECT = re.compile(r"^\s*SELECT\s+(.+?)\s+FROM\s+(\w+)"
-                     r"(?:\s+WHERE\s+(\w+)\s*=\s*'([^']*)')?\s*;?\s*$", re.I)
-_DELETE = re.compile(r"^\s*DELETE\s+FROM\s+(\w+)"
-                     r"(?:\s+WHERE\s+(\w+)\s*=\s*'([^']*)')?\s*;?\s*$", re.I)
+# WHERE accepts a ''-escaped string literal or NULL (never-matching, SQL
+# three-valued-logic rule for `= NULL`).
+_WHERE = r"(?:\s+WHERE\s+(\w+)\s*=\s*(?:'((?:[^']|'')*)'|(NULL)))?"
+_SELECT = re.compile(r"^\s*SELECT\s+(.+?)\s+FROM\s+(\w+)" + _WHERE
+                     + r"\s*;?\s*$", re.I)
+_DELETE = re.compile(r"^\s*DELETE\s+FROM\s+(\w+)" + _WHERE + r"\s*;?\s*$",
+                     re.I)
+_BEGIN = re.compile(r"^\s*(BEGIN|START\s+TRANSACTION)\s*;?\s*$", re.I)
+_COMMIT = re.compile(r"^\s*(COMMIT|END)\s*;?\s*$", re.I)
+_ROLLBACK = re.compile(r"^\s*ROLLBACK\s*;?\s*$", re.I)
+_PARAM = re.compile(r"\$(\d+)")
+
+
+def _parse_values(s: str) -> Optional[List[Optional[str]]]:
+    """Parse a VALUES list: ''-escaped string literals, NULL, bare tokens.
+    Quote-aware (commas inside strings are data). None on syntax error."""
+    out: List[Optional[str]] = []
+    i, n = 0, len(s)
+    while True:
+        while i < n and s[i].isspace():
+            i += 1
+        if i < n and s[i] == "'":
+            i += 1
+            buf: List[str] = []
+            closed = False
+            while i < n:
+                if s[i] == "'":
+                    if i + 1 < n and s[i + 1] == "'":
+                        buf.append("'")
+                        i += 2
+                        continue
+                    i += 1
+                    closed = True
+                    break
+                buf.append(s[i])
+                i += 1
+            if not closed:
+                return None
+            out.append("".join(buf))
+        else:
+            j = i
+            while j < n and s[j] != ",":
+                j += 1
+            tok = s[i:j].strip()
+            if not tok:
+                return None
+            out.append(None if tok.upper() == "NULL" else tok)
+            i = j
+        while i < n and s[i].isspace():
+            i += 1
+        if i >= n:
+            return out
+        if s[i] != ",":
+            return None
+        i += 1
+
+
+class _Session:
+    """Per-connection state: prepared statements, portals, transaction.
+
+    Transactions use an undo log (inverse operation per mutation) rather
+    than a whole-database snapshot: ROLLBACK reverts only this session's
+    writes, so commits from concurrent sessions survive, and BEGIN is O(1)
+    instead of a full deepcopy."""
+
+    __slots__ = ("statements", "portals", "txn", "undo")
+
+    def __init__(self):
+        self.statements: Dict[str, str] = {}          # name -> SQL
+        self.portals: Dict[str, str] = {}             # portal -> bound SQL
+        self.txn = "I"                                # I / T / E
+        self.undo: List = []                          # inverse ops, in order
 
 
 class SimPostgresServer:
@@ -221,6 +397,7 @@ class SimPostgresServer:
 
     # ------------------------------------------------------------------
     async def _session(self, stream: TcpStream) -> None:
+        sess = _Session()
         try:
             head = await stream.read_exact(8)
             (length, version) = struct.unpack("!II", head)
@@ -236,24 +413,168 @@ class SimPostgresServer:
             out += _msg(b"S", _cstr("session_user") + _cstr(params.get("user", "")))
             out += _msg(b"Z", b"I")                                    # ReadyForQuery
             await stream.write_all(out)
+            skip_to_sync = False
             while True:
                 mtype, payload = await _read_message(stream)
                 if mtype == b"X":
                     return
-                if mtype != b"Q":
+                if skip_to_sync and mtype != b"S":
+                    # Extended-protocol error: discard until Sync
+                    # (protocol-flow rule for the extended query cycle).
+                    continue
+                if mtype == b"Q":
+                    sql = payload.rstrip(b"\0").decode()
+                    await stream.write_all(self._run_txn(sql, sess)
+                                           + _msg(b"Z", sess.txn.encode()))
+                elif mtype == b"P":    # Parse
+                    out, skip_to_sync = self._on_parse(payload, sess)
+                    await stream.write_all(out)
+                elif mtype == b"D":    # Describe
+                    out, skip_to_sync = self._on_describe(payload, sess)
+                    await stream.write_all(out)
+                elif mtype == b"B":    # Bind
+                    out, skip_to_sync = self._on_bind(payload, sess)
+                    await stream.write_all(out)
+                elif mtype == b"E":    # Execute
+                    out, skip_to_sync = self._on_execute(payload, sess)
+                    await stream.write_all(out)
+                elif mtype == b"C":    # Close statement/portal
+                    kind, name = payload[:1], payload[1:].rstrip(b"\0").decode()
+                    (sess.statements if kind == b"S" else sess.portals).pop(name, None)
+                    await stream.write_all(_msg(b"3", b""))
+                elif mtype == b"S":    # Sync
+                    skip_to_sync = False
+                    await stream.write_all(_msg(b"Z", sess.txn.encode()))
+                elif mtype == b"H":    # Flush — writes are unbuffered here
+                    continue
+                else:
                     await stream.write_all(self._error("ERROR", "0A000",
                                                        f"unsupported message {mtype!r}")
-                                           + _msg(b"Z", b"I"))
-                    continue
-                sql = payload.rstrip(b"\0").decode()
-                await stream.write_all(self._run(sql) + _msg(b"Z", b"I"))
+                                           + _msg(b"Z", sess.txn.encode()))
         except (ConnectionReset, BrokenPipe):
             return  # client vanished (crash / partition): session ends
         finally:
             stream.close()
 
+    # -- extended-protocol handlers -------------------------------------
+    def _on_parse(self, payload: bytes, sess: _Session) -> Tuple[bytes, bool]:
+        end = payload.index(b"\0")
+        name = payload[:end].decode()
+        end2 = payload.index(b"\0", end + 1)
+        sql = payload[end + 1:end2].decode()
+        sess.statements[name] = sql
+        return _msg(b"1", b""), False
+
+    def _on_describe(self, payload: bytes, sess: _Session) -> Tuple[bytes, bool]:
+        kind, name = payload[:1], payload[1:].rstrip(b"\0").decode()
+        sql = (sess.statements if kind == b"S" else sess.portals).get(name)
+        if sql is None:
+            return (self._error("ERROR", "26000",
+                                f'unknown statement "{name}"'), True)
+        n_params = max((int(m) for m in _PARAM.findall(sql)), default=0)
+        out = b""
+        if kind == b"S":
+            out += _msg(b"t", struct.pack("!H", n_params)
+                        + struct.pack("!I", 25) * n_params)
+        # Row-shape probe: substitute placeholders with dummy literals so
+        # the statement patterns match parameterized SQL.
+        probe = _PARAM.sub("''", sql)
+        if m := _SELECT.match(probe):
+            want = m.group(1)
+            table = self.tables.get(m.group(2).lower())
+            cols = ([c.strip().lower() for c in want.split(",")]
+                    if want.strip() != "*" else
+                    (table[0] if table else []))
+            out += self._rowdesc(cols)
+        elif probe.strip().rstrip(";").lower() in ("select now()",
+                                                   "select current_timestamp"):
+            out += self._rowdesc(["now"])
+        else:
+            out += _msg(b"n", b"")  # NoData
+        return out, False
+
+    def _on_bind(self, payload: bytes, sess: _Session) -> Tuple[bytes, bool]:
+        off = payload.index(b"\0")
+        portal = payload[:off].decode()
+        end = payload.index(b"\0", off + 1)
+        stmt = payload[off + 1:end].decode()
+        off = end + 1
+        (nfmt,) = struct.unpack_from("!H", payload, off)
+        off += 2 + 2 * nfmt
+        (nparams,) = struct.unpack_from("!H", payload, off)
+        off += 2
+        values: List[Optional[str]] = []
+        for _ in range(nparams):
+            (vlen,) = struct.unpack_from("!i", payload, off)
+            off += 4
+            if vlen < 0:
+                values.append(None)
+            else:
+                values.append(payload[off:off + vlen].decode())
+                off += vlen
+        sql = sess.statements.get(stmt)
+        if sql is None:
+            return (self._error("ERROR", "26000",
+                                f'unknown statement "{stmt}"'), True)
+        n_params = max((int(m) for m in _PARAM.findall(sql)), default=0)
+        if len(values) != n_params:
+            return (self._error("ERROR", "08P01",
+                                f"bind supplies {len(values)} parameters, "
+                                f"statement needs {n_params}"), True)
+
+        def subst(m: "re.Match[str]") -> str:
+            v = values[int(m.group(1)) - 1]
+            return "NULL" if v is None else "'" + v.replace("'", "''") + "'"
+
+        sess.portals[portal] = _PARAM.sub(subst, sql)
+        return _msg(b"2", b""), False
+
+    def _on_execute(self, payload: bytes, sess: _Session) -> Tuple[bytes, bool]:
+        portal = payload[:payload.index(b"\0")].decode()
+        sql = sess.portals.get(portal)
+        if sql is None:
+            return (self._error("ERROR", "34000",
+                                f'unknown portal "{portal}"'), True)
+        out = self._run_txn(sql, sess)
+        # An error inside the extended flow skips to Sync.
+        return out, out[:1] == b"E"
+
+    # -- transaction wrapper --------------------------------------------
+    def _run_txn(self, sql: str, sess: _Session) -> bytes:
+        if _BEGIN.match(sql):
+            if sess.txn == "I":
+                sess.undo = []
+                sess.txn = "T"
+                return self._complete("BEGIN")
+            return self._notice() + self._complete("BEGIN")  # nested: no-op
+        if _COMMIT.match(sql):
+            if sess.txn == "E":
+                # COMMIT of a failed transaction rolls back (postgres rule).
+                self._rollback(sess)
+                return self._complete("ROLLBACK")
+            sess.txn, sess.undo = "I", []
+            return self._complete("COMMIT")
+        if _ROLLBACK.match(sql):
+            self._rollback(sess)
+            return self._complete("ROLLBACK")
+        if sess.txn == "E":
+            return self._error("ERROR", "25P02",
+                               "current transaction is aborted, commands "
+                               "ignored until end of transaction block")
+        out = self._run(sql, sess.undo if sess.txn == "T" else None)
+        if out[:1] == b"E" and sess.txn == "T":
+            sess.txn = "E"  # poison the transaction
+        return out
+
+    def _rollback(self, sess: _Session) -> None:
+        for inverse in reversed(sess.undo):
+            inverse()
+        sess.txn, sess.undo = "I", []
+
     # -- toy engine ----------------------------------------------------
-    def _run(self, sql: str) -> bytes:
+    def _run(self, sql: str, undo: Optional[List] = None) -> bytes:
+        """Execute one statement; mutations append their inverse to
+        ``undo`` when a transaction is open."""
         if sql.strip().rstrip(";").lower() in ("select now()", "select current_timestamp"):
             # Server-side wall-clock read: observes this node's simulated
             # system time *including injected clock skew*
@@ -268,17 +589,30 @@ class SimPostgresServer:
             if name in self.tables:
                 return self._error("ERROR", "42P07", f'table "{name}" exists')
             self.tables[name] = (cols, [])
+            if undo is not None:
+                undo.append(lambda: self.tables.pop(name, None))
             return self._complete("CREATE TABLE")
         if m := _INSERT.match(sql):
             name = m.group(1).lower()
             if name not in self.tables:
                 return self._error("ERROR", "42P01", f'no table "{name}"')
             cols, data = self.tables[name]
-            values = [v.strip().strip("'") for v in m.group(2).split(",")]
+            values = _parse_values(m.group(2))
+            if values is None:
+                return self._error("ERROR", "42601",
+                                   f"bad VALUES list: {m.group(2)[:40]!r}")
             if len(values) != len(cols):
                 return self._error("ERROR", "42601",
                                    f"expected {len(cols)} values")
             data.append(values)
+            if undo is not None:
+                def _undo_insert(data=data, row=values):
+                    for i in range(len(data) - 1, -1, -1):
+                        if data[i] is row:
+                            del data[i]
+                            return
+
+                undo.append(_undo_insert)
             return self._complete("INSERT 0 1")
         if m := _SELECT.match(sql):
             want, name = m.group(1), m.group(2).lower()
@@ -290,7 +624,7 @@ class SimPostgresServer:
             for c in out_cols:
                 if c not in cols:
                     return self._error("ERROR", "42703", f'no column "{c}"')
-            rows = self._filter(cols, data, m.group(3), m.group(4))
+            rows = self._filter(cols, data, m.group(3), m.group(4), m.group(5))
             proj = [[row[cols.index(c)] for c in out_cols] for row in rows]
             return self._rowset(out_cols, proj)
         if m := _DELETE.match(sql):
@@ -298,35 +632,47 @@ class SimPostgresServer:
             if name not in self.tables:
                 return self._error("ERROR", "42P01", f'no table "{name}"')
             cols, data = self.tables[name]
-            keep = [r for r in data
-                    if r not in self._filter(cols, data, m.group(2), m.group(3))]
-            removed = len(data) - len(keep)
-            self.tables[name] = (cols, keep)
-            return self._complete(f"DELETE {removed}")
+            drop = self._filter(cols, data, m.group(2), m.group(3), m.group(4))
+            # Mutate the row list in place: other sessions (and their undo
+            # closures) hold references to it.
+            data[:] = [r for r in data if r not in drop]
+            if undo is not None and drop:
+                undo.append(lambda data=data, rows=drop: data.extend(rows))
+            return self._complete(f"DELETE {len(drop)}")
         return self._error("ERROR", "42601", f"syntax error: {sql[:40]!r}")
 
     @staticmethod
-    def _filter(cols, data, where_col, where_val):
+    def _filter(cols, data, where_col, where_val, where_null):
         if where_col is None:
             return list(data)
+        if where_null is not None:
+            return []  # `col = NULL` matches nothing (three-valued logic)
         idx = cols.index(where_col.lower()) if where_col.lower() in cols else None
         if idx is None:
             return []
-        return [r for r in data if r[idx] == where_val]
+        val = where_val.replace("''", "'")
+        return [r for r in data if r[idx] == val]
 
     # -- response builders ---------------------------------------------
     @staticmethod
-    def _rowset(columns: List[str], rows: List[List[str]]) -> bytes:
+    def _rowdesc(columns: List[str]) -> bytes:
         desc = struct.pack("!H", len(columns))
         for col in columns:
             # name, table oid, attnum, type oid (25=text), typlen, typmod, fmt
             desc += _cstr(col) + struct.pack("!IHIhih", 0, 0, 25, -1, -1, 0)
-        out = _msg(b"T", desc)
+        return _msg(b"T", desc)
+
+    @staticmethod
+    def _rowset(columns: List[str], rows: List[List[str]]) -> bytes:
+        out = SimPostgresServer._rowdesc(columns)
         for row in rows:
             body = struct.pack("!H", len(row))
             for val in row:
-                raw = val.encode()
-                body += struct.pack("!i", len(raw)) + raw
+                if val is None:
+                    body += struct.pack("!i", -1)  # SQL NULL
+                else:
+                    raw = val.encode()
+                    body += struct.pack("!i", len(raw)) + raw
             out += _msg(b"D", body)
         return out + SimPostgresServer._complete(f"SELECT {len(rows)}")
 
@@ -335,6 +681,15 @@ class SimPostgresServer:
         return _msg(b"C", _cstr(tag))
 
     @staticmethod
+    def _notice(message: str = "there is already a transaction in progress") -> bytes:
+        body = (_cstr("SWARNING") + _cstr("VWARNING") + _cstr("C25001")
+                + _cstr("M" + message) + b"\0")
+        return _msg(b"N", body)
+
+    @staticmethod
     def _error(severity: str, code: str, message: str) -> bytes:
-        body = _cstr("S" + severity) + _cstr("C" + code) + _cstr("M" + message) + b"\0"
+        # Standard error fields: S localized severity, V non-localized
+        # severity, C sqlstate, M message (protocol error-fields table).
+        body = (_cstr("S" + severity) + _cstr("V" + severity)
+                + _cstr("C" + code) + _cstr("M" + message) + b"\0")
         return _msg(b"E", body)

@@ -171,6 +171,12 @@ def test_unoverridden_servicer_method_is_unimplemented(gen):
         with pytest.raises(grpc.RpcError) as ei:
             await stub.SayHello(pb2.HelloRequest(name="x"))
         assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        # Streaming methods too: an unoverridden async-coroutine base must
+        # surface UNIMPLEMENTED, not a TypeError-induced INTERNAL.
+        with pytest.raises(grpc.RpcError) as ei:
+            async for _ in stub.LotsOfReplies(pb2.HelloRequest(name="x")):
+                pass
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
         await ch.close()
         await server.stop()
 
