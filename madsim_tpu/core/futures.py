@@ -77,16 +77,17 @@ class SimFuture:
 
     def __await__(self):
         if not self.done():
-            loop = None
-            # The sim context wins unconditionally: under aio.patched() the
-            # shim substitutes asyncio.get_running_loop, so the loop probe
-            # alone cannot distinguish the backends.
-            from . import context
+            # Fast probe first: _get_running_loop is a C call returning
+            # None outside asyncio — the overwhelmingly common sim case
+            # never touches the TLS. The sim context wins unconditionally
+            # when both are present: under aio.patched() the shim
+            # substitutes asyncio.get_running_loop, so the loop probe alone
+            # cannot distinguish the backends.
+            loop = asyncio._get_running_loop()
+            if loop is not None:
+                from . import context
 
-            if context.try_current_handle() is None:
-                try:
-                    loop = asyncio.get_running_loop()
-                except RuntimeError:
+                if context.try_current_handle() is not None:
                     loop = None
             if loop is None:
                 yield self  # sim executor: wake via the random scheduler

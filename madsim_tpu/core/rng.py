@@ -43,10 +43,14 @@ class GlobalRng:
         self._counter = 0
         self._buf: Optional[int] = None
         # Draw backend: native C++ core when built, else scalar Python —
-        # both bit-exact with the numpy/jax array paths.
+        # both bit-exact with the numpy/jax array paths. The native path
+        # keeps the whole cursor (counter + u32 buffer) in a C object so a
+        # scheduler decision is one native call (SURVEY §2 ⚙).
         from .. import native as _native
 
         self._lib = _native.get_lib()
+        self._st = (self._lib.rng_new(self._k0, self._k1, 0)
+                    if self._lib is not None else None)
         # Determinism checker state (`rand.rs:84-107`): in 'log' mode every
         # access appends hash(value ^ hash(elapsed)); in 'check' mode accesses
         # are compared against the recorded log and the first divergence panics
@@ -93,35 +97,44 @@ class GlobalRng:
 
     # -- raw draws ---------------------------------------------------------
     def _draw(self) -> int:
-        """One u64 Threefry block at the current counter."""
-        if self._lib is not None:
-            v = self._lib.threefry_draw(self._k0, self._k1, self._counter)
-        else:
-            x0, x1 = threefry2x32_scalar(
-                self._k0, self._k1,
-                self._counter & 0xFFFFFFFF, self._counter >> 32)
-            v = (x1 << 32) | x0
+        """One u64 Threefry block at the current counter (pure-Python
+        cursor; the native cursor advances inside the C object)."""
+        x0, x1 = threefry2x32_scalar(
+            self._k0, self._k1,
+            self._counter & 0xFFFFFFFF, self._counter >> 32)
         self._counter += 1
-        return v
+        return (x1 << 32) | x0
 
     def next_u32(self) -> int:
-        if self._buf is not None:
+        if self._st is not None:
+            v = self._lib.rng_next_u32(self._st)
+        elif self._buf is not None:
             v, self._buf = self._buf, None
         else:
             block = self._draw()
             v, self._buf = block & 0xFFFFFFFF, block >> 32
-        self._observe(v)
+        if self._mode is not None:
+            self._observe(v)
         return v
 
     def next_u64(self) -> int:
-        v = self._draw()
-        self._buf = None
-        self._observe(v)
+        if self._st is not None:
+            v = self._lib.rng_next_u64(self._st)
+        else:
+            v = self._draw()
+            self._buf = None
+        if self._mode is not None:
+            self._observe(v)
         return v
 
     # -- distribution helpers (rand-crate-style surface) -------------------
     def gen_range(self, low: int, high: int) -> int:
         """Uniform integer in [low, high). high must be > low."""
+        if self._st is not None and self._mode is None:
+            try:
+                return self._lib.rng_gen_range(self._st, low, high)
+            except OverflowError:
+                pass  # bounds beyond i64: draw below (no counter consumed)
         width = high - low
         if width <= 0:
             raise ValueError(f"empty range [{low}, {high})")
@@ -129,6 +142,8 @@ class GlobalRng:
 
     def random(self) -> float:
         """Uniform float in [0, 1) with 53 bits of precision."""
+        if self._st is not None and self._mode is None:
+            return self._lib.rng_random(self._st)
         return (self.next_u64() >> 11) * (2.0 ** -53)
 
     def gen_bool(self, p: float) -> bool:

@@ -7,6 +7,7 @@ real DNS inside a simulation.
 """
 from __future__ import annotations
 
+import functools
 import ipaddress
 from typing import Tuple, Union
 
@@ -18,6 +19,10 @@ class AddrParseError(ValueError):
     pass
 
 
+# Address parsing sits on the per-message hot path (every send resolves its
+# destination); the cache turns repeat parses of the handful of addresses a
+# world uses into dict hits.
+@functools.lru_cache(maxsize=4096)
 def _normalize_ip(ip: str) -> str:
     if ip == "localhost":
         return "127.0.0.1"
@@ -51,10 +56,12 @@ async def lookup_host(addr: AddrLike) -> list[Addr]:
     return [parse_addr(addr)]
 
 
+@functools.lru_cache(maxsize=4096)
 def ip_is_loopback(ip: str) -> bool:
     return ipaddress.ip_address(ip).is_loopback
 
 
+@functools.lru_cache(maxsize=4096)
 def ip_is_unspecified(ip: str) -> bool:
     return ipaddress.ip_address(ip).is_unspecified
 

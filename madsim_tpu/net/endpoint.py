@@ -12,6 +12,7 @@ from typing import Any, List, Optional, Tuple
 
 from ..core import context
 from ..core.futures import Channel, ChannelClosed, SimFuture
+from ..core.timewheel import to_ns as _to_ns
 from .addr import Addr, AddrLike, lookup_host, parse_addr
 from .netsim import (
     BindGuard,
@@ -163,10 +164,24 @@ class Endpoint:
         net = self._guard.net
         await net.send(self._guard.node, self._guard.addr[1], dst, IpProtocol.UDP, (tag, data))
 
-    async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:
+    async def recv_from_raw(self, tag: int,
+                            timeout: Optional[float] = None) -> Tuple[Any, Addr]:
+        """Receive one raw message; optional virtual-time deadline.
+
+        The deadline is armed directly on the mailbox future rather than
+        through ``time.timeout`` — no wrapper task to spawn/abort, which
+        halves the scheduler polls of a timed RPC (rpc.call's hot path)."""
         fut = self._socket.mailbox.recv(tag)
+        timer = None
+        if timeout is not None:
+            timer = self._guard.net.time.add_timer(
+                _to_ns(timeout),
+                lambda: fut.set_exception(TimeoutError()) if not fut.done() else None)
         try:
             msg = await fut
+        except TimeoutError:
+            self._socket.mailbox.unregister(fut)
+            raise
         except BaseException:
             # A cancelled receiver (e.g. timeout) must give its message back
             # to later receivers (`endpoint.rs:353-387` test): either it was
@@ -176,6 +191,9 @@ class Endpoint:
             else:
                 self._socket.mailbox.unregister(fut)
             raise
+        finally:
+            if timer is not None:
+                timer.cancel()
         try:
             await self._guard.net.rand_delay()
         except BaseException:

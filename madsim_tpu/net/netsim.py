@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, Optional, Tuple
 
+from .. import time as vtime
 from ..core import context
 from ..core.futures import Channel, ChannelClosed
 from ..core.plugin import Simulator
@@ -83,11 +84,22 @@ class NetSim(Simulator):
     # -- data path ----------------------------------------------------------
     async def rand_delay(self) -> None:
         """Random 0-5 µs processing delay before touching the network
-        (`mod.rs:173-178`); keeps send timestamps distinct across seeds."""
-        from .. import time as vtime
+        (`mod.rs:173-178`); keeps send timestamps distinct across seeds.
 
+        Host-engine redesign: the reference registers a real timer here;
+        this engine advances the virtual clock synchronously by the drawn
+        delay and suspends through the executor's timer-free yield_now().
+        Deliberate divergence: concurrent senders' delays accumulate
+        serially (each advances the clock in turn) instead of overlapping
+        on the timer wheel — the same serialization the reference's own
+        per-poll 50-100 ns jitter has (`task.rs:176-178`), at µs scale,
+        bounded by 5 µs x messages-per-batch (vs the 1-10 ms link
+        latencies that dominate all timing). In exchange the timer-heap
+        push/pop/fire cycle — the hottest path in RPC-heavy worlds — is
+        gone. The scheduling point and the RNG draw are unchanged."""
         delay_us = self.rand.gen_range(0, 5)
-        await vtime.sleep(delay_us * 1e-6)
+        self.time.advance(delay_us * 1000)
+        await context.current_handle().task.yield_now()
 
     async def send(self, node_id: int, port: int, dst: Addr, protocol: IpProtocol, msg) -> None:
         await self.rand_delay()
@@ -121,8 +133,6 @@ class NetSim(Simulator):
         downstream = Channel()
 
         async def relay():
-            from .. import time as vtime
-
             try:
                 while True:
                     try:

@@ -11,9 +11,12 @@ from __future__ import annotations
 
 from typing import Any, Awaitable, Callable, Optional, Tuple, Type
 
-from ..core import context
+from .. import rand as _rand
+from .. import task as _task
+from .. import time as vtime
+from ..core import context  # noqa: F401 — part of the module's public shape
 from ..core.futures import ChannelClosed
-from .addr import AddrLike, lookup_host
+from .addr import AddrLike, lookup_host  # noqa: F401
 from .endpoint import Endpoint
 from .network import BrokenPipe, ConnectionReset
 
@@ -26,6 +29,10 @@ def hash_str(s: str) -> int:
     return h
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def type_tag(req_type: type) -> int:
     """Stable RPC tag for a request type (module path + qualname)."""
     override = getattr(req_type, "__rpc_id__", None)
@@ -42,24 +49,18 @@ async def call(ep: Endpoint, dst: AddrLike, request: Any, timeout: Optional[floa
 
 async def call_with_data(ep: Endpoint, dst: AddrLike, request: Any, data: bytes,
                          timeout: Optional[float] = None) -> Tuple[Any, bytes]:
-    """Send an RPC with a raw data sidecar → (response, response_data)."""
-    from .. import rand as _rand
-    from .. import time as vtime
+    """Send an RPC with a raw data sidecar → (response, response_data).
 
+    The deadline is armed inside the endpoint's mailbox (no wrapper task),
+    the timed-RPC fast path on both backends."""
     rsp_tag = _rand.thread_rng().next_u64()
     # send_to resolves the address per backend (sim parser vs real DNS).
     await ep.send_to(dst, type_tag(type(request)), (rsp_tag, request, data))
-
-    async def _recv():
-        payload, from_addr = await ep.recv_from_raw(rsp_tag)
-        resp, rsp_data = payload
-        if isinstance(resp, _RpcFault):
-            raise RpcError(resp.message)
-        return resp, rsp_data
-
-    if timeout is not None:
-        return await vtime.timeout(timeout, _recv())
-    return await _recv()
+    payload, _from_addr = await ep.recv_from_raw(rsp_tag, timeout=timeout)
+    resp, rsp_data = payload
+    if isinstance(resp, _RpcFault):
+        raise RpcError(resp.message)
+    return resp, rsp_data
 
 
 def add_rpc_handler(ep: Endpoint, req_type: Type,
@@ -81,8 +82,6 @@ def add_rpc_handler_with_data(ep: Endpoint, req_type: Type,
     (`rpc.rs:134-166`). Works on both backends: spawn routes to the sim
     executor in-sim and to asyncio tasks in real mode.
     """
-    from .. import task as _task
-
     tag = type_tag(req_type)
 
     async def dispatcher():

@@ -294,10 +294,21 @@ class RealEndpoint:
     async def recv_from(self, tag: int) -> Tuple[Any, Addr]:
         return await self.recv_from_raw(tag)
 
-    async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:
+    async def recv_from_raw(self, tag: int,
+                            timeout: Optional[float] = None) -> Tuple[Any, Addr]:
         fut = self._mailbox.recv(tag)
         try:
-            msg = await fut
+            if timeout is not None:
+                msg = await asyncio.wait_for(asyncio.shield(fut), timeout)
+            else:
+                msg = await fut
+        except asyncio.TimeoutError:
+            if fut.done() and fut.exception() is None:
+                self._mailbox.requeue_front(fut.result())
+            else:
+                fut.cancel()
+                self._mailbox.unregister(fut)
+            raise TimeoutError() from None
         except asyncio.CancelledError:
             if fut.done() and fut.exception() is None:
                 self._mailbox.requeue_front(fut.result())

@@ -380,7 +380,18 @@ class SimPostgresServer:
 
     def __init__(self):
         self.tables: Dict[str, Tuple[List[str], List[List[str]]]] = {}
+        # Tables created inside a still-open transaction: invisible to
+        # every other session until commit (postgres DDL transactionality),
+        # which also makes CREATE's rollback-drop safe — no other session
+        # can have written rows into a pending table.
+        self.pending_tables: Dict[str, "_Session"] = {}
         self._listener: Optional[TcpListener] = None
+
+    def _visible(self, name: str, sess: Optional["_Session"]) -> bool:
+        if name not in self.tables:
+            return False
+        owner = self.pending_tables.get(name)
+        return owner is None or owner is sess
 
     async def serve(self, addr) -> None:
         self._listener = await TcpListener.bind(addr)
@@ -454,6 +465,11 @@ class SimPostgresServer:
         except (ConnectionReset, BrokenPipe):
             return  # client vanished (crash / partition): session ends
         finally:
+            # Session over (Terminate, reset, or crash): an open
+            # transaction rolls back — uncommitted writes must never
+            # outlive their connection (postgres disconnect semantics).
+            if sess.txn != "I":
+                self._rollback(sess)
             stream.close()
 
     # -- extended-protocol handlers -------------------------------------
@@ -481,7 +497,8 @@ class SimPostgresServer:
         probe = _PARAM.sub("''", sql)
         if m := _SELECT.match(probe):
             want = m.group(1)
-            table = self.tables.get(m.group(2).lower())
+            tname = m.group(2).lower()
+            table = self.tables.get(tname) if self._visible(tname, sess) else None
             cols = ([c.strip().lower() for c in want.split(",")]
                     if want.strip() != "*" else
                     (table[0] if table else []))
@@ -516,7 +533,12 @@ class SimPostgresServer:
         if sql is None:
             return (self._error("ERROR", "26000",
                                 f'unknown statement "{stmt}"'), True)
-        n_params = max((int(m) for m in _PARAM.findall(sql)), default=0)
+        indices = [int(m) for m in _PARAM.findall(sql)]
+        if any(i < 1 for i in indices):
+            bad = min(indices)
+            return (self._error("ERROR", "42P02",
+                                f"there is no parameter ${bad}"), True)
+        n_params = max(indices, default=0)
         if len(values) != n_params:
             return (self._error("ERROR", "08P01",
                                 f"bind supplies {len(values)} parameters, "
@@ -552,6 +574,7 @@ class SimPostgresServer:
                 # COMMIT of a failed transaction rolls back (postgres rule).
                 self._rollback(sess)
                 return self._complete("ROLLBACK")
+            self._publish_pending(sess)
             sess.txn, sess.undo = "I", []
             return self._complete("COMMIT")
         if _ROLLBACK.match(sql):
@@ -561,7 +584,7 @@ class SimPostgresServer:
             return self._error("ERROR", "25P02",
                                "current transaction is aborted, commands "
                                "ignored until end of transaction block")
-        out = self._run(sql, sess.undo if sess.txn == "T" else None)
+        out = self._run(sql, sess)
         if out[:1] == b"E" and sess.txn == "T":
             sess.txn = "E"  # poison the transaction
         return out
@@ -569,12 +592,22 @@ class SimPostgresServer:
     def _rollback(self, sess: _Session) -> None:
         for inverse in reversed(sess.undo):
             inverse()
+        self._publish_pending(sess)
         sess.txn, sess.undo = "I", []
 
+    def _publish_pending(self, sess: _Session) -> None:
+        """End-of-transaction: this session's pending DDL becomes globally
+        visible (commit) or is gone already (rollback ran the drop)."""
+        self.pending_tables = {n: s for n, s in self.pending_tables.items()
+                               if s is not sess}
+
     # -- toy engine ----------------------------------------------------
-    def _run(self, sql: str, undo: Optional[List] = None) -> bytes:
-        """Execute one statement; mutations append their inverse to
-        ``undo`` when a transaction is open."""
+    def _run(self, sql: str, sess: Optional[_Session] = None) -> bytes:
+        """Execute one statement for ``sess``; mutations append their
+        inverse to the session's undo log when its transaction is open,
+        and pending (uncommitted-DDL) tables of other sessions are
+        invisible."""
+        undo = sess.undo if sess is not None and sess.txn == "T" else None
         if sql.strip().rstrip(";").lower() in ("select now()", "select current_timestamp"):
             # Server-side wall-clock read: observes this node's simulated
             # system time *including injected clock skew*
@@ -590,11 +623,20 @@ class SimPostgresServer:
                 return self._error("ERROR", "42P07", f'table "{name}" exists')
             self.tables[name] = (cols, [])
             if undo is not None:
-                undo.append(lambda: self.tables.pop(name, None))
+                # Transactional DDL: invisible to other sessions until
+                # commit, so the rollback-drop can never destroy another
+                # session's committed rows.
+                self.pending_tables[name] = sess
+
+                def _undo_create(name=name):
+                    self.tables.pop(name, None)
+                    self.pending_tables.pop(name, None)
+
+                undo.append(_undo_create)
             return self._complete("CREATE TABLE")
         if m := _INSERT.match(sql):
             name = m.group(1).lower()
-            if name not in self.tables:
+            if not self._visible(name, sess):
                 return self._error("ERROR", "42P01", f'no table "{name}"')
             cols, data = self.tables[name]
             values = _parse_values(m.group(2))
@@ -616,7 +658,7 @@ class SimPostgresServer:
             return self._complete("INSERT 0 1")
         if m := _SELECT.match(sql):
             want, name = m.group(1), m.group(2).lower()
-            if name not in self.tables:
+            if not self._visible(name, sess):
                 return self._error("ERROR", "42P01", f'no table "{name}"')
             cols, data = self.tables[name]
             out_cols = cols if want.strip() == "*" else \
@@ -629,7 +671,7 @@ class SimPostgresServer:
             return self._rowset(out_cols, proj)
         if m := _DELETE.match(sql):
             name = m.group(1).lower()
-            if name not in self.tables:
+            if not self._visible(name, sess):
                 return self._error("ERROR", "42P01", f'no table "{name}"')
             cols, data = self.tables[name]
             drop = self._filter(cols, data, m.group(2), m.group(3), m.group(4))

@@ -159,6 +159,49 @@ uint64_t ms_pick_index(uint32_t k0, uint32_t k1, uint64_t counter,
   return ms_threefry_draw(k0, k1, counter) % len;
 }
 
+// ---------------------------------------------------------------------------
+// Stateful RNG cursor (GlobalRng's hot path in one native object): key,
+// draw counter, and the 32-bit half-block buffer live here, so a scheduler
+// decision (gen_range) is ONE native call instead of four Python frames.
+// Semantics are bit-identical to madsim_tpu/core/rng.py GlobalRng:
+//   next_u64: fresh block, clears the u32 buffer
+//   next_u32: buffered half first, else low half of a fresh block
+//   gen_range(lo,hi): lo + next_u64() % (hi-lo)
+//   random(): (next_u64() >> 11) * 2^-53
+// ---------------------------------------------------------------------------
+
+struct RngState {
+  uint32_t k0, k1;
+  uint64_t counter;
+  uint32_t buf;
+  int has_buf;
+};
+
+void* ms_rng_new(uint32_t k0, uint32_t k1, uint64_t counter) {
+  auto* st = new RngState{k0, k1, counter, 0, 0};
+  return st;
+}
+
+void ms_rng_free(void* p) { delete (RngState*)p; }
+
+uint64_t ms_rng_next_u64(void* p) {
+  auto* st = (RngState*)p;
+  st->has_buf = 0;
+  return ms_threefry_draw(st->k0, st->k1, st->counter++);
+}
+
+uint32_t ms_rng_next_u32(void* p) {
+  auto* st = (RngState*)p;
+  if (st->has_buf) {
+    st->has_buf = 0;
+    return st->buf;
+  }
+  uint64_t block = ms_threefry_draw(st->k0, st->k1, st->counter++);
+  st->buf = (uint32_t)(block >> 32);
+  st->has_buf = 1;
+  return (uint32_t)(block & 0xFFFFFFFFu);
+}
+
 }  // extern "C"
 
 // ===========================================================================
@@ -246,7 +289,86 @@ static PyObject* py_heap_len(PyObject*, PyObject* args) {
   return PyLong_FromUnsignedLongLong(ms_timerheap_len(h));
 }
 
+// -- RngState bindings ------------------------------------------------------
+
+static void rng_capsule_destructor(PyObject* capsule) {
+  void* p = PyCapsule_GetPointer(capsule, "madsim.RngState");
+  if (p) ms_rng_free(p);
+}
+
+static RngState* rng_from(PyObject* capsule) {
+  return (RngState*)PyCapsule_GetPointer(capsule, "madsim.RngState");
+}
+
+static PyObject* py_rng_new(PyObject*, PyObject* args) {
+  unsigned int k0, k1;
+  unsigned long long counter;
+  if (!PyArg_ParseTuple(args, "IIK", &k0, &k1, &counter)) return nullptr;
+  return PyCapsule_New(ms_rng_new(k0, k1, counter), "madsim.RngState",
+                       rng_capsule_destructor);
+}
+
+static PyObject* py_rng_next_u64(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  RngState* st = rng_from(capsule);
+  if (!st) return nullptr;
+  return PyLong_FromUnsignedLongLong(ms_rng_next_u64(st));
+}
+
+static PyObject* py_rng_next_u32(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  RngState* st = rng_from(capsule);
+  if (!st) return nullptr;
+  return PyLong_FromUnsignedLong(ms_rng_next_u32(st));
+}
+
+static PyObject* py_rng_gen_range(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  long long lo, hi;
+  if (!PyArg_ParseTuple(args, "OLL", &capsule, &lo, &hi)) return nullptr;
+  RngState* st = rng_from(capsule);
+  if (!st) return nullptr;
+  long long width = hi - lo;
+  if (width <= 0) {
+    PyErr_Format(PyExc_ValueError, "empty range [%lld, %lld)", lo, hi);
+    return nullptr;
+  }
+  uint64_t v = ms_rng_next_u64(st);
+  return PyLong_FromLongLong(lo + (long long)(v % (uint64_t)width));
+}
+
+static PyObject* py_rng_random(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  RngState* st = rng_from(capsule);
+  if (!st) return nullptr;
+  uint64_t v = ms_rng_next_u64(st);
+  return PyFloat_FromDouble((double)(v >> 11) * 1.1102230246251565e-16);
+}
+
+static PyObject* py_rng_get_state(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  RngState* st = rng_from(capsule);
+  if (!st) return nullptr;
+  if (st->has_buf)
+    return Py_BuildValue("(KI)", (unsigned long long)st->counter,
+                         (unsigned int)st->buf);
+  return Py_BuildValue("(KO)", (unsigned long long)st->counter, Py_None);
+}
+
 static PyMethodDef core_methods[] = {
+    {"rng_new", py_rng_new, METH_VARARGS,
+     "rng_new(k0, k1, counter) -> RngState capsule"},
+    {"rng_next_u64", py_rng_next_u64, METH_VARARGS, "fresh u64 block"},
+    {"rng_next_u32", py_rng_next_u32, METH_VARARGS, "buffered u32 draw"},
+    {"rng_gen_range", py_rng_gen_range, METH_VARARGS,
+     "gen_range(rng, lo, hi) -> lo + u64 % (hi-lo)"},
+    {"rng_random", py_rng_random, METH_VARARGS, "uniform [0,1), 53-bit"},
+    {"rng_get_state", py_rng_get_state, METH_VARARGS,
+     "(counter, buf|None) — parity checks / introspection"},
     {"threefry_draw", py_threefry_draw, METH_VARARGS,
      "threefry_draw(k0, k1, counter) -> u64 block (x1<<32|x0)"},
     {"derive_stream", py_derive_stream, METH_VARARGS,

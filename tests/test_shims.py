@@ -526,6 +526,81 @@ def test_postgres_values_with_commas_and_quotes():
     assert _pg_world(body)
 
 
+def test_postgres_disconnect_rolls_back_open_transaction():
+    # Uncommitted writes must not outlive their connection.
+    async def main():
+        h = ms.Handle.current()
+        server = postgres.SimPostgresServer()
+
+        async def serve():
+            await server.serve(("10.0.0.1", 5432))
+
+        h.create_node(name="db", ip="10.0.0.1", init=serve)
+        done = ms.sync.SimFuture()
+
+        async def app():
+            await time.sleep(0.1)
+            a = await postgres.connect("10.0.0.1")
+            await a.execute("CREATE TABLE t (k)")
+            await a.execute("BEGIN")
+            await a.execute("INSERT INTO t VALUES ('uncommitted')")
+            await a.close()  # Terminate with the transaction still open
+            b = await postgres.connect("10.0.0.1")
+            rows = await b.query("SELECT * FROM t")
+            await b.close()
+            done.set_result([r[0] for r in rows])
+
+        h.create_node(name="app", ip="10.0.0.2", init=app)
+        return await time.timeout(60, _await(done))
+
+    assert ms.run(main(), seed=12) == []
+
+
+def test_postgres_bad_placeholder_and_pending_ddl():
+    async def main():
+        h = ms.Handle.current()
+        server = postgres.SimPostgresServer()
+
+        async def serve():
+            await server.serve(("10.0.0.1", 5432))
+
+        h.create_node(name="db", ip="10.0.0.1", init=serve)
+        done = ms.sync.SimFuture()
+
+        async def app():
+            await time.sleep(0.1)
+            a = await postgres.connect("10.0.0.1")
+            # $0 is not a parameter: the server must error, not crash.
+            s = await a.prepare("SELECT k FROM t WHERE k = $0")
+            with pytest.raises(postgres.PostgresError) as ei:
+                await a.query_prepared(s, [])
+            assert ei.value.code == "42P02"
+            # DDL inside an open transaction is invisible to other sessions
+            # until commit; rollback drops it without touching anyone else.
+            b = await postgres.connect("10.0.0.1")
+            await a.execute("BEGIN")
+            await a.execute("CREATE TABLE pend (k)")
+            with pytest.raises(postgres.PostgresError) as ei:
+                await b.query("SELECT * FROM pend")
+            assert ei.value.code == "42P01"
+            await a.execute("ROLLBACK")
+            with pytest.raises(postgres.PostgresError):
+                await a.query("SELECT * FROM pend")  # dropped by rollback
+            # Committed DDL becomes visible.
+            await a.execute("BEGIN")
+            await a.execute("CREATE TABLE pub (k)")
+            await a.execute("COMMIT")
+            assert await b.query("SELECT * FROM pub") == []
+            await a.close()
+            await b.close()
+            done.set_result(True)
+
+        h.create_node(name="app", ip="10.0.0.2", init=app)
+        return await time.timeout(60, _await(done))
+
+    assert ms.run(main(), seed=13)
+
+
 def test_postgres_prepared_txn_under_loss_and_restart():
     # The VERDICT bar: prepared statements + transaction rollback while the
     # network drops packets and the DB node restarts mid-run.
