@@ -199,9 +199,42 @@ class Runtime:
         return results[1]
 
 
+def sim_span() -> str:
+    """The current simulation span — ``t=<vtime> node=<id>/<name>
+    task=<id>`` — or '' outside a simulation.
+
+    The analog of the reference's per-node/per-task tracing spans that wrap
+    every poll (`madsim/src/sim/task.rs:58-82,100`): every in-sim log line
+    carries who emitted it and at what virtual time, which is what makes a
+    seed-replayed trace navigable."""
+    handle = context.try_current_handle()
+    if handle is None:
+        return ""
+    t = handle.time.now_ns() / 1e9
+    task = context.try_current_task()
+    if task is None:
+        return f"[t={t:.9f}s]"
+    node = task.node
+    return f"[t={t:.9f}s node={node.id}/{node.name} task={task.id}]"
+
+
+class _SpanFilter:
+    """logging filter injecting the sim span into every record (attribute
+    ``sim``, used by the default format; safe no-op outside a sim)."""
+
+    def filter(self, record) -> bool:
+        span = sim_span()
+        record.sim = (span + " ") if span else ""
+        return True
+
+
 def init_logger() -> None:
-    """Install a basic logging config once (`runtime/mod.rs:380-384` analog).
-    Honors MADSIM_LOG (e.g. DEBUG/INFO)."""
+    """Install the logging config once (`runtime/mod.rs:380-384` analog):
+    MADSIM_LOG sets the level, and every record carries the structured
+    simulation span (virtual time + node + task identity) as the ``sim``
+    attribute. When logging was already configured elsewhere (basicConfig
+    no-ops), the span attribute is still injected so custom formats can
+    include ``%(sim)s`` — but the preexisting format string is left alone."""
     import logging
     import os
 
@@ -209,5 +242,13 @@ def init_logger() -> None:
         return
     init_logger._done = True  # type: ignore[attr-defined]
     level = os.environ.get("MADSIM_LOG", "WARNING").upper()
+    root = logging.getLogger()
+    preconfigured = bool(root.handlers)
     logging.basicConfig(level=getattr(logging, level, logging.WARNING),
-                        format="%(levelname)s %(name)s: %(message)s")
+                        format="%(levelname)s %(sim)s%(name)s: %(message)s")
+    for handler in root.handlers:
+        handler.addFilter(_SpanFilter())
+    if preconfigured:
+        logging.getLogger(__name__).debug(
+            "logging was configured before init_logger: %%(sim)s span "
+            "attribute injected, existing format preserved")

@@ -27,11 +27,13 @@ from .network import (
 from .tcp import TcpListener, TcpStream
 from .udp import UdpSocket
 from . import rpc  # attaches call/add_rpc_handler onto Endpoint
+from .service_layer import rpc as rpc_method  # noqa: F401
+from .service_layer import service
 
 __all__ = [
     "Addr", "AddrLike", "format_addr", "lookup_host", "parse_addr",
     "Endpoint", "NetSim", "BindGuard", "ChannelSender", "ChannelReceiver",
     "AddrInUse", "AddrNotAvailable", "BrokenPipe", "ConnectionRefused",
     "ConnectionReset", "IpProtocol", "NetworkError", "Socket", "Stat",
-    "TcpListener", "TcpStream", "UdpSocket", "rpc",
+    "TcpListener", "TcpStream", "UdpSocket", "rpc", "service", "rpc_method",
 ]

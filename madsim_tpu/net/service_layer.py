@@ -1,0 +1,106 @@
+"""Service-layer ergonomics: ``@service`` / ``@rpc`` decorators.
+
+The ``#[madsim::service]`` macro analog (`madsim-macros/src/service.rs:
+8-111`): the reference rewrites an impl block so every ``#[rpc]`` method is
+registered as an RPC handler by a generated ``add_rpc_handler`` —  here a
+class decorator attaches ``add_rpc_handler(ep)`` / ``serve(addr)`` /
+``serve_on(ep)`` that wire each ``@rpc`` method into the endpoint's
+dispatcher, keyed by the method's request type (taken from its parameter
+annotation, the typed-request idiom of `service.rs` RpcFn).
+
+Usage::
+
+    @service
+    class KvStore:
+        @rpc
+        async def put(self, req: PutRequest) -> PutReply: ...
+        @rpc
+        async def get(self, req: GetRequest) -> GetReply: ...
+
+    node_ep = await Endpoint.bind("10.0.0.1:700")
+    await KvStore().serve_on(node_ep)           # or .serve(addr) to bind
+    # client side: rpc.call(ep, addr, PutRequest(...))
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Type
+
+from .addr import AddrLike
+from .endpoint import Endpoint
+from . import rpc as _rpc_mod
+
+
+def rpc(fn: Callable) -> Callable:
+    """Mark an async method as an RPC handler (`#[rpc]` analog)."""
+    if not inspect.iscoroutinefunction(fn):
+        raise TypeError("@rpc requires an async method")
+    fn._madsim_rpc = True
+    return fn
+
+
+def _request_type(cls_name: str, fn: Callable) -> Type:
+    """The request type = the annotation of the first non-self parameter."""
+    sig = inspect.signature(fn)
+    params = [p for p in sig.parameters.values() if p.name != "self"]
+    if not params:
+        raise TypeError(
+            f"@rpc method {cls_name}.{fn.__name__} needs a request parameter")
+    ann = params[0].annotation
+    if ann is inspect.Parameter.empty:
+        raise TypeError(
+            f"@rpc method {cls_name}.{fn.__name__}'s request parameter must "
+            "be annotated with its request type (the tag the dispatcher "
+            "routes on, `service.rs` RpcFn semantics)")
+    if isinstance(ann, str):
+        # `from __future__ import annotations` stringizes. Evaluate ONLY
+        # this annotation (not the whole signature: an unresolvable reply
+        # annotation must not break decoration).
+        ann = eval(ann, getattr(fn, "__globals__", {}))  # noqa: S307
+    return ann
+
+
+def service(cls: type) -> type:
+    """Class decorator: collect ``@rpc`` methods and attach the serving
+    surface (`#[madsim::service]` analog)."""
+    methods = {}
+    seen: dict = {}
+    # dir() + getattr_static covers inherited @rpc methods too (a subclass
+    # of a service base must serve the base's handlers).
+    for name in dir(cls):
+        fn = inspect.getattr_static(cls, name, None)
+        if callable(fn) and getattr(fn, "_madsim_rpc", False):
+            req_type = _request_type(cls.__name__, fn)
+            if req_type in seen:
+                raise TypeError(
+                    f"@service {cls.__name__}: methods {seen[req_type]!r} "
+                    f"and {name!r} both take {req_type.__name__} — request "
+                    "types route RPCs, so each may have exactly one handler")
+            seen[req_type] = name
+            methods[name] = req_type
+    cls.__rpc_methods__ = methods
+
+    def add_rpc_handler(self, ep: Endpoint) -> None:
+        """Register every @rpc method on an endpoint (generated
+        `add_rpc_handler`, service.rs:62-111)."""
+        for name, req_type in type(self).__rpc_methods__.items():
+            bound = getattr(self, name)
+
+            async def handler(req: Any, _fn=bound) -> Any:
+                return await _fn(req)
+
+            _rpc_mod.add_rpc_handler(ep, req_type, handler)
+
+    async def serve_on(self, ep: Endpoint) -> Endpoint:
+        """Register handlers on an existing endpoint; returns it."""
+        self.add_rpc_handler(ep)
+        return ep
+
+    async def serve(self, addr: AddrLike) -> Endpoint:
+        """Bind an endpoint at ``addr`` and serve this service on it."""
+        return await self.serve_on(await Endpoint.bind(addr))
+
+    cls.add_rpc_handler = add_rpc_handler
+    cls.serve_on = serve_on
+    cls.serve = serve
+    return cls
