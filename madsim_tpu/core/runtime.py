@@ -250,5 +250,5 @@ def init_logger() -> None:
         handler.addFilter(_SpanFilter())
     if preconfigured:
         logging.getLogger(__name__).debug(
-            "logging was configured before init_logger: %%(sim)s span "
-            "attribute injected, existing format preserved")
+            "logging was configured before init_logger: %s span attribute "
+            "injected, existing format preserved", "%(sim)s")

@@ -19,7 +19,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
 
 from ..engine.core import DeviceEngine, EngineConfig, WorldState
 from .mesh import WORLD_AXIS, seed_mesh, shard_worlds
@@ -50,9 +54,13 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
             jnp.sum(state.active.astype(jnp.int32)), WORLD_AXIS)
         return state, any_bug, n_active
 
-    runner = jax.jit(shard_map(
-        chunk, mesh=mesh, in_specs=(spec,),
-        out_specs=(spec, P(), P()), check_rep=False))
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
+                           out_specs=(spec, P(), P()), check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
+                           out_specs=(spec, P(), P()), check_rep=False)
+    runner = jax.jit(mapped)
     cache[key] = runner
     return runner
 
@@ -83,8 +91,21 @@ class SweepResult:
 def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = None,
           mesh: Optional[Mesh] = None, chunk_steps: int = 512,
           max_steps: int = 1_000_000, stop_on_first_bug: bool = False,
-          engine: Optional[DeviceEngine] = None) -> SweepResult:
-    """Run one simulation per seed, sharded over the mesh, to completion."""
+          engine: Optional[DeviceEngine] = None,
+          checkpoint_path: Optional[str] = None,
+          checkpoint_every_chunks: int = 0,
+          resume: bool = False) -> SweepResult:
+    """Run one simulation per seed, sharded over the mesh, to completion.
+
+    Preemption survival: with ``checkpoint_path`` set, the (padded) world
+    state is written every ``checkpoint_every_chunks`` chunks (and at the
+    end); with ``resume=True`` an existing checkpoint is loaded instead of
+    re-initializing, and the sweep continues bit-exactly where it stopped —
+    resumed trajectories equal an unbroken run's (the state carries every
+    RNG cursor and queue). ``max_steps`` counts steps issued by THIS call.
+    """
+    from ..engine import checkpoint as ckpt
+
     eng = engine if engine is not None else DeviceEngine(actor, cfg)
     mesh = mesh if mesh is not None else seed_mesh()
     n_dev = mesh.devices.size
@@ -101,17 +122,42 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             faults_p = np.concatenate(
                 [faults_p, faults_p[:1].repeat(pad, axis=0)], axis=0)
 
-    state = shard_worlds(eng.init(seeds_p, faults=faults_p), mesh)
+    import hashlib
+    import os
+
+    # Seed identity travels with the checkpoint: resuming under different
+    # seeds would silently attribute results (repro banners!) to the wrong
+    # seed numbers.
+    seeds_meta = {"seeds_sha256": hashlib.sha256(seeds_p.tobytes()).hexdigest()}
+
+    if resume and checkpoint_path and os.path.exists(checkpoint_path):
+        state = ckpt.load(eng, checkpoint_path, expect_extra=seeds_meta)
+        if np.asarray(state.now).shape[0] != seeds_p.shape[0]:
+            raise ckpt.CheckpointError(
+                f"checkpoint holds {np.asarray(state.now).shape[0]} worlds, "
+                f"sweep expects {seeds_p.shape[0]} (seeds + mesh padding)")
+        state = shard_worlds(state, mesh)
+    else:
+        state = shard_worlds(eng.init(seeds_p, faults=faults_p), mesh)
     runner = sharded_engine(eng, mesh, chunk_steps)
 
     steps = 0
+    chunks = 0
+    saved_at_chunk = -1
     while steps < max_steps:
         state, any_bug, n_active = runner(state)
         steps += chunk_steps
+        chunks += 1
+        if checkpoint_path and checkpoint_every_chunks and \
+                chunks % checkpoint_every_chunks == 0:
+            ckpt.save(eng, state, checkpoint_path, extra_meta=seeds_meta)
+            saved_at_chunk = chunks
         if int(n_active) == 0:
             break
         if stop_on_first_bug and bool(any_bug):
             break
+    if checkpoint_path and saved_at_chunk != chunks:
+        ckpt.save(eng, state, checkpoint_path, extra_meta=seeds_meta)
 
     obs = eng.observe(state)
     obs = {k: v[:n] for k, v in obs.items()}

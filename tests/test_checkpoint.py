@@ -1,0 +1,95 @@
+"""Checkpoint/resume: a split run must be bit-identical to an unbroken one.
+
+The crosscheck-style assertion VERDICT r2 item 9 specifies: save mid-run,
+reload (fresh engine object — nothing shared), continue, compare every
+state leaf bitwise against a run that never stopped.
+"""
+import jax
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import (
+    DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
+    CheckpointError, load_checkpoint, save_checkpoint,
+)
+
+RCFG = RaftDeviceConfig(n=3, n_proposals=2)
+ECFG = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=2_000_000)
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_split_run_bit_identical(tmp_path):
+    path = tmp_path / "ckpt.npz"
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+
+    unbroken = eng.run_steps(eng.init(np.arange(16)), 800)
+
+    half = eng.run_steps(eng.init(np.arange(16)), 400)
+    save_checkpoint(eng, half, path)
+    # Fresh engine object: nothing survives but the file.
+    eng2 = DeviceEngine(RaftActor(RCFG), ECFG)
+    resumed = load_checkpoint(eng2, path)
+    assert _leaves_equal(half, resumed), "load must restore state bitwise"
+    finished = eng2.run_steps(resumed, 400)
+    assert _leaves_equal(unbroken, finished), \
+        "a split run must be bit-identical to an unbroken run"
+
+
+def test_checkpoint_rejects_wrong_config(tmp_path):
+    path = tmp_path / "ckpt.npz"
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    save_checkpoint(eng, eng.init(np.arange(4)), path)
+    other = DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=5, log_cap=16)),
+        EngineConfig(n_nodes=5, outbox_cap=6))
+    with pytest.raises(CheckpointError, match="different engine config"):
+        load_checkpoint(other, path)
+    # Same EngineConfig but different ACTOR config must also be rejected
+    # (same shapes — only the fingerprint can catch it).
+    tweaked = DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=3, n_proposals=2, heartbeat_us=10_000)),
+        ECFG)
+    with pytest.raises(CheckpointError, match="different engine config"):
+        load_checkpoint(tweaked, path)
+
+
+def test_sweep_resume_rejects_different_seeds(tmp_path):
+    from madsim_tpu.parallel.sweep import sweep
+
+    path = str(tmp_path / "sweep.npz")
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    sweep(None, ECFG, np.arange(100, 124), engine=eng, chunk_steps=64,
+          max_steps=64, checkpoint_path=path)
+    with pytest.raises(CheckpointError, match="seeds_sha256"):
+        sweep(None, ECFG, np.arange(24), engine=eng, chunk_steps=64,
+              max_steps=64, checkpoint_path=path, resume=True)
+
+
+def test_sweep_resumes_from_checkpoint(tmp_path):
+    from madsim_tpu.parallel.sweep import sweep
+
+    path = str(tmp_path / "sweep.npz")
+    seeds = np.arange(24)
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    full = sweep(None, ECFG, seeds, engine=eng, chunk_steps=128,
+                 max_steps=4_000)
+
+    # Interrupted sweep: only 2 chunks, checkpointing as it goes.
+    eng2 = DeviceEngine(RaftActor(RCFG), ECFG)
+    partial = sweep(None, ECFG, seeds, engine=eng2, chunk_steps=128,
+                    max_steps=256, checkpoint_path=path,
+                    checkpoint_every_chunks=1)
+    assert partial.steps_run == 256
+    # "Process restart": new engine, resume from disk, run to completion.
+    eng3 = DeviceEngine(RaftActor(RCFG), ECFG)
+    resumed = sweep(None, ECFG, seeds, engine=eng3, chunk_steps=128,
+                    max_steps=4_000, checkpoint_path=path, resume=True)
+
+    for key in full.observations:
+        assert np.array_equal(full.observations[key],
+                              resumed.observations[key]), key
+    assert np.array_equal(full.bug, resumed.bug)
