@@ -157,7 +157,11 @@ class Endpoint:
     async def recv(self, tag: int) -> Any:
         peer = self.peer_addr()
         data, from_addr = await self.recv_from(tag)
-        assert from_addr == peer, "received a message not from the connected address"
+        if from_addr != peer:
+            # A real error, not an assert: must hold under python -O too.
+            raise NetworkError(
+                f"received a message from {from_addr}, expected connected "
+                f"peer {peer}")
         return data
 
     async def send_to_raw(self, dst: Addr, tag: int, data: Any) -> None:

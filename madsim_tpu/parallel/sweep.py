@@ -26,7 +26,7 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 from ..engine.core import DeviceEngine, EngineConfig, WorldState
-from .mesh import WORLD_AXIS, seed_mesh, shard_worlds
+from .mesh import seed_mesh, shard_worlds, world_spec
 
 
 def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
@@ -34,7 +34,8 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
 
     The body is `shard_map`'d so each device advances only its world shard
     (no resharding possible); the two scalar outputs are psum/any reductions
-    over the mesh axis — the only cross-chip communication in a sweep.
+    over ALL mesh axes — ICI within a host, DCN across hosts on a 2-D
+    ``multihost_mesh`` — the only cross-chip communication in a sweep.
 
     Runners are cached per (mesh, chunk_steps) on the engine, so repeated
     sweeps reuse the compiled program instead of paying a fresh XLA compile
@@ -44,14 +45,15 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
     key = (mesh, chunk_steps)
     if key in cache:
         return cache[key]
-    spec = P(WORLD_AXIS)
+    spec = world_spec(mesh)
+    axes = tuple(mesh.axis_names)
 
     def chunk(state: WorldState):
         state = eng._run_steps_impl(state, chunk_steps)
         any_bug = jax.lax.psum(
-            jnp.any(state.bug).astype(jnp.int32), WORLD_AXIS) > 0
+            jnp.any(state.bug).astype(jnp.int32), axes) > 0
         n_active = jax.lax.psum(
-            jnp.sum(state.active.astype(jnp.int32)), WORLD_AXIS)
+            jnp.sum(state.active.astype(jnp.int32)), axes)
         return state, any_bug, n_active
 
     try:  # jax >= 0.8 renamed check_rep -> check_vma

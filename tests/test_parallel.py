@@ -58,3 +58,24 @@ def test_sweep_clean_config_no_bugs():
                 chunk_steps=256)
     assert not res.bug.any()
     assert res.observations["leader_elected"].all()
+
+
+def test_multihost_mesh_matches_flat_mesh():
+    # The DCN scale-out path: a 2-D (dcn=2 hosts x 4 chips) mesh must
+    # produce bit-identical sweeps to the flat 8-chip mesh — worlds are
+    # independent, only the reduction path differs (psum over both axes,
+    # the cross-host hop riding DCN).
+    from madsim_tpu.parallel import multihost_mesh
+
+    mesh2d = multihost_mesh(n_hosts=2)
+    assert mesh2d.devices.shape == (2, 4)
+    assert mesh2d.axis_names == ("dcn", "worlds")
+    clean = RaftDeviceConfig(n=3, n_proposals=1)
+    flat = sweep(RaftActor(clean), ECFG, np.arange(48), mesh=seed_mesh(),
+                 chunk_steps=256)
+    hier = sweep(RaftActor(clean), ECFG, np.arange(48), mesh=mesh2d,
+                 chunk_steps=256)
+    assert np.array_equal(flat.bug, hier.bug)
+    for k in flat.observations:
+        assert np.array_equal(flat.observations[k], hier.observations[k]), k
+    assert not hier.bug.any()
