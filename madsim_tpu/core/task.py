@@ -67,7 +67,8 @@ TaskInfo = NodeInfo  # current_task() yields the Task; node via task.node
 
 
 class Task:
-    __slots__ = ("id", "coro", "node", "join_future", "cancelled", "_scheduled", "_finished")
+    __slots__ = ("id", "coro", "node", "join_future", "cancelled",
+                 "_scheduled", "_finished", "_pending_exc", "wake_epoch")
 
     def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo):
         self.id = task_id
@@ -77,6 +78,13 @@ class Task:
         self.cancelled = False
         self._scheduled = False
         self._finished = False
+        # Interrupt support (aio.timeout scopes): an exception to throw
+        # into the coroutine at its current await instead of resuming it,
+        # plus a wake epoch that invalidates the abandoned await's pending
+        # done-callback (the awaited future itself is never touched — it
+        # may be shared with other waiters).
+        self._pending_exc: Optional[BaseException] = None
+        self.wake_epoch = 0
         node.tasks[self] = None
 
     @property
@@ -206,6 +214,17 @@ class Executor:
     def abort_task(self, task: Task) -> None:
         task.drop()
 
+    def interrupt(self, task: Task, exc: BaseException) -> None:
+        """Deliver ``exc`` at the task's current (or next) await point —
+        the asyncio task-cancellation model: the WAITER is interrupted,
+        the awaited future is untouched (it may be shared). The stale
+        await's wakeup is invalidated via the task's wake epoch."""
+        if task._finished:
+            return
+        task._pending_exc = exc
+        task.wake_epoch += 1
+        self._enqueue(task)
+
     def _enqueue(self, task: Task) -> None:
         if task._scheduled or task._finished:
             return
@@ -290,7 +309,12 @@ class Executor:
 
     def _poll(self, task: Task) -> None:
         try:
-            yielded = task.coro.send(None)
+            exc = task._pending_exc
+            if exc is not None:
+                task._pending_exc = None
+                yielded = task.coro.throw(exc)
+            else:
+                yielded = task.coro.send(None)
         except StopIteration as stop:
             task._finished = True
             task.node.tasks.pop(task, None)
@@ -315,7 +339,10 @@ class Executor:
                 task.join_future.set_exception(err)
                 self._uncaught = err
                 return
-            yielded.add_done_callback(lambda _fut, t=task: self._wake(t))
+            epoch = task.wake_epoch
+            yielded.add_done_callback(
+                lambda _fut, t=task, e=epoch:
+                self._wake(t) if t.wake_epoch == e else None)
 
 
 class Node:

@@ -56,14 +56,24 @@ def sleep(delay: float, result: Any = None):
 class Task:
     """asyncio.Task-flavored wrapper over a simulation JoinHandle."""
 
-    def __init__(self, handle: _task.JoinHandle, fut: SimFuture):
+    def __init__(self, handle: _task.JoinHandle, fut: SimFuture,
+                 coro: Coroutine = None):
         self._handle = handle
         self._fut = fut
+        self._coro = coro
 
     def cancel(self) -> bool:
         if self._fut.done():
             return False
         self._handle.abort()
+        if self._coro is not None:
+            # The guard may never have been polled, in which case aborting
+            # it cannot unwind into the wrapped coroutine — close it
+            # directly so it doesn't leak unawaited.
+            try:
+                self._coro.close()
+            except (RuntimeError, ValueError):
+                pass  # already running or already closed via the guard
         if not self._fut.done():
             self._fut.set_exception(CancelledError())
         return True
@@ -108,7 +118,7 @@ def create_task(coro: Coroutine, *, name: str = None) -> Task:
             if not fut.done():
                 fut.set_exception(exc)
 
-    return Task(_task.spawn(_guard()), fut)
+    return Task(_task.spawn(_guard()), fut, coro)
 
 
 ensure_future = create_task
@@ -138,10 +148,175 @@ async def wait_for(aw: Awaitable, timeout: float) -> Any:
     return await _time.timeout(timeout, aw)
 
 
+FIRST_COMPLETED = "FIRST_COMPLETED"
+FIRST_EXCEPTION = "FIRST_EXCEPTION"
+ALL_COMPLETED = "ALL_COMPLETED"
+
+
+async def wait(aws, *, timeout: float = None, return_when: str = ALL_COMPLETED):
+    """asyncio.wait over sim tasks → (done, pending). The select!/select
+    building block (`madsim-tokio` passes tokio's through)."""
+    tasks = [aw if isinstance(aw, Task) else create_task(aw) for aw in aws]
+    gate = SimFuture()
+
+    def arm(t: Task):
+        def on_done(_f):
+            if gate.done():
+                return
+            if return_when == FIRST_COMPLETED:
+                gate.set_result(None)
+            elif return_when == FIRST_EXCEPTION and (
+                    t._fut._exception is not None):
+                gate.set_result(None)
+            elif all(x.done() for x in tasks):
+                gate.set_result(None)
+
+        t._fut.add_done_callback(on_done)
+
+    for t in tasks:
+        arm(t)
+    if not tasks:
+        return set(), set()
+    try:
+        if timeout is not None:
+            await _time.timeout(timeout, gate)
+        else:
+            await gate
+    except TimeoutError:
+        pass
+    done = {t for t in tasks if t.done()}
+    return done, set(tasks) - done
+
+
+def as_completed(aws, *, timeout: float = None):
+    """asyncio.as_completed: yields awaitables in completion order; each
+    resolves to the task's RESULT (raising its exception), and ``timeout``
+    is one overall deadline across the whole iteration — both per the real
+    asyncio contract, since install() patches this over asyncio."""
+    tasks = [aw if isinstance(aw, Task) else create_task(aw) for aw in aws]
+    ch = _sync.Channel()
+    for t in tasks:
+        t._fut.add_done_callback(lambda _f, t=t: ch.send(t))
+    deadline_ns = (_time.monotonic_ns() + _time.to_ns(timeout)
+                   if timeout is not None else None)
+
+    async def _next():
+        if deadline_ns is None:
+            t = await ch.recv()
+        else:
+            remaining = (deadline_ns - _time.monotonic_ns()) / 1e9
+            if remaining <= 0:
+                raise TimeoutError()
+            t = await _time.timeout(remaining, ch.recv())
+        return t.result()
+
+    return (_next() for _ in tasks)
+
+
 async def shield(aw: Awaitable) -> Any:
     # Cancellation granularity in the sim is the task; a shielded await is
     # just the await (supervisor aborts drop whole tasks, not awaits).
     return await aw
+
+
+class Timeout:
+    """``async with asyncio.timeout(s):`` (3.11+) on virtual time.
+
+    Real asyncio cancels the waiting TASK on expiry (never the awaited
+    object — it may be shared) and converts the cancellation into
+    TimeoutError at scope exit; same here via the executor's interrupt():
+    the deadline timer throws CancelledError into the enclosing task's
+    current await, which unwinds through the existing cancel-safe paths
+    (mailbox requeue, channel restore, ...), and __aexit__ swallows that
+    cancellation into TimeoutError.
+    """
+
+    def __init__(self, delay: float):
+        self._delay = delay
+        self._expired = False
+        self._timer = None
+
+    async def __aenter__(self):
+        task = _context.current_task()
+        executor = _context.current_handle().task
+
+        def expire():
+            self._expired = True
+            executor.interrupt(task, CancelledError("timeout scope expired"))
+
+        self._timer = _context.current_handle().time.add_timer(
+            _time.to_ns(self._delay), expire)
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb):
+        self._timer.cancel()
+        if self._expired and exc_type in (None, CancelledError):
+            raise TimeoutError() from None
+        return False
+
+    def expired(self) -> bool:
+        return self._expired
+
+
+def timeout(delay: float):
+    from ..core.backend import is_real
+
+    if is_real():
+        # Production backend: the real thing exists and is correct.
+        import asyncio as _real_asyncio
+
+        return _real_asyncio.timeout(delay)
+    return Timeout(delay)
+
+
+class TaskGroup:
+    """asyncio.TaskGroup (3.11+) over sim tasks, with the real contract:
+    a body exception cancels all children immediately; a child failure
+    cancels its siblings the moment it happens (not when its turn to be
+    awaited comes — a hung earlier sibling cannot mask it); child failures
+    surface as an ExceptionGroup, exactly like asyncio's."""
+
+    def __init__(self):
+        self._tasks: List[Task] = []
+
+    async def __aenter__(self):
+        return self
+
+    def create_task(self, coro: Coroutine, *, name: str = None) -> Task:
+        t = create_task(coro)
+        self._tasks.append(t)
+        return t
+
+    async def __aexit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            for t in self._tasks:
+                t.cancel()
+        if not self._tasks:
+            return False
+        errors: List[BaseException] = []
+        gate = SimFuture()
+        state = {"left": len(self._tasks)}
+
+        def on_done(t: Task):
+            def cb(_f):
+                state["left"] -= 1
+                child_exc = t._fut._exception
+                if child_exc is not None and not isinstance(
+                        child_exc, (Cancelled, CancelledError)):
+                    errors.append(child_exc)
+                    for other in self._tasks:
+                        other.cancel()
+                if state["left"] == 0 and not gate.done():
+                    gate.set_result(None)
+
+            t._fut.add_done_callback(cb)
+
+        for t in self._tasks:
+            on_done(t)
+        await gate
+        if exc_type is None and errors:
+            raise ExceptionGroup("unhandled errors in a TaskGroup", errors)
+        return False  # the body's own exception propagates
 
 
 def get_event_loop():
@@ -175,6 +350,54 @@ class Event(_sync.Event):
 
 Lock = _sync.Lock
 Semaphore = _sync.Semaphore
+
+
+class Condition:
+    """asyncio.Condition over the sim scheduler."""
+
+    def __init__(self, lock: Lock = None):
+        self._lock = lock if lock is not None else Lock()
+        self._waiters: List[SimFuture] = []
+
+    async def __aenter__(self):
+        await self._lock.acquire()
+        return self
+
+    async def __aexit__(self, *exc):
+        self._lock.release()
+        return False
+
+    async def acquire(self) -> None:
+        await self._lock.acquire()
+
+    def release(self) -> None:
+        self._lock.release()
+
+    async def wait(self) -> bool:
+        fut = SimFuture()
+        self._waiters.append(fut)
+        self._lock.release()
+        try:
+            await fut
+        finally:
+            await self._lock.acquire()
+        return True
+
+    async def wait_for(self, predicate) -> Any:
+        while not (result := predicate()):
+            await self.wait()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        woken = 0
+        while self._waiters and woken < n:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+                woken += 1
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters))
 
 
 # The real asyncio exception classes, so unmodified `except asyncio.QueueEmpty`
@@ -250,8 +473,12 @@ def install() -> None:
 
     patch(_aio, "create_task", passthrough(_aio.create_task, _sim_create_task))
     patch(_aio, "ensure_future", passthrough(_aio.ensure_future, _sim_create_task))
+    patch(_aio, "wait", passthrough(_aio.wait, wait))
+    patch(_aio, "as_completed", passthrough(_aio.as_completed, as_completed))
+    patch(_aio, "timeout", passthrough(_aio.timeout, timeout))
     for name, cls in [("Event", Event), ("Lock", Lock),
-                      ("Semaphore", Semaphore), ("Queue", Queue)]:
+                      ("Semaphore", Semaphore), ("Queue", Queue),
+                      ("Condition", Condition), ("TaskGroup", TaskGroup)]:
         orig_cls = getattr(_aio, name)
         patch(_aio, name, _class_passthrough(orig_cls, cls))
 

@@ -656,3 +656,189 @@ def test_postgres_prepared_txn_under_loss_and_restart():
     b = world(3)
     assert a == b, "chaos run must be seed-deterministic"
     assert len(a) == 6
+
+
+# ---------------------------------------------------------------------------
+# Modern asyncio surface (3.11+): TaskGroup / timeout / wait / as_completed /
+# Condition — what current pip libraries are written against.
+# ---------------------------------------------------------------------------
+
+def test_aio_taskgroup_and_timeout_scope():
+    async def main():
+        order = []
+        async with aio.TaskGroup() as tg:
+            async def worker(i, d):
+                await aio.sleep(d)
+                order.append(i)
+
+            for i, d in enumerate([0.03, 0.01, 0.02]):
+                tg.create_task(worker(i, d))
+        assert order == [1, 2, 0]  # completion order = virtual-time order
+
+        # asyncio.timeout must interrupt a hung await mid-flight.
+        t0 = time.monotonic()
+        try:
+            async with aio.timeout(0.05):
+                await ms.sync.SimFuture()  # never resolves
+            raise AssertionError("expected TimeoutError")
+        except TimeoutError:
+            pass
+        assert 0.04 < time.monotonic() - t0 < 0.2
+
+        # A body that finishes in time passes through untouched.
+        async with aio.timeout(10.0) as scope:
+            await aio.sleep(0.01)
+        assert not scope.expired()
+        return True
+
+    assert ms.run(main(), seed=5)
+
+
+def test_aio_taskgroup_failure_cancels_siblings():
+    async def main():
+        try:
+            async with aio.TaskGroup() as tg:
+                async def doomed():
+                    await aio.sleep(0.01)
+                    raise ValueError("boom")
+
+                async def hung_sibling():
+                    await ms.sync.SimFuture()  # never resolves
+
+                # The hung sibling is created FIRST: its failure to finish
+                # must not mask the later child's error (asyncio reacts to
+                # failures as they happen, not in creation order).
+                tg.create_task(hung_sibling())
+                tg.create_task(doomed())
+            raise AssertionError("expected ExceptionGroup")
+        except ExceptionGroup as eg:  # the real asyncio.TaskGroup contract
+            assert len(eg.exceptions) == 1
+            assert isinstance(eg.exceptions[0], ValueError)
+        return True
+
+    assert ms.run(main(), seed=6, time_limit=30)
+
+
+def test_aio_taskgroup_body_exception_cancels_children():
+    async def main():
+        try:
+            async with aio.TaskGroup() as tg:
+                async def server_loop():
+                    await ms.sync.SimFuture()  # runs forever
+
+                tg.create_task(server_loop())
+                raise ValueError("body failed")
+        except ValueError:
+            pass  # the body's exception, not a hang until time_limit
+        return True
+
+    assert ms.run(main(), seed=16, time_limit=30)
+
+
+def test_aio_timeout_does_not_poison_shared_futures():
+    # Cancelling a timed-out wait must interrupt the WAITER only: the
+    # awaited task keeps running and its result stays intact for others.
+    async def main():
+        async def slow():
+            await aio.sleep(0.2)
+            return "value"
+
+        t = aio.create_task(slow())
+        try:
+            async with aio.timeout(0.05):
+                await t
+            raise AssertionError("expected TimeoutError")
+        except TimeoutError:
+            pass
+        assert not t.done()          # still running, not poisoned
+        assert await t == "value"    # other waiters see the real result
+        return True
+
+    assert ms.run(main(), seed=17, time_limit=30)
+
+
+def test_aio_wait_and_as_completed():
+    async def main():
+        async def v(i, d):
+            await aio.sleep(d)
+            return i
+
+        done, pending = await aio.wait(
+            [v(0, 0.05), v(1, 0.01)], return_when=aio.FIRST_COMPLETED)
+        assert {t.result() for t in done} == {1}
+        assert len(pending) == 1
+        done2, pending2 = await aio.wait(pending)
+        assert not pending2 and {t.result() for t in done2} == {0}
+
+        got = []
+        for nxt in aio.as_completed([v(10, 0.03), v(11, 0.01), v(12, 0.02)]):
+            got.append(await nxt)  # resolves to the RESULT (asyncio contract)
+        assert got == [11, 12, 10]
+
+        # A child exception surfaces at the await point, and the timeout is
+        # one overall deadline across the iteration.
+        async def bad():
+            await aio.sleep(0.01)
+            raise RuntimeError("child failed")
+
+        it = aio.as_completed([bad()], timeout=10.0)
+        with pytest.raises(RuntimeError):
+            await next(iter(it))
+        t0 = time.monotonic()
+        try:
+            for nxt in aio.as_completed(
+                    [v(0, 0.02), v(1, 5.0), v(2, 5.0)], timeout=0.1):
+                await nxt
+            raise AssertionError("expected TimeoutError")
+        except TimeoutError:
+            pass
+        assert time.monotonic() - t0 < 0.2  # one deadline, not per-item
+        return True
+
+    assert ms.run(main(), seed=7)
+
+
+def test_aio_condition():
+    async def main():
+        cond = aio.Condition()
+        items = []
+        got = []
+
+        async def consumer():
+            async with cond:
+                while len(got) < 3:
+                    await cond.wait_for(lambda: bool(items))
+                    got.append(items.pop(0))
+
+        async def producer():
+            for i in range(3):
+                await aio.sleep(0.01)
+                async with cond:
+                    items.append(i)
+                    cond.notify()
+
+        async with aio.TaskGroup() as tg:
+            tg.create_task(consumer())
+            tg.create_task(producer())
+        assert got == [0, 1, 2]
+        return True
+
+    assert ms.run(main(), seed=8, time_limit=30)
+
+
+def test_aio_patched_covers_modern_names():
+    import asyncio as real_asyncio
+
+    async def main():
+        with aio.patched():
+            async with real_asyncio.timeout(1.0):
+                await real_asyncio.sleep(0.01)
+            async with real_asyncio.TaskGroup() as tg:
+                t = tg.create_task(real_asyncio.sleep(0.01, result="x"))
+            assert t.result() == "x"
+            done, _ = await real_asyncio.wait(
+                [real_asyncio.sleep(0.01, result="y")])
+            assert {x.result() for x in done} == {"y"}
+        return True
+
+    assert ms.run(main(), seed=9)
