@@ -351,6 +351,81 @@ class DeviceEngine:
         return self._run(state, max_steps)
 
     # ------------------------------------------------------------------
+    # Single-seed tracing (repro tooling)
+    # ------------------------------------------------------------------
+    def trace(self, seed: int, max_steps: int = 2_000,
+              faults: Optional[np.ndarray] = None) -> List[Dict[str, Any]]:
+        """Replay ONE seed and return its full event trace.
+
+        The device analog of re-running a failing seed with MADSIM_LOG on:
+        feed a seed from ``SweepResult.failing_seeds`` (or
+        ``device_first_failing_seed``) back in and get the ordered list of
+        events — virtual time, kind, src→dst, fault/timer flags, payload,
+        and the step at which the bug flag first rose. Runs as one scan on
+        device; decoding happens on host afterwards.
+        """
+        state = jax.tree.map(lambda x: x[0],
+                             self.init(np.asarray([seed], np.uint64),
+                                       faults=faults))
+
+        def body(s, _):
+            _q, ev, found = pop(s.queue)  # pure peek of what step will pop
+            s2 = self._step_one(s)
+            # Mirror the step's own processing gate: an event popped at or
+            # past t_limit_us was NOT processed and must not appear as one.
+            in_time = jnp.maximum(s.now, ev.time) < jnp.int32(self.cfg.t_limit_us)
+            rec = (found & s.active & in_time, ev.time, ev.kind, ev.flags,
+                   ev.src, ev.dst, ev.payload, s2.bug, s2.now)
+            return s2, rec
+
+        _final, recs = jax.lax.scan(body, state, None, length=max_steps)
+        valid, time_us, kind, flags, src, dst, payload, bug, now_us = \
+            (np.asarray(r) for r in recs)
+        kind_names = getattr(self.actor, "kind_names", None)
+        fault_names = {FAULT_KILL: "kill", FAULT_RESTART: "restart",
+                       FAULT_CLOG_NODE: "clog_node",
+                       FAULT_UNCLOG_NODE: "unclog_node",
+                       FAULT_CLOG_LINK: "clog_link",
+                       FAULT_UNCLOG_LINK: "unclog_link"}
+        out: List[Dict[str, Any]] = []
+        bug_seen = False
+        for i in range(max_steps):
+            raised_here = bool(bug[i]) and not bug_seen
+            if not valid[i]:
+                if raised_here:
+                    # The invariant rose on a step that processed no event
+                    # (e.g. an out-of-time or empty-queue step): record it
+                    # as its own marker so the raise point is never lost.
+                    out.append({"step": i, "t_us": int(now_us[i]),
+                                "kind": "invariant", "timer": False,
+                                "src": -1, "dst": -1, "payload": [],
+                                "bug_raised": True})
+                    bug_seen = True
+                continue
+            is_fault = bool(flags[i] & FLAG_FAULT)
+            k = int(kind[i])
+            if is_fault:
+                name = f"fault:{fault_names.get(k, k)}"
+            elif kind_names is not None and 0 <= k < len(kind_names):
+                name = kind_names[k]
+            else:
+                name = str(k)
+            entry = {
+                "step": i,
+                "t_us": int(time_us[i]),
+                "kind": name,
+                "timer": bool(flags[i] & FLAG_TIMER),
+                "src": int(src[i]),
+                "dst": int(dst[i]),
+                "payload": payload[i].tolist(),
+            }
+            if raised_here:
+                entry["bug_raised"] = True
+                bug_seen = True
+            out.append(entry)
+        return out
+
+    # ------------------------------------------------------------------
     # Observation
     # ------------------------------------------------------------------
     def observe(self, state: WorldState) -> Dict[str, np.ndarray]:

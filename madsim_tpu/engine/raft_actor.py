@@ -96,6 +96,9 @@ class RaftActor:
     """Actor implementing the DeviceEngine protocol for a Raft cluster."""
 
     num_kinds = NUM_KINDS
+    # Event-kind names for DeviceEngine.trace output.
+    kind_names = ["Election", "Heartbeat", "RequestVote", "VoteReply",
+                  "AppendEntries", "AppendReply", "Propose"]
 
     def __init__(self, rcfg: RaftDeviceConfig):
         self.rcfg = rcfg

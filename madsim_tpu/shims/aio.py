@@ -163,10 +163,13 @@ async def wait(aws, *, timeout: float = None, return_when: str = ALL_COMPLETED):
         def on_done(_f):
             if gate.done():
                 return
+            exc = t._fut._exception
+            failed = exc is not None and not isinstance(
+                exc, (Cancelled, CancelledError))
             if return_when == FIRST_COMPLETED:
                 gate.set_result(None)
-            elif return_when == FIRST_EXCEPTION and (
-                    t._fut._exception is not None):
+            elif return_when == FIRST_EXCEPTION and failed:
+                # Cancellations don't count (the asyncio contract).
                 gate.set_result(None)
             elif all(x.done() for x in tasks):
                 gate.set_result(None)
@@ -273,11 +276,17 @@ class TaskGroup:
     """asyncio.TaskGroup (3.11+) over sim tasks, with the real contract:
     a body exception cancels all children immediately; a child failure
     cancels its siblings the moment it happens (not when its turn to be
-    awaited comes — a hung earlier sibling cannot mask it); child failures
-    surface as an ExceptionGroup, exactly like asyncio's."""
+    awaited comes — a hung earlier sibling cannot mask it); children may
+    spawn further children mid-flight (a task handed the group can call
+    create_task, and those are awaited/cancelled too); failures surface as
+    an ExceptionGroup (combined with the body's exception if both fail)."""
 
     def __init__(self):
         self._tasks: List[Task] = []
+        self._errors: List[BaseException] = []
+        self._left = 0
+        self._aborting = False
+        self._gate: SimFuture = None
 
     async def __aenter__(self):
         return self
@@ -285,37 +294,42 @@ class TaskGroup:
     def create_task(self, coro: Coroutine, *, name: str = None) -> Task:
         t = create_task(coro)
         self._tasks.append(t)
+        self._left += 1
+        # Done-callbacks attach at CREATE time, so late children (spawned
+        # from inside running children) are tracked like any other.
+        t._fut.add_done_callback(lambda _f, t=t: self._on_child_done(t))
+        if self._aborting:
+            t.cancel()
         return t
+
+    def _on_child_done(self, t: Task) -> None:
+        self._left -= 1
+        child_exc = t._fut._exception
+        if child_exc is not None and not isinstance(
+                child_exc, (Cancelled, CancelledError)):
+            self._errors.append(child_exc)
+            self._abort()
+        if self._left == 0 and self._gate is not None and not self._gate.done():
+            self._gate.set_result(None)
+
+    def _abort(self) -> None:
+        self._aborting = True
+        for t in self._tasks:
+            t.cancel()
 
     async def __aexit__(self, exc_type, exc, tb):
         if exc_type is not None:
-            for t in self._tasks:
-                t.cancel()
-        if not self._tasks:
-            return False
-        errors: List[BaseException] = []
-        gate = SimFuture()
-        state = {"left": len(self._tasks)}
-
-        def on_done(t: Task):
-            def cb(_f):
-                state["left"] -= 1
-                child_exc = t._fut._exception
-                if child_exc is not None and not isinstance(
-                        child_exc, (Cancelled, CancelledError)):
-                    errors.append(child_exc)
-                    for other in self._tasks:
-                        other.cancel()
-                if state["left"] == 0 and not gate.done():
-                    gate.set_result(None)
-
-            t._fut.add_done_callback(cb)
-
-        for t in self._tasks:
-            on_done(t)
-        await gate
-        if exc_type is None and errors:
-            raise ExceptionGroup("unhandled errors in a TaskGroup", errors)
+            self._abort()
+        self._gate = SimFuture()
+        if self._left == 0:
+            self._gate.set_result(None)
+        await self._gate
+        if self._errors:
+            group = list(self._errors)
+            if exc is not None and not isinstance(
+                    exc, (Cancelled, CancelledError)):
+                group.append(exc)  # both failed: neither may be lost
+            raise ExceptionGroup("unhandled errors in a TaskGroup", group)
         return False  # the body's own exception propagates
 
 
@@ -379,6 +393,15 @@ class Condition:
         self._lock.release()
         try:
             await fut
+        except BaseException:
+            # A cancelled waiter must not swallow a notification: if one
+            # was already delivered to us, hand it to a live waiter; else
+            # deregister so notify() never counts us as woken.
+            if fut in self._waiters:
+                self._waiters.remove(fut)
+            elif fut.done() and fut._exception is None:
+                self.notify(1)
+            raise
         finally:
             await self._lock.acquire()
         return True

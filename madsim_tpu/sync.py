@@ -63,7 +63,9 @@ class Barrier:
 
 
 class Lock:
-    """Async mutex."""
+    """Async mutex. Interrupt-safe: a waiter cancelled mid-acquire (task
+    abort or an aio.timeout scope) unregisters itself, and if the lock was
+    already handed to it, passes it on instead of leaking it."""
 
     def __init__(self):
         self._locked = False
@@ -75,7 +77,17 @@ class Lock:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        await fut
+        try:
+            await fut
+        except BaseException:
+            if fut.done() and fut._exception is None:
+                self.release()  # lock was handed to us as we were cancelled
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
 
     def release(self) -> None:
         while self._waiters:
@@ -105,7 +117,17 @@ class Semaphore:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        await fut
+        try:
+            await fut
+        except BaseException:
+            if fut.done() and fut._exception is None:
+                self.release()  # permit was handed to us: give it back
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
 
     def release(self) -> None:
         while self._waiters:
@@ -151,7 +173,17 @@ class Notify:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        await fut
+        try:
+            await fut
+        except BaseException:
+            if fut.done() and fut._exception is None:
+                self.notify_one()  # consumed notification: pass it on
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
 
 
 class Queue:

@@ -244,3 +244,39 @@ def test_five_node_cluster():
     assert obs["leader_elected"].all()
     assert not obs["bug"].any()
     assert (obs["max_commit"] == 3).all()
+
+
+def test_trace_replays_failing_seed():
+    # The repro loop: sweep finds a failing seed -> trace it -> the trace
+    # shows ordered events with virtual times and the bug-raise point.
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=2_000_000,
+                       stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    obs = eng.observe(eng.run(eng.init(np.arange(64)), 4000))
+    assert obs["bug"].any()
+    failing = int(np.argmax(obs["bug"]))
+
+    trace = eng.trace(failing, max_steps=4000)
+    assert trace, "a failing world has events"
+    times = [e["t_us"] for e in trace]
+    assert times == sorted(times), "events replay in virtual-time order"
+    kinds = {e["kind"] for e in trace}
+    assert "Election" in kinds and "RequestVote" in kinds
+    bug_steps = [e for e in trace if e.get("bug_raised")]
+    assert len(bug_steps) == 1, "exactly one bug-raise point"
+    assert bug_steps[0]["t_us"] == int(obs["bug_time_us"][failing])
+    # Tracing is a pure replay: same seed, same trace.
+    assert eng.trace(failing, max_steps=4000) == trace
+
+
+def test_trace_includes_faults():
+    rcfg = RaftDeviceConfig(n=3)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=1_500_000)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    faults = np.array([[400_000, FAULT_KILL, 1, 0],
+                       [800_000, FAULT_RESTART, 1, 0]], np.int32)
+    trace = eng.trace(7, max_steps=4000, faults=faults)
+    fault_events = [e for e in trace if e["kind"].startswith("fault:")]
+    assert [e["kind"] for e in fault_events] == ["fault:kill", "fault:restart"]
+    assert fault_events[0]["t_us"] == 400_000

@@ -842,3 +842,107 @@ def test_aio_patched_covers_modern_names():
         return True
 
     assert ms.run(main(), seed=9)
+
+
+def test_aio_timeout_does_not_leak_locks_or_notifications():
+    # A waiter cancelled by a timeout scope must not corrupt the primitive.
+    async def main():
+        lock = aio.Lock()
+        await lock.acquire()
+
+        async def blocked_acquirer():
+            try:
+                async with aio.timeout(0.02):
+                    await lock.acquire()
+            except TimeoutError:
+                return "timed_out"
+
+        t = aio.create_task(blocked_acquirer())
+        await aio.sleep(0.05)
+        assert await t == "timed_out"
+        lock.release()
+        await lock.acquire()   # must not deadlock: no leaked handoff
+        lock.release()
+
+        # Condition: a dead waiter must not eat a notification.
+        cond = aio.Condition()
+        got = []
+
+        async def dead_waiter():
+            try:
+                async with aio.timeout(0.02):
+                    async with cond:
+                        await cond.wait()
+            except TimeoutError:
+                pass
+
+        async def live_waiter():
+            async with cond:
+                await cond.wait()
+                got.append("woken")
+
+        aio.create_task(dead_waiter())
+        t2 = aio.create_task(live_waiter())
+        await aio.sleep(0.05)   # dead waiter has timed out by now
+        async with cond:
+            cond.notify(1)      # must reach the LIVE waiter
+        await t2
+        assert got == ["woken"]
+        return True
+
+    assert ms.run(main(), seed=18, time_limit=30)
+
+
+def test_aio_taskgroup_tracks_children_spawned_by_children():
+    async def main():
+        order = []
+
+        async with aio.TaskGroup() as tg:
+            async def grandchild():
+                await aio.sleep(0.02)
+                order.append("grandchild")
+
+            async def child():
+                order.append("child")
+                tg.create_task(grandchild())  # standard asyncio pattern
+
+            tg.create_task(child())
+        # The group must not exit until the late grandchild finished.
+        assert order == ["child", "grandchild"]
+
+        # A late child's failure still surfaces.
+        try:
+            async with aio.TaskGroup() as tg:
+                async def bad_grandchild():
+                    raise RuntimeError("late failure")
+
+                async def spawner():
+                    await aio.sleep(0.01)
+                    tg.create_task(bad_grandchild())
+
+                tg.create_task(spawner())
+            raise AssertionError("expected ExceptionGroup")
+        except ExceptionGroup as eg:
+            assert isinstance(eg.exceptions[0], RuntimeError)
+        return True
+
+    assert ms.run(main(), seed=19, time_limit=30)
+
+
+def test_aio_taskgroup_combines_body_and_child_errors():
+    async def main():
+        try:
+            async with aio.TaskGroup() as tg:
+                async def failing_child():
+                    raise AssertionError("child invariant")
+
+                tg.create_task(failing_child())
+                await aio.sleep(0.01)  # let the child fail first
+                raise ValueError("body failed")
+        except ExceptionGroup as eg:
+            kinds = {type(e) for e in eg.exceptions}
+            assert kinds == {AssertionError, ValueError}
+            return True
+        raise AssertionError("expected ExceptionGroup with both errors")
+
+    assert ms.run(main(), seed=20, time_limit=30)
