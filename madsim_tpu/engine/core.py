@@ -123,6 +123,7 @@ class WorldState(NamedTuple):
     delivered: jnp.ndarray    # int32
     dropped: jnp.ndarray      # int32
     overflow: jnp.ndarray     # bool — event queue overflowed (diagnostic)
+    qmax: jnp.ndarray         # int32 — queue depth high-water mark
     bug: jnp.ndarray          # bool — invariant violation observed
     bug_time: jnp.ndarray     # int32 µs of first bug, INF_TIME if none
 
@@ -214,6 +215,7 @@ class DeviceEngine:
             delivered=jnp.int32(0),
             dropped=jnp.int32(0),
             overflow=overflow,
+            qmax=jnp.sum(q.valid.astype(jnp.int32)),
             bug=jnp.asarray(False),
             bug_time=INF_TIME,
         )
@@ -274,7 +276,8 @@ class DeviceEngine:
                 )
                 q, ok = push(q, ev, enable=ob.valid[m] & ~dropped)
                 overflow = overflow | ~ok
-            return ws._replace(queue=q, rng=rng, overflow=overflow)
+            qmax = jnp.maximum(ws.qmax, jnp.sum(q.valid.astype(jnp.int32)))
+            return ws._replace(queue=q, rng=rng, overflow=overflow, qmax=qmax)
 
         def step(ws: WorldState) -> WorldState:
             q, ev, found = pop(ws.queue)
@@ -371,15 +374,22 @@ class DeviceEngine:
         def body(s, _):
             _q, ev, found = pop(s.queue)  # pure peek of what step will pop
             s2 = self._step_one(s)
-            # Mirror the step's own processing gate: an event popped at or
-            # past t_limit_us was NOT processed and must not appear as one.
+            # Mirror the step's own gates exactly: an event popped at/past
+            # t_limit_us was not processed, and a stale timer or a message
+            # to a dead node was popped-and-dropped, not delivered.
             in_time = jnp.maximum(s.now, ev.time) < jnp.int32(self.cfg.t_limit_us)
+            dst_c = jnp.clip(ev.dst, 0, self.cfg.n_nodes - 1)
+            is_fault = (ev.flags & FLAG_FAULT) != 0
+            stale = ((ev.flags & FLAG_TIMER) != 0) & \
+                (ev.gen != sel(s2.gen, dst_c))
+            dead = ~sel(s2.alive, dst_c)
+            delivered = ~is_fault & ~stale & ~dead
             rec = (found & s.active & in_time, ev.time, ev.kind, ev.flags,
-                   ev.src, ev.dst, ev.payload, s2.bug, s2.now)
+                   ev.src, ev.dst, ev.payload, delivered, s2.bug, s2.now)
             return s2, rec
 
         _final, recs = jax.lax.scan(body, state, None, length=max_steps)
-        valid, time_us, kind, flags, src, dst, payload, bug, now_us = \
+        valid, time_us, kind, flags, src, dst, payload, delivered, bug, now_us = \
             (np.asarray(r) for r in recs)
         kind_names = getattr(self.actor, "kind_names", None)
         fault_names = {FAULT_KILL: "kill", FAULT_RESTART: "restart",
@@ -419,6 +429,10 @@ class DeviceEngine:
                 "dst": int(dst[i]),
                 "payload": payload[i].tolist(),
             }
+            if not is_fault and not delivered[i]:
+                # Popped but NOT handled: stale timer (node generation
+                # changed) or destination dead at delivery time.
+                entry["dropped"] = True
             if raised_here:
                 entry["bug_raised"] = True
                 bug_seen = True
@@ -437,6 +451,7 @@ class DeviceEngine:
             "delivered": state.delivered,
             "dropped": state.dropped,
             "overflow": state.overflow,
+            "qmax": state.qmax,
             "bug": state.bug,
             "bug_time_us": state.bug_time,
             "queue_depth": jax.vmap(

@@ -63,19 +63,33 @@ class Task:
         self._coro = coro
 
     def cancel(self) -> bool:
+        """Request cancellation (asyncio semantics): CancelledError is
+        THROWN INTO the task at its current await, so the task can catch
+        it, run cleanup, and even raise a different error — completion is
+        observed by awaiting the task, not by cancel() returning."""
         if self._fut.done():
             return False
-        self._handle.abort()
-        if self._coro is not None:
-            # The guard may never have been polled, in which case aborting
-            # it cannot unwind into the wrapped coroutine — close it
-            # directly so it doesn't leak unawaited.
+        import inspect as _inspect
+
+        handle = _context.try_current_handle()
+        inner = getattr(self._handle, "_task", None)
+        if self._coro is not None and \
+                _inspect.getcoroutinestate(self._coro) == "CORO_CREATED":
+            # Never started: nothing to unwind and the guard will die
+            # before it can resolve the result future — close the wrapped
+            # coroutine (no unawaited leak) and resolve here.
             try:
                 self._coro.close()
             except (RuntimeError, ValueError):
-                pass  # already running or already closed via the guard
-        if not self._fut.done():
+                pass
             self._fut.set_exception(CancelledError())
+        if handle is not None and inner is not None:
+            handle.task.interrupt(inner, CancelledError())
+        else:
+            # No executor to deliver through (e.g. real backend): abort.
+            self._handle.abort()
+            if not self._fut.done():
+                self._fut.set_exception(CancelledError())
         return True
 
     def done(self) -> bool:
@@ -286,12 +300,23 @@ class TaskGroup:
         self._errors: List[BaseException] = []
         self._left = 0
         self._aborting = False
+        self._exited = False
+        self._in_body = False
+        self._host_interrupted = False
+        self._host = None
         self._gate: SimFuture = None
 
     async def __aenter__(self):
+        self._host = _context.current_task()
+        self._in_body = True
         return self
 
     def create_task(self, coro: Coroutine, *, name: str = None) -> Task:
+        if self._exited:
+            # asyncio's contract: a finished group refuses new children
+            # loudly instead of spawning an unwatched orphan.
+            coro.close()
+            raise RuntimeError("TaskGroup is finished")
         t = create_task(coro)
         self._tasks.append(t)
         self._left += 1
@@ -316,14 +341,41 @@ class TaskGroup:
         self._aborting = True
         for t in self._tasks:
             t.cancel()
+        if self._in_body and self._host is not None:
+            # asyncio cancels the PARENT too: a child failure must tear
+            # down `await serve_forever()` in the body, not hang behind it.
+            self._host_interrupted = True
+            _context.current_handle().task.interrupt(
+                self._host, CancelledError("TaskGroup child failed"))
 
     async def __aexit__(self, exc_type, exc, tb):
+        self._in_body = False
         if exc_type is not None:
-            self._abort()
+            self._abort()  # _in_body is already False: no host interrupt
         self._gate = SimFuture()
         if self._left == 0:
             self._gate.set_result(None)
-        await self._gate
+        externally_cancelled = False
+        while True:
+            try:
+                await self._gate
+                break
+            except CancelledError:
+                if getattr(self, "_host_interrupted", False):
+                    # Exactly one self-induced cancel may land late (our
+                    # own abort interrupt raced the body's exit); absorb it.
+                    self._host_interrupted = False
+                    continue
+                # EXTERNAL cancellation (supervisor / enclosing timeout):
+                # abort the children, keep waiting for them, and let the
+                # cancellation win afterwards (the asyncio contract).
+                externally_cancelled = True
+                self._aborting = True
+                for t in self._tasks:
+                    t.cancel()
+        self._exited = True
+        if externally_cancelled:
+            raise CancelledError()
         if self._errors:
             group = list(self._errors)
             if exc is not None and not isinstance(

@@ -16,6 +16,23 @@ __all__ = ["Event", "Barrier", "Lock", "Semaphore", "Notify", "Queue", "oneshot"
            "Channel", "ChannelClosed", "SimFuture"]
 
 
+async def _await_waiter(fut: SimFuture, waiters, on_handoff) -> None:
+    """Shared interrupt-safe wait protocol for handoff primitives: await a
+    registered waiter future; on cancellation, either pass an already-
+    delivered handoff onward (``on_handoff(fut)``) or deregister."""
+    try:
+        await fut
+    except BaseException:
+        if fut.done() and fut._exception is None:
+            on_handoff(fut)
+        else:
+            try:
+                waiters.remove(fut)
+            except ValueError:
+                pass
+        raise
+
+
 class Event:
     """One-way latch: wait() until set()."""
 
@@ -77,17 +94,8 @@ class Lock:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        try:
-            await fut
-        except BaseException:
-            if fut.done() and fut._exception is None:
-                self.release()  # lock was handed to us as we were cancelled
-            else:
-                try:
-                    self._waiters.remove(fut)
-                except ValueError:
-                    pass
-            raise
+        # On cancellation, a lock already handed to us passes onward.
+        await _await_waiter(fut, self._waiters, lambda _f: self.release())
 
     def release(self) -> None:
         while self._waiters:
@@ -117,17 +125,8 @@ class Semaphore:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        try:
-            await fut
-        except BaseException:
-            if fut.done() and fut._exception is None:
-                self.release()  # permit was handed to us: give it back
-            else:
-                try:
-                    self._waiters.remove(fut)
-                except ValueError:
-                    pass
-            raise
+        # On cancellation, a permit already handed to us is given back.
+        await _await_waiter(fut, self._waiters, lambda _f: self.release())
 
     def release(self) -> None:
         while self._waiters:
@@ -157,7 +156,10 @@ class Notify:
         while self._waiters:
             fut = self._waiters.popleft()
             if not fut.done():
-                fut.set_result(None)
+                # True marks a targeted (notify_one) wakeup: a cancelled
+                # recipient must pass it on. notify_waiters wakeups are
+                # broadcast (False) and mint no permit on cancellation.
+                fut.set_result(True)
                 return
         self._permit = True
 
@@ -165,7 +167,7 @@ class Notify:
         waiters, self._waiters = self._waiters, deque()
         for fut in waiters:
             if not fut.done():
-                fut.set_result(None)
+                fut.set_result(False)
 
     async def notified(self) -> None:
         if self._permit:
@@ -173,17 +175,9 @@ class Notify:
             return
         fut = SimFuture()
         self._waiters.append(fut)
-        try:
-            await fut
-        except BaseException:
-            if fut.done() and fut._exception is None:
-                self.notify_one()  # consumed notification: pass it on
-            else:
-                try:
-                    self._waiters.remove(fut)
-                except ValueError:
-                    pass
-            raise
+        await _await_waiter(
+            fut, self._waiters,
+            lambda f: self.notify_one() if f._result else None)
 
 
 class Queue:

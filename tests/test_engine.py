@@ -280,3 +280,10 @@ def test_trace_includes_faults():
     fault_events = [e for e in trace if e["kind"].startswith("fault:")]
     assert [e["kind"] for e in fault_events] == ["fault:kill", "fault:restart"]
     assert fault_events[0]["t_us"] == 400_000
+    # Events popped for the dead node between kill and restart are marked
+    # dropped, never shown as handled.
+    dead_window = [e for e in trace
+                   if 400_000 < e["t_us"] < 800_000 and e["dst"] == 1
+                   and not e["kind"].startswith("fault:")]
+    assert dead_window, "some traffic addressed the dead node"
+    assert all(e.get("dropped") for e in dead_window)

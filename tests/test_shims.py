@@ -69,6 +69,10 @@ def test_aio_task_cancel():
         t = aio.create_task(worker())
         await aio.sleep(0.055)
         assert t.cancel()
+        # asyncio semantics: cancel() REQUESTS; completion is observed by
+        # awaiting (CancelledError is delivered inside the task).
+        with pytest.raises(aio.CancelledError):
+            await t
         assert t.done() and t.cancelled()
         n = len(hits)
         await aio.sleep(0.05)
@@ -76,6 +80,28 @@ def test_aio_task_cancel():
         return True
 
     assert ms.run(main(), seed=3)
+
+
+def test_aio_task_can_catch_cancellation_for_cleanup():
+    async def main():
+        cleaned = []
+
+        async def worker():
+            try:
+                await aio.sleep(100.0)
+            except aio.CancelledError:
+                cleaned.append(True)   # asyncio cleanup idiom
+                raise
+
+        t = aio.create_task(worker())
+        await aio.sleep(0.01)
+        t.cancel()
+        with pytest.raises(aio.CancelledError):
+            await t
+        assert cleaned == [True]
+        return True
+
+    assert ms.run(main(), seed=4, time_limit=30)
 
 
 # ---------------------------------------------------------------------------
@@ -929,20 +955,122 @@ def test_aio_taskgroup_tracks_children_spawned_by_children():
     assert ms.run(main(), seed=19, time_limit=30)
 
 
-def test_aio_taskgroup_combines_body_and_child_errors():
+def test_aio_taskgroup_child_failure_tears_down_body():
+    # The asyncio contract: a child failure cancels the PARENT's body too,
+    # so `await serve_forever()` in the block does not hang the group.
     async def main():
+        reached_after = []
         try:
             async with aio.TaskGroup() as tg:
                 async def failing_child():
+                    await aio.sleep(0.01)
                     raise AssertionError("child invariant")
 
                 tg.create_task(failing_child())
-                await aio.sleep(0.01)  # let the child fail first
+                await ms.sync.SimFuture()  # serve-forever: must be torn down
+                reached_after.append(True)
+        except ExceptionGroup as eg:
+            assert {type(e) for e in eg.exceptions} == {AssertionError}
+        assert not reached_after
+        return True
+
+    assert ms.run(main(), seed=20, time_limit=30)
+
+
+def test_aio_taskgroup_combines_body_and_child_errors():
+    # Body fails first; a child that errors during the resulting abort
+    # must still surface alongside the body's exception.
+    async def main():
+        try:
+            async with aio.TaskGroup() as tg:
+                async def protests_cancellation():
+                    try:
+                        await aio.sleep(100.0)
+                    except aio.CancelledError:
+                        raise RuntimeError("cleanup failed") from None
+
+                tg.create_task(protests_cancellation())
+                await aio.sleep(0.01)
                 raise ValueError("body failed")
         except ExceptionGroup as eg:
-            kinds = {type(e) for e in eg.exceptions}
-            assert kinds == {AssertionError, ValueError}
+            assert {type(e) for e in eg.exceptions} == {RuntimeError, ValueError}
             return True
         raise AssertionError("expected ExceptionGroup with both errors")
 
-    assert ms.run(main(), seed=20, time_limit=30)
+    assert ms.run(main(), seed=21, time_limit=30)
+
+
+def test_aio_taskgroup_refuses_new_children_after_exit():
+    async def main():
+        async with aio.TaskGroup() as tg:
+            tg.create_task(aio.sleep(0.01))
+        with pytest.raises(RuntimeError, match="finished"):
+            tg.create_task(aio.sleep(0.01))
+        return True
+
+    assert ms.run(main(), seed=22)
+
+
+def test_aio_taskgroup_external_cancel_wins():
+    # Cancelling the task hosting a group cancels the children and the
+    # cancellation propagates (not swallowed, not orphaning children).
+    async def main():
+        child_cancelled = []
+
+        async def host():
+            async with aio.TaskGroup() as tg:
+                async def child():
+                    try:
+                        await aio.sleep(100.0)
+                    except aio.CancelledError:
+                        child_cancelled.append(True)
+                        raise
+
+                tg.create_task(child())
+                await aio.sleep(50.0)
+
+        t = aio.create_task(host())
+        await aio.sleep(0.05)
+        t.cancel()
+        with pytest.raises(aio.CancelledError):
+            await t
+        assert child_cancelled == [True], "children must not be orphaned"
+        return True
+
+    assert ms.run(main(), seed=23, time_limit=30)
+
+
+def test_notify_waiters_cancel_mints_no_phantom_permit():
+    # A broadcast (notify_waiters) wakeup consumed by a cancelled waiter
+    # must NOT convert into a stored permit (tokio::sync::Notify rule).
+    async def main():
+        notify = ms.sync.Notify()
+
+        async def waiter_cancelled_late():
+            async with aio.timeout(0.05):
+                await notify.notified()
+
+        t = aio.create_task(waiter_cancelled_late())
+        await aio.sleep(0.01)
+        # Resolve the waiter via broadcast, but interrupt it in the same
+        # virtual instant window before it resumes.
+        t.cancel()
+        notify.notify_waiters()
+        with pytest.raises(aio.CancelledError):
+            await t
+        # No permit may exist: a fresh notified() must BLOCK.
+        blocked = []
+
+        async def fresh():
+            await notify.notified()
+            blocked.append("woke")
+
+        aio.create_task(fresh())
+        await aio.sleep(0.05)
+        assert blocked == [], "phantom permit: notified() returned unsignalled"
+        notify.notify_one()
+        await aio.sleep(0.01)
+        assert blocked == ["woke"]
+        return True
+
+    assert ms.run(main(), seed=24, time_limit=30)
