@@ -8,6 +8,9 @@ configs mirror its harness definitions:
 
   1. rpc_pingpong       2-node RPC ping-pong, single seed, host engine
                         (`madsim/benches/rpc.rs:11-26`)
+  1b. rpc_real          the same ping-pong on the production backend over
+                        real loopback TCP — the transport the reference's
+                        criterion bench actually measures
   2. madraft_3node      3-node leader election, W seeds vmapped (headline)
   3. grpc_chaos         gRPC echo under partition chaos
                         (`tonic-example/src/server.rs:281-332`)
@@ -34,6 +37,16 @@ import numpy as np
 SIM_SECONDS = 1.0  # virtual seconds of Raft per seed (headline config)
 
 
+class BenchPing:
+    """RPC request type for the ping-pong configs. Module-level because the
+    real backend pickles payloads onto the wire (std-mode bincode analog)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self, n):
+        self.n = n
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -49,11 +62,7 @@ def bench_rpc_pingpong(n_rounds: int) -> dict:
     from madsim_tpu.net import Endpoint, rpc
     from madsim_tpu import time as simtime
 
-    class Ping:
-        __slots__ = ("n",)
-
-        def __init__(self, n):
-            self.n = n
+    Ping = BenchPing
 
     def world(payload: bytes, rounds: int):
         rt = ms.Runtime(seed=1)
@@ -117,6 +126,57 @@ def bench_rpc_pingpong(n_rounds: int) -> dict:
     out["payload_mb_per_sec"] = rates
     log(f"rpc_pingpong: {out}")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Config 1b: the same RPC ping-pong on the PRODUCTION backend — direct
+# parity with the reference's criterion bench, which measures the std TCP
+# transport over loopback (`madsim/benches/rpc.rs:11-56`).
+# ---------------------------------------------------------------------------
+
+def bench_rpc_real(n_rounds: int) -> dict:
+    import os
+
+    prior_backend = os.environ.get("MADSIM_BACKEND")
+    os.environ["MADSIM_BACKEND"] = "real"
+    try:
+        import madsim_tpu as ms
+        from madsim_tpu.net import Endpoint, rpc
+
+        async def world(payload: bytes, rounds: int) -> float:
+            server = await Endpoint.bind("127.0.0.1:0")
+
+            async def handle(req, data):
+                return BenchPing(req.n + 1), data
+
+            rpc.add_rpc_handler_with_data(server, BenchPing, handle)
+            client = await Endpoint.bind("127.0.0.1:0")
+            addr = server.local_addr()
+            t0 = walltime.perf_counter()
+            for i in range(rounds):
+                await rpc.call_with_data(client, addr, BenchPing(i),
+                                         payload, timeout=10.0)
+            dt = walltime.perf_counter() - t0
+            client.close()
+            server.close()
+            return dt
+
+        dt = ms.run(world(b"", n_rounds))
+        out = {"empty_rpc_roundtrips_per_sec": round(n_rounds / dt, 2),
+               "empty_rpc_latency_us": round(dt / n_rounds * 1e6, 1)}
+        rates = {}
+        data_rounds = max(16, n_rounds // 8)
+        for size in (16, 256, 4096, 65536, 1 << 20):
+            dt = ms.run(world(b"\xab" * size, data_rounds))
+            rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
+        out["payload_mb_per_sec"] = rates
+        log(f"rpc_real (production TCP backend): {out}")
+        return out
+    finally:
+        if prior_backend is None:
+            os.environ.pop("MADSIM_BACKEND", None)
+        else:
+            os.environ["MADSIM_BACKEND"] = prior_backend
 
 
 # ---------------------------------------------------------------------------
@@ -583,6 +643,8 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
 _CONFIGS = [
     ("rpc", "rpc_pingpong",
      lambda a: bench_rpc_pingpong(64 if a.smoke else 1_000)),
+    ("rpc_real", "rpc_real",
+     lambda a: bench_rpc_real(256 if a.smoke else 2_000)),
     ("grpc", "grpc_chaos",
      lambda a: bench_grpc_chaos(n_clients=2 if a.smoke else 5,
                                 sim_seconds=2.0 if a.smoke else 10.0)),
@@ -674,8 +736,8 @@ def main() -> None:
     ap.add_argument("--worlds", type=int, default=None)
     ap.add_argument("--host-seeds", type=int, default=None)
     ap.add_argument("--only", type=str, default=None,
-                    help="comma list: 3node,rpc,grpc,postgres,5node,"
-                         "crosscheck,bug (3node = the headline)")
+                    help="comma list: 3node,rpc,rpc_real,grpc,postgres,"
+                         "5node,crosscheck,bug (3node = the headline)")
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "
                          "failure isolation keeps the headline alive")

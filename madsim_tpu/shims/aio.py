@@ -307,7 +307,10 @@ class TaskGroup:
         self._gate: SimFuture = None
 
     async def __aenter__(self):
-        self._host = _context.current_task()
+        # None on the real backend (no sim executor): the group still
+        # works there via the SimFuture asyncio bridge, minus the
+        # host-interrupt fast path.
+        self._host = _context.try_current_task()
         self._in_body = True
         return self
 
@@ -338,6 +341,11 @@ class TaskGroup:
             self._gate.set_result(None)
 
     def _abort(self) -> None:
+        if self._aborting:
+            # Idempotent: a second failing child must not throw extra
+            # CancelledErrors into siblings already mid-cleanup (new tasks
+            # created while aborting are cancelled by create_task).
+            return
         self._aborting = True
         for t in self._tasks:
             t.cancel()
@@ -350,8 +358,14 @@ class TaskGroup:
 
     async def __aexit__(self, exc_type, exc, tb):
         self._in_body = False
+        if exc_type is not None and issubclass(exc_type, CancelledError) \
+                and self._host_interrupted:
+            # The body exited ON our own abort interrupt: the flag is
+            # consumed here, so a later CancelledError at the gate is a
+            # genuine external one.
+            self._host_interrupted = False
         if exc_type is not None:
-            self._abort()  # _in_body is already False: no host interrupt
+            self._abort()
         self._gate = SimFuture()
         if self._left == 0:
             self._gate.set_result(None)
@@ -361,27 +375,28 @@ class TaskGroup:
                 await self._gate
                 break
             except CancelledError:
-                if getattr(self, "_host_interrupted", False):
+                if self._host_interrupted:
                     # Exactly one self-induced cancel may land late (our
                     # own abort interrupt raced the body's exit); absorb it.
                     self._host_interrupted = False
                     continue
                 # EXTERNAL cancellation (supervisor / enclosing timeout):
-                # abort the children, keep waiting for them, and let the
-                # cancellation win afterwards (the asyncio contract).
+                # abort the children and keep waiting for them.
                 externally_cancelled = True
                 self._aborting = True
                 for t in self._tasks:
                     t.cancel()
         self._exited = True
-        if externally_cancelled:
-            raise CancelledError()
         if self._errors:
+            # Child errors take precedence over a cancellation (asyncio:
+            # the cancellation propagates only when there are no errors).
             group = list(self._errors)
             if exc is not None and not isinstance(
                     exc, (Cancelled, CancelledError)):
                 group.append(exc)  # both failed: neither may be lost
             raise ExceptionGroup("unhandled errors in a TaskGroup", group)
+        if externally_cancelled:
+            raise CancelledError()
         return False  # the body's own exception propagates
 
 
@@ -444,16 +459,10 @@ class Condition:
         self._waiters.append(fut)
         self._lock.release()
         try:
-            await fut
-        except BaseException:
-            # A cancelled waiter must not swallow a notification: if one
-            # was already delivered to us, hand it to a live waiter; else
-            # deregister so notify() never counts us as woken.
-            if fut in self._waiters:
-                self._waiters.remove(fut)
-            elif fut.done() and fut._exception is None:
-                self.notify(1)
-            raise
+            # Shared interrupt-safe protocol: a delivered notification is
+            # handed to a live waiter; a pending one deregisters.
+            await _sync._await_waiter(fut, self._waiters,
+                                      lambda _f: self.notify(1))
         finally:
             await self._lock.acquire()
         return True

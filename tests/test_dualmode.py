@@ -129,6 +129,19 @@ async def primitives_world():
         raise AssertionError("expected TimeoutError")
     except TimeoutError:
         pass
+    # TaskGroup works on both backends (real mode: no sim executor).
+    from madsim_tpu.shims import aio
+
+    order = []
+
+    async with aio.TaskGroup() as tg:
+        async def member(i, d):
+            await mtime.sleep(d)
+            order.append(i)
+
+        tg.create_task(member(0, 0.02))
+        tg.create_task(member(1, 0.01))
+    assert sorted(order) == [0, 1]
     return True
 
 
