@@ -35,6 +35,9 @@ import time as walltime
 import numpy as np
 
 SIM_SECONDS = 1.0  # virtual seconds of Raft per seed (headline config)
+# Payload sweep mirroring `benches/rpc.rs:28-54`, shared by the sim and
+# production RPC configs so their curves stay directly comparable.
+PAYLOAD_SIZES = (16, 256, 4096, 65536, 1 << 20)
 
 
 class BenchPing:
@@ -114,10 +117,9 @@ def bench_rpc_pingpong(n_rounds: int) -> dict:
     out = {"empty_rpc_roundtrips_per_sec": round(n_rounds / dt, 2),
            "virtual_latency_ms": round(virt / n_rounds * 1e3, 3)}
 
-    sizes = [16, 256, 4096, 65536, 1 << 20]
     data_rounds = max(16, n_rounds // 8)
     rates = {}
-    for size in sizes:
+    for size in PAYLOAD_SIZES:
         payload = b"\xab" * size
         t0 = walltime.perf_counter()
         world(payload, data_rounds)
@@ -166,7 +168,7 @@ def bench_rpc_real(n_rounds: int) -> dict:
                "empty_rpc_latency_us": round(dt / n_rounds * 1e6, 1)}
         rates = {}
         data_rounds = max(16, n_rounds // 8)
-        for size in (16, 256, 4096, 65536, 1 << 20):
+        for size in PAYLOAD_SIZES:
             dt = ms.run(world(b"\xab" * size, data_rounds))
             rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
         out["payload_mb_per_sec"] = rates

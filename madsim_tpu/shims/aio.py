@@ -37,6 +37,12 @@ from ..core.futures import Cancelled, SimFuture
 
 TimeoutError = builtins.TimeoutError  # asyncio.TimeoutError is this since 3.11
 CancelledError = Cancelled
+# In real mode awaits bridge through asyncio, whose CancelledError is the
+# stdlib BaseException one — cancellation-aware except clauses must catch
+# both families.
+import asyncio as _stdlib_asyncio_early  # noqa: E402
+
+CANCELLED_TYPES = (Cancelled, _stdlib_asyncio_early.CancelledError)
 
 
 # ---------------------------------------------------------------------------
@@ -358,7 +364,7 @@ class TaskGroup:
 
     async def __aexit__(self, exc_type, exc, tb):
         self._in_body = False
-        if exc_type is not None and issubclass(exc_type, CancelledError) \
+        if exc_type is not None and issubclass(exc_type, CANCELLED_TYPES) \
                 and self._host_interrupted:
             # The body exited ON our own abort interrupt: the flag is
             # consumed here, so a later CancelledError at the gate is a
@@ -374,7 +380,7 @@ class TaskGroup:
             try:
                 await self._gate
                 break
-            except CancelledError:
+            except CANCELLED_TYPES:
                 if self._host_interrupted:
                     # Exactly one self-induced cancel may land late (our
                     # own abort interrupt raced the body's exit); absorb it.

@@ -10,6 +10,7 @@ asyncio + framed TCP over real loopback sockets,
 """
 import dataclasses
 import os
+from pathlib import Path
 
 import pytest
 
@@ -198,6 +199,78 @@ def test_real_mode_is_not_deterministic_and_sim_is(monkeypatch):
     c = ms.run(draws(), seed=7)
     d = ms.run(draws(), seed=7)
     assert c != d
+
+
+def test_real_mode_cross_process_rpc(monkeypatch, tmp_path):
+    # The production deployment shape: server and client in SEPARATE OS
+    # processes over real TCP — same facade code as the sim worlds above.
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    server_src = textwrap.dedent("""
+        import dataclasses, os, sys
+        sys.path.insert(0, %r)
+        os.environ["MADSIM_BACKEND"] = "real"
+        import madsim_tpu as ms
+        from madsim_tpu.net import Endpoint, rpc
+
+        @dataclasses.dataclass
+        class Add:
+            a: int
+            b: int
+        Add.__module__ = "__main__"; Add.__qualname__ = "Add"
+
+        async def main():
+            ep = await Endpoint.bind("127.0.0.1:0")
+            async def add(req):
+                return req.a + req.b
+            rpc.add_rpc_handler(ep, Add, add)
+            print(f"PORT {ep.local_addr()[1]}", flush=True)
+            await ms.time.sleep(30)
+
+        ms.run(main())
+    """) % str(Path(__file__).resolve().parent.parent)
+
+    proc = subprocess.Popen([_sys.executable, "-c", server_src],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), f"server failed: {line!r}"
+        port = int(line.split()[1])
+        monkeypatch.setenv("MADSIM_BACKEND", "real")
+
+        # The client's Add must pickle to the same path as the server's.
+        import __main__ as main_mod
+
+        @dataclasses.dataclass
+        class Add:
+            a: int
+            b: int
+
+        Add.__module__ = "__main__"
+        Add.__qualname__ = "Add"
+        had = getattr(main_mod, "Add", None)
+        main_mod.Add = Add
+        try:
+            async def client():
+                ep = await Endpoint.bind("127.0.0.1:0")
+                total = 0
+                for i in range(20):
+                    total += await rpc.call(ep, f"127.0.0.1:{port}",
+                                            Add(i, i), timeout=5.0)
+                ep.close()
+                return total
+
+            assert ms.run(client()) == 2 * sum(range(20))
+        finally:
+            if had is None:
+                delattr(main_mod, "Add")
+            else:
+                main_mod.Add = had
+    finally:
+        proc.kill()
+        proc.wait()
 
 
 def test_sim_wins_inside_runtime(monkeypatch):
