@@ -37,6 +37,12 @@ class TcpListener:
 
     @staticmethod
     async def bind(addr: AddrLike) -> "TcpListener":
+        from ..core.backend import is_real
+
+        if is_real():
+            from ..real.tcp import RealTcpListener
+
+            return await RealTcpListener.bind(addr)
         socket = _ListenerSocket()
         guard = await BindGuard.bind(addr, IpProtocol.TCP, socket)
         return TcpListener(guard, socket)
@@ -80,6 +86,12 @@ class TcpStream:
 
     @staticmethod
     async def connect(addr: AddrLike) -> "TcpStream":
+        from ..core.backend import is_real
+
+        if is_real():
+            from ..real.tcp import RealTcpStream
+
+            return await RealTcpStream.connect(addr)
         net = _netsim()
         guard = await BindGuard.bind("0.0.0.0:0", IpProtocol.TCP, Socket())
         from .addr import lookup_host

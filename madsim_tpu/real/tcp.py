@@ -1,0 +1,136 @@
+"""Real-TCP twins of the sim TcpListener/TcpStream.
+
+The madsim-tokio model for ``net``: outside a simulation the TCP types are
+the real thing (`madsim-tokio/src/lib.rs:32-38` re-exports tokio::net) —
+here the same bind/accept/connect/read/write_all surface runs over asyncio
+streams, so byte-stream code written against :mod:`madsim_tpu.net.tcp`
+deploys unchanged with ``MADSIM_BACKEND=real``.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+from ..net.addr import Addr, AddrLike
+from ..net.network import ConnectionReset
+from .net import real_lookup
+
+
+class RealTcpListener:
+    def __init__(self):
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._queue: "asyncio.Queue" = None
+        self._addr: Optional[Addr] = None
+
+    @staticmethod
+    async def bind(addr: AddrLike) -> "RealTcpListener":
+        host, port = await real_lookup(addr)
+        lst = RealTcpListener()
+        lst._queue = asyncio.Queue()
+
+        async def on_accept(reader, writer):
+            await lst._queue.put((reader, writer))
+
+        lst._server = await asyncio.start_server(on_accept, host, port)
+        ip, bound_port = lst._server.sockets[0].getsockname()[:2]
+        lst._addr = (ip, bound_port)
+        return lst
+
+    def local_addr(self) -> Addr:
+        return self._addr
+
+    async def accept(self) -> Tuple["RealTcpStream", Addr]:
+        if self._server is None:
+            raise ConnectionReset("listener closed")
+        item = await self._queue.get()
+        if item is None:
+            # close() sentinel: re-enqueue so every pending/later accept
+            # unwinds too (the sim twin's ChannelClosed contract).
+            self._queue.put_nowait(None)
+            raise ConnectionReset("listener closed")
+        reader, writer = item
+        peer = writer.get_extra_info("peername")[:2]
+        local = writer.get_extra_info("sockname")[:2]
+        return RealTcpStream(reader, writer, tuple(local), tuple(peer)), \
+            tuple(peer)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+            # Wake blocked accepts (matching the sim listener, whose close
+            # fails the accept with ConnectionReset).
+            self._queue.put_nowait(None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class RealTcpStream:
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, local: Addr, peer: Addr):
+        self._reader = reader
+        self._writer = writer
+        self._local = local
+        self._peer = peer
+        self._write_buf = bytearray()
+
+    @staticmethod
+    async def connect(addr: AddrLike) -> "RealTcpStream":
+        host, port = await real_lookup(addr)
+        reader, writer = await asyncio.open_connection(host, port)
+        local = tuple(writer.get_extra_info("sockname")[:2])
+        return RealTcpStream(reader, writer, local, (host, port))
+
+    def local_addr(self) -> Addr:
+        return self._local
+
+    def peer_addr(self) -> Addr:
+        return self._peer
+
+    # -- reading (sim TcpStream surface) -----------------------------------
+    async def read(self, max_bytes: int = 65536) -> bytes:
+        try:
+            return await self._reader.read(max_bytes)
+        except (ConnectionError, OSError) as exc:
+            raise ConnectionReset(str(exc)) from exc
+
+    async def read_exact(self, n: int) -> bytes:
+        try:
+            return await self._reader.readexactly(n)
+        except asyncio.IncompleteReadError as exc:
+            raise ConnectionReset("unexpected EOF") from exc
+        except (ConnectionError, OSError) as exc:
+            raise ConnectionReset(str(exc)) from exc
+
+    # -- writing -----------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        self._write_buf.extend(data)
+
+    async def flush(self) -> None:
+        if self._write_buf:
+            payload, self._write_buf = bytes(self._write_buf), bytearray()
+            try:
+                self._writer.write(payload)
+                await self._writer.drain()
+            except (ConnectionError, OSError) as exc:
+                raise ConnectionReset(str(exc)) from exc
+
+    async def write_all(self, data: bytes) -> None:
+        self.write(data)
+        await self.flush()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

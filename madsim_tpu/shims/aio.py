@@ -131,7 +131,7 @@ def create_task(coro: Coroutine, *, name: str = None) -> Task:
             fut.set_result(await coro)
         except GeneratorExit:
             raise  # task abort: let close() unwind; cancel() sets the future
-        except Cancelled:
+        except CANCELLED_TYPES:
             if not fut.done():
                 fut.set_exception(CancelledError())
         except BaseException as exc:  # noqa: BLE001 — contained, like asyncio
@@ -184,8 +184,7 @@ async def wait(aws, *, timeout: float = None, return_when: str = ALL_COMPLETED):
             if gate.done():
                 return
             exc = t._fut._exception
-            failed = exc is not None and not isinstance(
-                exc, (Cancelled, CancelledError))
+            failed = exc is not None and not isinstance(exc, CANCELLED_TYPES)
             if return_when == FIRST_COMPLETED:
                 gate.set_result(None)
             elif return_when == FIRST_EXCEPTION and failed:
@@ -339,8 +338,8 @@ class TaskGroup:
     def _on_child_done(self, t: Task) -> None:
         self._left -= 1
         child_exc = t._fut._exception
-        if child_exc is not None and not isinstance(
-                child_exc, (Cancelled, CancelledError)):
+        if child_exc is not None and not isinstance(child_exc,
+                                                    CANCELLED_TYPES):
             self._errors.append(child_exc)
             self._abort()
         if self._left == 0 and self._gate is not None and not self._gate.done():
@@ -375,12 +374,12 @@ class TaskGroup:
         self._gate = SimFuture()
         if self._left == 0:
             self._gate.set_result(None)
-        externally_cancelled = False
+        external_cancel: "BaseException | None" = None
         while True:
             try:
                 await self._gate
                 break
-            except CANCELLED_TYPES:
+            except CANCELLED_TYPES as cancel_exc:
                 if self._host_interrupted:
                     # Exactly one self-induced cancel may land late (our
                     # own abort interrupt raced the body's exit); absorb it.
@@ -388,7 +387,7 @@ class TaskGroup:
                     continue
                 # EXTERNAL cancellation (supervisor / enclosing timeout):
                 # abort the children and keep waiting for them.
-                externally_cancelled = True
+                external_cancel = cancel_exc
                 self._aborting = True
                 for t in self._tasks:
                     t.cancel()
@@ -397,12 +396,13 @@ class TaskGroup:
             # Child errors take precedence over a cancellation (asyncio:
             # the cancellation propagates only when there are no errors).
             group = list(self._errors)
-            if exc is not None and not isinstance(
-                    exc, (Cancelled, CancelledError)):
+            if exc is not None and not isinstance(exc, CANCELLED_TYPES):
                 group.append(exc)  # both failed: neither may be lost
             raise ExceptionGroup("unhandled errors in a TaskGroup", group)
-        if externally_cancelled:
-            raise CancelledError()
+        if external_cancel is not None:
+            # Preserve the cancellation family: real-mode asyncio
+            # cancellation must stay convertible by asyncio.timeout.
+            raise external_cancel
         return False  # the body's own exception propagates
 
 
