@@ -22,8 +22,13 @@ from collections import deque
 from typing import Any, Callable, Deque, List, Optional
 
 
-class Cancelled(Exception):
-    """Raised when awaiting a future that was cancelled / a closed channel."""
+class Cancelled(BaseException):
+    """Raised when awaiting a future that was cancelled / a closed channel.
+
+    A BaseException subclass for the same reason asyncio.CancelledError is
+    (bpo-32528): unmodified code's broad ``except Exception:`` retry loops
+    must not be able to swallow cancellation, or timeout scopes and task
+    aborts could never tear such code down."""
 
 
 _PENDING = object()

@@ -42,8 +42,21 @@ def save(engine, state, path: Union[str, Path],
          extra_meta: Optional[Dict[str, str]] = None) -> None:
     """Write a WorldState (any world count) to ``path`` (npz), atomically:
     a preemption mid-write must never destroy the previous checkpoint, so
-    the bytes land in a temp file that os.replace()s onto ``path``."""
+    the bytes land in a temp file that os.replace()s onto ``path``.
+
+    Scope: single-process (all shards addressable from this host) — any
+    mesh within one process, including the virtual multihost one. Real
+    multi-process checkpointing needs per-host shard files (an orbax-style
+    layout); rather than crash mid-save inside np.savez, that case is
+    rejected up front."""
     leaves = jax.tree.leaves(state)
+    for leaf in leaves:
+        if hasattr(leaf, "is_fully_addressable") and \
+                not leaf.is_fully_addressable:
+            raise CheckpointError(
+                "state is sharded across processes: single-file "
+                "checkpointing needs all shards addressable from this "
+                "host (gather first, or checkpoint per-process)")
     arrays = {f"leaf_{i:05d}": np.asarray(leaf)
               for i, leaf in enumerate(leaves)}
     meta = {

@@ -127,10 +127,15 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     import hashlib
     import os
 
-    # Seed identity travels with the checkpoint: resuming under different
-    # seeds would silently attribute results (repro banners!) to the wrong
-    # seed numbers.
-    seeds_meta = {"seeds_sha256": hashlib.sha256(seeds_p.tobytes()).hexdigest()}
+    # World identity travels with the checkpoint: resuming under different
+    # seeds OR fault schedules would silently attribute results (repro
+    # banners!) to inputs that never produced them.
+    faults_key = (np.ascontiguousarray(faults_p).tobytes()
+                  if faults_p is not None else b"none")
+    seeds_meta = {
+        "seeds_sha256": hashlib.sha256(seeds_p.tobytes()).hexdigest(),
+        "faults_sha256": hashlib.sha256(faults_key).hexdigest(),
+    }
 
     if resume and checkpoint_path and os.path.exists(checkpoint_path):
         state = ckpt.load(eng, checkpoint_path, expect_extra=seeds_meta)

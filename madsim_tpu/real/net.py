@@ -191,9 +191,17 @@ class RealEndpoint:
                 NetworkError, ValueError):
             writer.close()
             return
+        prev = self._conns.get(peer)
+        if prev is not None and not prev.done():
+            # Simultaneous connect: our own outbound connect to this peer
+            # is mid-handshake. Don't displace its pending future (waiters
+            # already hold it — overwriting would split senders across two
+            # sockets and orphan one); this inbound socket still gets a
+            # reader so the peer's traffic is received.
+            self._spawn_reader(reader, writer, peer)
+            return
         fut = asyncio.get_running_loop().create_future()
         fut.set_result(_Conn(writer))
-        prev = self._conns.get(peer)
         self._conns[peer] = fut
         if prev is not None and prev.done() and prev.exception() is None:
             # A stale duplicate connection loses to the fresh one

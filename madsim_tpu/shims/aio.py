@@ -79,7 +79,9 @@ class Task:
 
         handle = _context.try_current_handle()
         inner = getattr(self._handle, "_task", None)
-        if self._coro is not None and \
+        # ensure_future accepts non-coroutine awaitables (SimFuture etc.);
+        # only real coroutines have inspectable start state.
+        if self._coro is not None and _inspect.iscoroutine(self._coro) and \
                 _inspect.getcoroutinestate(self._coro) == "CORO_CREATED":
             # Never started: nothing to unwind and the guard will die
             # before it can resolve the result future — close the wrapped
@@ -272,7 +274,8 @@ class Timeout:
 
     async def __aexit__(self, exc_type, exc, tb):
         self._timer.cancel()
-        if self._expired and exc_type in (None, CancelledError):
+        if self._expired and (exc_type is None
+                              or issubclass(exc_type, CANCELLED_TYPES)):
             raise TimeoutError() from None
         return False
 
@@ -461,14 +464,18 @@ class Condition:
         self._lock.release()
 
     async def wait(self) -> bool:
+        if not self._lock._locked:
+            raise RuntimeError("cannot wait on un-acquired lock")
         fut = SimFuture()
         self._waiters.append(fut)
         self._lock.release()
         try:
             # Shared interrupt-safe protocol: a delivered notification is
-            # handed to a live waiter; a pending one deregisters.
+            # handed to a live waiter; a pending one deregisters. The
+            # handoff uses the internal path — the cancelled waiter does
+            # not hold the lock here.
             await _sync._await_waiter(fut, self._waiters,
-                                      lambda _f: self.notify(1))
+                                      lambda _f: self._notify(1))
         finally:
             await self._lock.acquire()
         return True
@@ -479,6 +486,11 @@ class Condition:
         return result
 
     def notify(self, n: int = 1) -> None:
+        if not self._lock._locked:
+            raise RuntimeError("cannot notify on un-acquired lock")
+        self._notify(n)
+
+    def _notify(self, n: int) -> None:
         woken = 0
         while self._waiters and woken < n:
             fut = self._waiters.pop(0)

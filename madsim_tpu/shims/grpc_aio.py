@@ -114,6 +114,7 @@ class SimAioServer:
         self._ep: Optional[Endpoint] = None
         self._accept_task = None
         self._stopped = None
+        self._in_flight: list = []  # live _handle_conn tasks (for drain)
 
     # -- registration (both grpcio generated-code generations) -------------
     def add_generic_rpc_handlers(self, handlers) -> None:
@@ -163,8 +164,29 @@ class SimAioServer:
             return False
 
     async def stop(self, grace: Optional[float] = None) -> None:
+        """Stop accepting, then drain in-flight RPCs for up to ``grace``
+        seconds before tearing the transport down (the grpc.aio contract;
+        grace=None waits for all in-flight calls)."""
         if self._accept_task is not None:
             self._accept_task.abort()
+        live = [t for t in self._in_flight if not t.is_finished()]
+        if live:
+            async def drain():
+                for t in live:
+                    try:
+                        await t
+                    except (Cancelled, ChannelClosed):
+                        pass
+
+            if grace is None:
+                await drain()
+            else:
+                try:
+                    await _vtime.timeout(grace, drain())
+                except TimeoutError:
+                    for t in live:
+                        t.abort()
+        self._in_flight.clear()
         if self._ep is not None:
             self._ep.close()
         if self._stopped is not None:
@@ -187,7 +209,10 @@ class SimAioServer:
                 tx, rx, src = await self._ep.accept1()
             except (ConnectionReset, ChannelClosed):
                 return
-            _task.spawn(self._handle_conn(tx, rx, src))
+            self._in_flight.append(_task.spawn(self._handle_conn(tx, rx, src)))
+            if len(self._in_flight) > 64:  # prune completed handlers
+                self._in_flight = [t for t in self._in_flight
+                                   if not t.is_finished()]
 
     async def _handle_conn(self, tx, rx, src) -> None:
         try:

@@ -247,3 +247,36 @@ def test_grpc_patch_passthrough_outside_sim():
                 await ch.close()
 
     asyncio.run(main())
+
+
+def test_grpc_server_stop_drains_in_flight_rpcs():
+    # grpc.aio contract: stop(grace) lets in-flight handlers finish.
+    rt = ms.Runtime(seed=30)
+
+    class Slow:
+        async def Work(self, request, context):
+            await mtime.sleep(0.5)
+            return b"done"
+
+    def add_to_server(servicer, server):
+        handlers = {"Work": grpc.unary_unary_rpc_method_handler(
+            servicer.Work)}
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("t.Slow", handlers),))
+
+    async def main():
+        server = grpc.aio.server()
+        add_to_server(Slow(), server)
+        server.add_insecure_port("127.0.0.1:50052")
+        await server.start()
+        ch = grpc.aio.insecure_channel("127.0.0.1:50052")
+        mc = ch.unary_unary("/t.Slow/Work")
+        call = ms.task.spawn(mc(b"x"))
+        await mtime.sleep(0.1)      # the RPC is now in flight
+        await server.stop(grace=5.0)
+        assert await call == b"done", "in-flight RPC must complete in grace"
+        await ch.close()
+
+    with grpc_aio.patched():
+        rt.block_on(main())
+

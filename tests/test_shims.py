@@ -1074,3 +1074,44 @@ def test_notify_waiters_cancel_mints_no_phantom_permit():
         return True
 
     assert ms.run(main(), seed=24, time_limit=30)
+
+
+def test_broad_except_cannot_swallow_cancellation():
+    # Cancelled is a BaseException (the asyncio.CancelledError design):
+    # unmodified retry loops with `except Exception` must still be
+    # teardown-able by timeout scopes and task cancellation.
+    async def main():
+        attempts = []
+
+        async def stubborn_retry_loop():
+            while True:
+                try:
+                    attempts.append(1)
+                    await aio.sleep(0.01)
+                except Exception:   # the swallow-everything anti-pattern
+                    continue
+
+        try:
+            async with aio.timeout(0.05):
+                await stubborn_retry_loop()
+            raise AssertionError("expected TimeoutError")
+        except TimeoutError:
+            pass
+        n = len(attempts)
+        await aio.sleep(0.05)
+        assert len(attempts) == n, "the loop must actually be torn down"
+        return True
+
+    assert ms.run(main(), seed=25, time_limit=30)
+
+
+def test_condition_requires_lock():
+    async def main():
+        cond = aio.Condition()
+        with pytest.raises(RuntimeError, match="un-acquired"):
+            await cond.wait()
+        with pytest.raises(RuntimeError, match="un-acquired"):
+            cond.notify()
+        return True
+
+    assert ms.run(main(), seed=26)
