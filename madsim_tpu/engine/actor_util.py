@@ -1,0 +1,41 @@
+"""Shared actor-side helpers: payload padding and the outbox layout.
+
+Both actor families (raft_actor, pb_actor) assemble the same
+(N peer messages + 1 timer) Outbox shape; keeping the layout in one place
+means a change to it cannot silently diverge the actors.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import EngineConfig, Outbox
+
+
+def pad_payload(cfg: EngineConfig, words) -> jnp.ndarray:
+    """(P,) payload row: the given words, zero-padded."""
+    vals = [jnp.asarray(w, jnp.int32) for w in words]
+    vals += [jnp.int32(0)] * (cfg.payload_words - len(vals))
+    return jnp.stack(vals)
+
+
+def bcast_payload(cfg: EngineConfig, n: int, words) -> jnp.ndarray:
+    """(N, P) payload with the same words in every row."""
+    return jnp.broadcast_to(pad_payload(cfg, words), (n, cfg.payload_words))
+
+
+def make_outbox(cfg: EngineConfig, n: int, msg_valid, msg_kind, msg_payload,
+                timer_valid, timer_kind, timer_dst, timer_delay,
+                timer_payload) -> Outbox:
+    """Assemble the (N peers + 1 timer) outbox layout."""
+    app = lambda xs, x: jnp.concatenate(  # noqa: E731
+        [jnp.asarray(xs), jnp.asarray(x)[None]], axis=0)
+    return Outbox(
+        valid=app(msg_valid, timer_valid),
+        is_timer=app(jnp.zeros((n,), bool), jnp.asarray(True)),
+        kind=app(msg_kind, timer_kind),
+        dst=app(jnp.arange(n, dtype=jnp.int32),
+                jnp.asarray(timer_dst, jnp.int32)),
+        delay_us=app(jnp.zeros((n,), jnp.int32),
+                     jnp.asarray(timer_delay, jnp.int32)),
+        payload=jnp.concatenate([msg_payload, timer_payload[None]], axis=0),
+    )

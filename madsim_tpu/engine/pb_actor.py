@@ -1,0 +1,390 @@
+"""Primary-backup replication actor for the batched device engine.
+
+The second workload family (alongside :mod:`madsim_tpu.engine.raft_actor`),
+proving the DeviceEngine actor protocol generalizes: a view-based
+primary-backup log (VR/chain-replication style) — the primary of view v is
+node ``v % n``; clients write to the primary, the primary replicates to
+every backup and commits an entry once EVERY replica has acked it (static
+membership, chain-replication-strength durability: a dead backup stalls
+new commits until it restarts — there is deliberately no reconfiguration);
+backups that miss the primary's heartbeat long enough start a view change.
+
+On-device invariant (the bug flag): **durability of committed writes** —
+every entry the old primary reported committed must exist in the new
+primary's log after a failover — plus single-primary-per-view. The
+``buggy_commit_early`` switch makes the primary commit after the FIRST ack
+instead of all acks; a fault schedule that kills the primary mid-window
+then loses a committed write at failover, and seed sweeps catch it at the
+view change. All state is fixed-shape int32 arrays via the one-hot lane
+helpers (no gather/scatter), exactly like the Raft actor.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .actor_util import bcast_payload, make_outbox, pad_payload
+from .core import EngineConfig, Outbox
+from .lanes import sel, sel2, upd, upd2
+from .queue import Event, FLAG_TIMER, INF_TIME
+from .rng import DevRng, uniform_u32
+
+# Event kinds.
+K_WRITE = 0        # scheduled client write [cmd] (delivered to all; primary acts)
+K_REPLICATE = 1    # primary -> backup [view, idx, cmd]
+K_ACK = 2          # backup -> primary [view, idx, backup]
+K_COMMIT = 3       # primary -> backup [view, commit_idx]
+K_HEARTBEAT = 4    # timer on primary [view]
+K_WATCHDOG = 5     # timer on backup [view] — primary silence detector
+NUM_KINDS = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class PBDeviceConfig:
+    """Static primary-backup parameters."""
+
+    n: int = 3
+    log_cap: int = 16
+    heartbeat_us: int = 50_000
+    # A backup that hears nothing from the primary for this long starts the
+    # next view (randomized per node to avoid symmetric races).
+    watchdog_min_us: int = 200_000
+    watchdog_max_us: int = 400_000
+    n_writes: int = 4
+    write_start_us: int = 100_000
+    write_interval_us: int = 150_000
+    # Injected bug: commit after the first ack instead of all acks.
+    buggy_commit_early: bool = False
+
+
+class PBState(NamedTuple):
+    view: jnp.ndarray        # (N,) i32 — each node's current view
+    log_len: jnp.ndarray     # (N,) i32
+    log_cmd: jnp.ndarray     # (N, L) i32
+    commit: jnp.ndarray      # (N,) i32 — entries each node knows committed
+    acks: jnp.ndarray        # (N, L) i32 bitmask of backup acks (primary rows)
+    wd_epoch: jnp.ndarray    # (N,) i32 — invalidates stale watchdog timers
+    committed_cmd: jnp.ndarray   # (L,) i32 — globally committed prefix record
+    committed_max: jnp.ndarray   # i32 — high-water committed index
+    views_changed: jnp.ndarray   # i32
+    writes_done: jnp.ndarray     # i32
+
+
+class PBActor:
+    """Primary-backup actor implementing the DeviceEngine protocol."""
+
+    num_kinds = NUM_KINDS
+    kind_names = ["Write", "Replicate", "Ack", "Commit", "Heartbeat",
+                  "Watchdog"]
+
+    def __init__(self, pcfg: PBDeviceConfig):
+        self.pcfg = pcfg
+
+    # ------------------------------------------------------------------
+    def init(self, cfg: EngineConfig, rng: DevRng
+             ) -> Tuple[PBState, List[Event], DevRng]:
+        p = self.pcfg
+        n, L = p.n, p.log_cap
+        if cfg.n_nodes != n:
+            raise ValueError("EngineConfig.n_nodes must match PBDeviceConfig.n")
+        if cfg.m != n + 1:
+            raise ValueError("PBActor needs outbox_cap == n + 1")
+        if cfg.payload_words < 4:
+            raise ValueError("PBActor needs payload_words >= 4")
+        s = PBState(
+            view=jnp.zeros((n,), jnp.int32),
+            log_len=jnp.zeros((n,), jnp.int32),
+            log_cmd=jnp.zeros((n, L), jnp.int32),
+            commit=jnp.zeros((n,), jnp.int32),
+            acks=jnp.zeros((n, L), jnp.int32),
+            wd_epoch=jnp.zeros((n,), jnp.int32),
+            committed_cmd=jnp.zeros((L,), jnp.int32),
+            committed_max=jnp.int32(0),
+            views_changed=jnp.int32(0),
+            writes_done=jnp.int32(0),
+        )
+        events: List[Event] = []
+        # Primary of view 0 (node 0) heartbeats; backups watch.
+        events.append(Event.make(
+            time=p.heartbeat_us, kind=K_HEARTBEAT,
+            payload_words=cfg.payload_words, flags=FLAG_TIMER,
+            src=0, dst=0, payload=[0]))
+        for i in range(1, n):
+            delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
+            events.append(Event.make(
+                time=delay, kind=K_WATCHDOG, payload_words=cfg.payload_words,
+                flags=FLAG_TIMER, src=i, dst=i, payload=[0, 0]))
+        for w in range(p.n_writes):
+            t = p.write_start_us + w * p.write_interval_us
+            for i in range(n):  # broadcast; only the current primary acts
+                events.append(Event.make(
+                    time=t, kind=K_WRITE, payload_words=cfg.payload_words,
+                    src=i, dst=i, payload=[w + 1]))
+        return s, events, rng
+
+    # ------------------------------------------------------------------
+    def on_restart(self, cfg: EngineConfig, s: PBState, node, now, rng: DevRng
+                   ) -> Tuple[PBState, Outbox, DevRng]:
+        p = self.pcfg
+        n = p.n
+        me = jnp.clip(node, 0, n - 1)
+        # Log and commit are persistent (disk); view is too. Volatile ack
+        # bookkeeping resets; the watchdog re-arms.
+        epoch2 = sel(s.wd_epoch, me) + 1
+        s2 = s._replace(
+            acks=upd(s.acks, me, jnp.zeros((p.log_cap,), jnp.int32)),
+            wd_epoch=upd(s.wd_epoch, me, epoch2),
+        )
+        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=jnp.zeros((n,), bool),
+            msg_kind=jnp.zeros((n,), jnp.int32),
+            msg_payload=jnp.zeros((n, cfg.payload_words), jnp.int32),
+            timer_valid=jnp.asarray(True), timer_kind=jnp.int32(K_WATCHDOG),
+            timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [sel(s2.view, me), epoch2]))
+        return s2, ob, rng
+
+    # ------------------------------------------------------------------
+    def handle(self, cfg: EngineConfig, s: PBState, ev: Event, now, rng: DevRng
+               ) -> Tuple[PBState, Outbox, DevRng, jnp.ndarray]:
+        branches = [self._on_write, self._on_replicate, self._on_ack,
+                    self._on_commit, self._on_heartbeat, self._on_watchdog]
+
+        def mk(fn):
+            return lambda a, e, t, r: fn(cfg, a, e, t, r)
+
+        kind = jnp.clip(ev.kind, 0, NUM_KINDS - 1)
+        return jax.lax.switch(kind, [mk(f) for f in branches], s, ev, now, rng)
+
+    # ------------------------------------------------------------------
+    def invariant(self, cfg: EngineConfig, s: PBState) -> jnp.ndarray:
+        """Durability: the current primary's log must contain every entry
+        ever reported committed, verbatim."""
+        p = self.pcfg
+        n, L = p.n, p.log_cap
+        primary = jnp.max(s.view) % n
+        k = jnp.arange(L)
+        mask = k < s.committed_max
+        plog = sel(s.log_cmd, primary)                    # (L,)
+        plen = sel(s.log_len, primary)
+        missing = jnp.any(mask & ((k >= plen) | (plog != s.committed_cmd)))
+        return missing
+
+    # ------------------------------------------------------------------
+    def observe(self, cfg: EngineConfig, s: PBState) -> dict:
+        # Called on BATCHED state (leading world axis): node-axis
+        # reductions must keep the world axis (axis=-1), unlike
+        # invariant(), which runs per-world under vmap.
+        return {
+            "max_view": jnp.max(s.view, axis=-1),
+            "views_changed": s.views_changed,
+            "committed_max": s.committed_max,
+            "writes_done": s.writes_done,
+            "min_commit": jnp.min(s.commit, axis=-1),
+        }
+
+    # ==================================================================
+    # Handlers: (state, outbox, rng, bug)
+    # ==================================================================
+    def _primary_of(self, view):
+        return view % jnp.int32(self.pcfg.n)
+
+    def _on_write(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n, L = p.n, p.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        cmd = ev.payload[0]
+        view_me = sel(s.view, me)
+        llen = sel(s.log_len, me)
+        is_primary = me == self._primary_of(view_me)
+        accept = is_primary & (llen < L)
+        pos = jnp.clip(llen, 0, L - 1)
+        llen2 = llen + accept.astype(jnp.int32)
+        s2 = s._replace(
+            log_cmd=upd2(s.log_cmd, me, pos, jnp.where(
+                accept, cmd, sel2(s.log_cmd, me, pos))),
+            log_len=upd(s.log_len, me, llen2),
+            acks=upd2(s.acks, me, pos, jnp.where(
+                accept, 1 << me, sel2(s.acks, me, pos))),
+            writes_done=s.writes_done + accept.astype(jnp.int32),
+        )
+        payload = self._bcast(cfg, [view_me, llen2, cmd, 0])
+        ob = self._outbox(
+            cfg,
+            msg_valid=accept & (jnp.arange(n) != me),
+            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
+            msg_payload=payload,
+            timer_valid=jnp.asarray(False), timer_kind=jnp.int32(0),
+            timer_dst=me, timer_delay=jnp.int32(0),
+            timer_payload=self._pad(cfg, []))
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_replicate(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n, L = p.n, p.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        v, idx, cmd = ev.payload[0], ev.payload[1], ev.payload[2]
+        view_me = sel(s.view, me)
+        # Adopt newer views from the primary's traffic.
+        view2 = jnp.maximum(view_me, v)
+        current = v >= view_me
+        # Append in order only (idx == len + 1); out-of-order is ignored
+        # (the primary's retransmit-free pipeline keeps this dense).
+        llen = sel(s.log_len, me)
+        in_order = current & (idx == llen + 1) & (idx <= L)
+        pos = jnp.clip(idx - 1, 0, L - 1)
+        # Primary sign-of-life (current traffic only): reset the watchdog.
+        epoch2 = sel(s.wd_epoch, me) + current.astype(jnp.int32)
+        s2 = s._replace(
+            view=upd(s.view, me, view2),
+            log_cmd=upd2(s.log_cmd, me, pos, jnp.where(
+                in_order, cmd, sel2(s.log_cmd, me, pos))),
+            log_len=upd(s.log_len, me, jnp.where(in_order, idx, llen)),
+            wd_epoch=upd(s.wd_epoch, me, epoch2),
+        )
+        payload = self._bcast(cfg, [view2, idx, me, 0])
+        primary = self._primary_of(view2)
+        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
+        ob = self._outbox(
+            cfg,
+            msg_valid=in_order & (jnp.arange(n) == primary),
+            msg_kind=jnp.full((n,), K_ACK, jnp.int32),
+            msg_payload=payload,
+            timer_valid=current, timer_kind=jnp.int32(K_WATCHDOG),
+            timer_dst=me, timer_delay=delay,
+            timer_payload=self._pad(cfg, [view2, epoch2]))
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_ack(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n, L = p.n, p.log_cap
+        me = jnp.clip(ev.dst, 0, n - 1)
+        v, idx, backup = ev.payload[0], ev.payload[1], \
+            jnp.clip(ev.payload[2], 0, n - 1)
+        view_me = sel(s.view, me)
+        live = (v == view_me) & (me == self._primary_of(view_me)) & \
+            (idx >= 1) & (idx <= L)
+        pos = jnp.clip(idx - 1, 0, L - 1)
+        acks2 = sel2(s.acks, me, pos) | jnp.where(live, 1 << backup, 0)
+        all_mask = jnp.int32((1 << n) - 1)
+        quorum = acks2 == all_mask
+        if p.buggy_commit_early:
+            # THE BUG: one ack is "enough". A fault schedule that kills
+            # the primary before the rest replicate loses the entry.
+            quorum = jax.lax.population_count(acks2) >= 2
+        old_commit = sel(s.commit, me)
+        committed = live & quorum & (idx > old_commit)
+        commit2 = jnp.where(committed, idx, old_commit)
+        # Record the global committed prefix at commit time from the
+        # primary's own log — the WHOLE (old_commit, idx] range, not just
+        # slot idx: acks can arrive out of order, so a commit may jump
+        # several indices and every skipped slot is committed with it.
+        krange = jnp.arange(L)
+        fill = committed & (krange >= old_commit) & (krange < idx)
+        committed_cmd2 = jnp.where(fill, sel(s.log_cmd, me), s.committed_cmd)
+        s2 = s._replace(
+            acks=upd2(s.acks, me, pos, acks2),
+            commit=upd(s.commit, me, commit2),
+            committed_cmd=committed_cmd2,
+            committed_max=jnp.maximum(s.committed_max,
+                                      jnp.where(committed, idx, 0)),
+        )
+        payload = self._bcast(cfg, [view_me, commit2, 0, 0])
+        ob = self._outbox(
+            cfg,
+            msg_valid=committed & (jnp.arange(n) != me),
+            msg_kind=jnp.full((n,), K_COMMIT, jnp.int32),
+            msg_payload=payload,
+            timer_valid=jnp.asarray(False), timer_kind=jnp.int32(0),
+            timer_dst=me, timer_delay=jnp.int32(0),
+            timer_payload=self._pad(cfg, []))
+        return s2, ob, rng, jnp.asarray(False)
+
+    def _on_commit(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n = p.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        v, cidx = ev.payload[0], ev.payload[1]
+        current = v >= sel(s.view, me)
+        commit2 = jnp.where(current,
+                            jnp.maximum(sel(s.commit, me),
+                                        jnp.minimum(cidx, sel(s.log_len, me))),
+                            sel(s.commit, me))
+        s2 = s._replace(commit=upd(s.commit, me, commit2))
+        return s2, Outbox.empty(cfg), rng, jnp.asarray(False)
+
+    def _on_heartbeat(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n = p.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        view_me = sel(s.view, me)
+        live = (ev.payload[0] == view_me) & (me == self._primary_of(view_me))
+        # Heartbeats ride the replicate channel with idx 0 (kept by backups
+        # as a watchdog reset only).
+        payload = self._bcast(cfg, [view_me, 0, 0, 0])
+        ob = self._outbox(
+            cfg,
+            msg_valid=live & (jnp.arange(n) != me),
+            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
+            msg_payload=payload,
+            timer_valid=live, timer_kind=jnp.int32(K_HEARTBEAT), timer_dst=me,
+            timer_delay=jnp.int32(p.heartbeat_us),
+            timer_payload=self._pad(cfg, [view_me]))
+        return s, ob, rng, jnp.asarray(False)
+
+    def _on_watchdog(self, cfg, s: PBState, ev: Event, now, rng):
+        p = self.pcfg
+        n = p.n
+        me = jnp.clip(ev.dst, 0, n - 1)
+        view_me = sel(s.view, me)
+        # A watchdog is live only if nothing reset it since it was armed:
+        # every primary sign-of-life bumps wd_epoch and arms a fresh timer,
+        # so stale timers (old epoch or old view) are no-ops.
+        epoch_ok = ev.payload[1] == sel(s.wd_epoch, me)
+        stale = (ev.payload[0] < view_me) | ~epoch_ok
+        fire = ~stale & (me != self._primary_of(view_me))
+        # View change: bump until THIS node is primary of the new view
+        # (deterministic successor rule — the node whose watchdog fires
+        # first wins; others adopt its view from its heartbeats).
+        cand = view_me + ((me - self._primary_of(view_me)) % n + n) % n
+        view2 = jnp.where(fire, jnp.maximum(cand, view_me + 1), view_me)
+        became_primary = fire & (me == self._primary_of(view2))
+        s2 = s._replace(
+            view=upd(s.view, me, view2),
+            views_changed=s.views_changed + fire.astype(jnp.int32),
+        )
+        # New primary announces itself via heartbeat; a stale-timer holder
+        # re-arms its watchdog against the current epoch.
+        epoch2 = sel(s.wd_epoch, me) + fire.astype(jnp.int32)
+        s2 = s2._replace(wd_epoch=upd(s2.wd_epoch, me, epoch2))
+        payload = self._bcast(cfg, [view2, 0, 0, 0])
+        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
+        timer_kind = jnp.where(became_primary, K_HEARTBEAT, K_WATCHDOG)
+        timer_delay = jnp.where(became_primary, p.heartbeat_us, delay)
+        ob = self._outbox(
+            cfg,
+            msg_valid=became_primary & (jnp.arange(n) != me),
+            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
+            msg_payload=payload,
+            timer_valid=epoch_ok | fire,
+            timer_kind=timer_kind.astype(jnp.int32), timer_dst=me,
+            timer_delay=timer_delay.astype(jnp.int32),
+            timer_payload=self._pad(cfg, [view2, epoch2]))
+        return s2, ob, rng, jnp.asarray(False)
+
+    # ==================================================================
+    # Helpers (same layout discipline as the Raft actor)
+    # ==================================================================
+    def _bcast(self, cfg, words):
+        return bcast_payload(cfg, self.pcfg.n, words)
+
+    def _pad(self, cfg, words) -> jnp.ndarray:
+        return pad_payload(cfg, words)
+
+    def _outbox(self, cfg, *args, **kwargs) -> Outbox:
+        return make_outbox(cfg, self.pcfg.n, *args, **kwargs)

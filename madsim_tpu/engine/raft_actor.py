@@ -27,6 +27,7 @@ from typing import List, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from .actor_util import bcast_payload, make_outbox, pad_payload
 from .core import EngineConfig, Outbox
 from .lanes import sel, sel2, sel_many, upd, upd2
 from .queue import Event, FLAG_TIMER, INF_TIME
@@ -542,27 +543,10 @@ class RaftActor:
         return jnp.arange(n) != me, jnp.concatenate([payload, pad], axis=1)
 
     def _bcast_payload(self, cfg, words):
-        """(N, P) payload with the same words in every row."""
-        n = self.rcfg.n
-        row = self._pad(cfg, words)
-        return jnp.broadcast_to(row, (n, cfg.payload_words))
+        return bcast_payload(cfg, self.rcfg.n, words)
 
     def _pad(self, cfg, words) -> jnp.ndarray:
-        vals = [jnp.asarray(wd, jnp.int32) for wd in words]
-        vals += [jnp.int32(0)] * (cfg.payload_words - len(words))
-        return jnp.stack(vals)
+        return pad_payload(cfg, words)
 
-    def _outbox(self, cfg, msg_valid, msg_kind, msg_payload, timer_valid,
-                timer_kind, timer_dst, timer_delay, timer_payload) -> Outbox:
-        """Assemble the (N peers + 1 timer) outbox layout."""
-        n = self.rcfg.n
-        app = lambda xs, x: jnp.concatenate(  # noqa: E731
-            [jnp.asarray(xs), jnp.asarray(x)[None]], axis=0)
-        return Outbox(
-            valid=app(msg_valid, timer_valid),
-            is_timer=app(jnp.zeros((n,), bool), jnp.asarray(True)),
-            kind=app(msg_kind, timer_kind),
-            dst=app(jnp.arange(n, dtype=jnp.int32), jnp.asarray(timer_dst, jnp.int32)),
-            delay_us=app(jnp.zeros((n,), jnp.int32), jnp.asarray(timer_delay, jnp.int32)),
-            payload=jnp.concatenate([msg_payload, timer_payload[None]], axis=0),
-        )
+    def _outbox(self, cfg, *args, **kwargs) -> Outbox:
+        return make_outbox(cfg, self.rcfg.n, *args, **kwargs)
