@@ -5,13 +5,17 @@ proving the DeviceEngine actor protocol generalizes: a view-based
 primary-backup log (VR/chain-replication style) — the primary of view v is
 node ``v % n``; clients write to the primary, the primary replicates to
 every backup and commits an entry once EVERY replica has acked it (static
-membership, chain-replication-strength durability: a dead backup stalls
-new commits until it restarts — there is deliberately no reconfiguration);
-backups that miss the primary's heartbeat long enough start a view change.
+membership, chain-replication-strength durability). There is deliberately
+no retransmission, log repair, or reconfiguration: a replicate lost to a
+dead backup or the network permanently caps the commit index (safety is
+the subject under test, not liveness — madsim worlds are finite). Backups
+that miss the primary's heartbeat long enough start a view change; the
+primary of a view is fixed by construction (``v % n``), so single-primary
+holds definitionally and is not separately checked.
 
 On-device invariant (the bug flag): **durability of committed writes** —
 every entry the old primary reported committed must exist in the new
-primary's log after a failover — plus single-primary-per-view. The
+primary's log after a failover. The
 ``buggy_commit_early`` switch makes the primary commit after the FIRST ack
 instead of all acks; a fault schedule that kills the primary mid-window
 then loses a committed write at failover, and seed sweeps catch it at the
