@@ -73,6 +73,62 @@ def _split_cstrs(buf: bytes) -> List[str]:
     return [p.decode() for p in buf.split(b"\0")[:-1]]
 
 
+def _parse_error(payload: bytes, default_severity: str = "ERROR",
+                 default_message: str = "unknown") -> PostgresError:
+    """Decode an ErrorResponse payload's field list into a PostgresError."""
+    fields = dict((c[0], c[1:]) for c in _split_cstrs(payload) if c)
+    return PostgresError(fields.get("S", default_severity),
+                         fields.get("C", "XX000"),
+                         fields.get("M", default_message))
+
+
+# ---------------------------------------------------------------------------
+# COPY text-format codec (protocol "COPY file formats", text mode): rows are
+# newline-terminated, columns tab-separated, NULL is \N, and backslash, tab,
+# newline, and carriage return are backslash-escaped in data.
+# ---------------------------------------------------------------------------
+
+def copy_encode_row(values: List[Optional[str]]) -> bytes:
+    cols = []
+    for v in values:
+        if v is None:
+            cols.append("\\N")
+        else:
+            cols.append(str(v).replace("\\", "\\\\").replace("\t", "\\t")
+                        .replace("\n", "\\n").replace("\r", "\\r"))
+    return ("\t".join(cols) + "\n").encode()
+
+
+def _copy_unescape(field: str) -> Optional[str]:
+    if field == "\\N":
+        return None
+    out: List[str] = []
+    i, n = 0, len(field)
+    while i < n:
+        c = field[i]
+        if c == "\\" and i + 1 < n:
+            nxt = field[i + 1]
+            out.append({"t": "\t", "n": "\n", "r": "\r"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def copy_decode(data: bytes) -> List[List[Optional[str]]]:
+    text = data.decode()
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()  # fragment after the final row terminator, not a row
+    rows: List[List[Optional[str]]] = []
+    for line in lines:
+        if line == "\\.":  # end-of-data marker terminates the stream
+            break
+        rows.append([_copy_unescape(f) for f in line.split("\t")])
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # Client
 # ---------------------------------------------------------------------------
@@ -99,6 +155,43 @@ class PreparedStatement:
         self.sql = sql
         self.columns = columns  # [] for statements returning no rows
         self.n_params = n_params
+
+
+class CopyInWriter:
+    """Sink side of ``COPY ... FROM STDIN`` (reference copy_in.rs analog:
+    the CopyInSink the vendored client returns). Stream raw text-format
+    bytes with :meth:`write`, or rows with :meth:`write_row`; then
+    :meth:`finish` (→ rows copied) or :meth:`fail` to abort."""
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self._done = False
+
+    async def write(self, data: bytes) -> None:
+        if self._done:
+            raise PostgresError("ERROR", "08P01",
+                                "COPY-in already finished on this writer")
+        await self._conn._stream.write_all(_msg(b"d", data))
+
+    async def write_row(self, values: List[Optional[str]]) -> None:
+        await self.write(copy_encode_row(values))
+
+    async def finish(self) -> int:
+        """CopyDone; returns the server-reported copied-row count."""
+        self._done = True
+        await self._conn._stream.write_all(_msg(b"c", b""))
+        await self._conn._read_until_ready()
+        tag = self._conn._last_tag
+        return int(tag.rsplit(" ", 1)[1]) if tag.startswith("COPY ") else 0
+
+    async def fail(self, message: str = "aborted") -> None:
+        """CopyFail: the server discards the data and reports 57014."""
+        self._done = True
+        await self._conn._stream.write_all(_msg(b"f", _cstr(message)))
+        try:
+            await self._conn._read_until_ready()
+        except PostgresError:
+            pass  # the expected "COPY from stdin failed" error
 
 
 class Transaction:
@@ -132,6 +225,7 @@ class Connection:
         self._closed = False
         self.txn_status = "I"  # ReadyForQuery status: I / T / E
         self._stmt_counter = 0  # deterministic auto-generated stmt names
+        self._last_tag = ""  # most recent CommandComplete tag
 
     # -- shared response pump ---------------------------------------------
     async def _read_until_ready(self) -> Tuple[List[Row], List[str], int]:
@@ -165,16 +259,14 @@ class Connection:
                 rows.append(Row(values, columns))
             elif mtype == b"t":  # ParameterDescription
                 (n_params,) = struct.unpack("!H", payload[:2])
-            elif mtype in (b"C", b"1", b"2", b"3", b"n", b"s", b"I"):
-                # CommandComplete / ParseComplete / BindComplete /
-                # CloseComplete / NoData / PortalSuspended / EmptyQuery
+            elif mtype == b"C":  # CommandComplete — keep the tag ("COPY 3")
+                self._last_tag = payload.rstrip(b"\0").decode()
+            elif mtype in (b"1", b"2", b"3", b"n", b"s", b"I"):
+                # ParseComplete / BindComplete / CloseComplete / NoData /
+                # PortalSuspended / EmptyQuery
                 pass
             elif mtype == b"E":  # ErrorResponse
-                fields = dict((chunk[0], chunk[1:]) for chunk in
-                              _split_cstrs(payload) if chunk)
-                error = PostgresError(fields.get("S", "ERROR"),
-                                      fields.get("C", "XX000"),
-                                      fields.get("M", "unknown"))
+                error = _parse_error(payload)
             elif mtype == b"Z":  # ReadyForQuery — end of the response cycle
                 self.txn_status = payload[:1].decode() or "I"
                 break
@@ -243,6 +335,55 @@ class Connection:
             _msg(b"C", b"S" + _cstr(name)) + _msg(b"S", b""))
         await self._read_until_ready()
 
+    # -- COPY sub-protocol (copy_in.rs / copy_out.rs analog) ---------------
+    async def copy_in(self, sql: str) -> CopyInWriter:
+        """Start ``COPY table [(cols)] FROM STDIN``; returns the sink."""
+        await self._stream.write_all(_msg(b"Q", _cstr(sql)))
+        error: Optional[PostgresError] = None
+        while True:
+            mtype, payload = await _read_message(self._stream)
+            if mtype == b"G":  # CopyInResponse — ready for CopyData
+                return CopyInWriter(self)
+            if mtype == b"E":
+                error = _parse_error(payload)
+            elif mtype == b"Z":
+                self.txn_status = payload[:1].decode() or "I"
+                raise error if error is not None else PostgresError(
+                    "ERROR", "08P01", "server did not enter COPY-in mode")
+            elif mtype in (b"S", b"N", b"C"):
+                continue
+            else:
+                raise PostgresError("FATAL", "08P01",
+                                    f"unexpected message {mtype!r} in COPY")
+
+    async def copy_out(self, sql: str) -> List[List[Optional[str]]]:
+        """Run ``COPY table [(cols)] TO STDOUT``; returns decoded rows."""
+        await self._stream.write_all(_msg(b"Q", _cstr(sql)))
+        data = bytearray()
+        error: Optional[PostgresError] = None
+        while True:
+            mtype, payload = await _read_message(self._stream)
+            if mtype == b"H":  # CopyOutResponse
+                continue
+            if mtype == b"d":  # CopyData
+                data += payload
+            elif mtype == b"c":  # CopyDone
+                continue
+            elif mtype == b"C":
+                self._last_tag = payload.rstrip(b"\0").decode()
+            elif mtype == b"E":
+                error = _parse_error(payload)
+            elif mtype == b"Z":
+                self.txn_status = payload[:1].decode() or "I"
+                if error is not None:
+                    raise error
+                return copy_decode(bytes(data))
+            elif mtype in (b"S", b"N"):
+                continue
+            else:
+                raise PostgresError("FATAL", "08P01",
+                                    f"unexpected message {mtype!r} in COPY")
+
     # -- transactions ------------------------------------------------------
     def transaction(self) -> Transaction:
         return Transaction(self)
@@ -279,10 +420,7 @@ async def connect(host: str, port: int = 5432, user: str = "postgres",
             elif mtype == b"K":  # BackendKeyData
                 pass
             elif mtype == b"E":
-                fields = dict((c[0], c[1:]) for c in _split_cstrs(payload) if c)
-                raise PostgresError(fields.get("S", "FATAL"),
-                                    fields.get("C", "XX000"),
-                                    fields.get("M", "startup failed"))
+                raise _parse_error(payload, "FATAL", "startup failed")
             elif mtype == b"Z":
                 return Connection(stream, parameters)
             else:
@@ -308,6 +446,10 @@ _SELECT = re.compile(r"^\s*SELECT\s+(.+?)\s+FROM\s+(\w+)" + _WHERE
                      + r"\s*;?\s*$", re.I)
 _DELETE = re.compile(r"^\s*DELETE\s+FROM\s+(\w+)" + _WHERE + r"\s*;?\s*$",
                      re.I)
+_COPY_FROM = re.compile(
+    r"^\s*COPY\s+(\w+)\s*(?:\(([^)]*)\))?\s+FROM\s+STDIN\s*;?\s*$", re.I)
+_COPY_TO = re.compile(
+    r"^\s*COPY\s+(\w+)\s*(?:\(([^)]*)\))?\s+TO\s+STDOUT\s*;?\s*$", re.I)
 _BEGIN = re.compile(r"^\s*(BEGIN|START\s+TRANSACTION)\s*;?\s*$", re.I)
 _COMMIT = re.compile(r"^\s*(COMMIT|END)\s*;?\s*$", re.I)
 _ROLLBACK = re.compile(r"^\s*ROLLBACK\s*;?\s*$", re.I)
@@ -435,6 +577,9 @@ class SimPostgresServer:
                     continue
                 if mtype == b"Q":
                     sql = payload.rstrip(b"\0").decode()
+                    if _COPY_FROM.match(sql) or _COPY_TO.match(sql):
+                        await self._copy_session(stream, sess, sql)
+                        continue
                     await stream.write_all(self._run_txn(sql, sess)
                                            + _msg(b"Z", sess.txn.encode()))
                 elif mtype == b"P":    # Parse
@@ -471,6 +616,109 @@ class SimPostgresServer:
             if sess.txn != "I":
                 self._rollback(sess)
             stream.close()
+
+    # -- COPY sub-protocol ----------------------------------------------
+    async def _copy_session(self, stream: TcpStream, sess: _Session,
+                            sql: str) -> None:
+        """One simple-protocol COPY cycle: ``COPY t [(cols)] FROM STDIN``
+        (CopyInResponse → CopyData* → CopyDone/CopyFail) or
+        ``COPY t [(cols)] TO STDOUT`` (CopyOutResponse → CopyData* →
+        CopyDone). Errors poison an open transaction like any statement;
+        COPY FROM inside a transaction appends an undo entry so ROLLBACK
+        removes the copied rows."""
+        def fail(out: bytes) -> bytes:
+            if sess.txn == "T":
+                sess.txn = "E"
+            return out + _msg(b"Z", sess.txn.encode())
+
+        m_in = _COPY_FROM.match(sql)
+        m = m_in or _COPY_TO.match(sql)
+        name = m.group(1).lower()
+        if sess.txn == "E":
+            await stream.write_all(self._error(
+                "ERROR", "25P02", "current transaction is aborted, commands "
+                "ignored until end of transaction block") + _msg(b"Z", b"E"))
+            return
+        if not self._visible(name, sess):
+            await stream.write_all(fail(self._error(
+                "ERROR", "42P01", f'no table "{name}"')))
+            return
+        cols, data = self.tables[name]
+        want = ([c.strip().lower() for c in m.group(2).split(",")]
+                if m.group(2) else list(cols))
+        bad = [c for c in want if c not in cols]
+        if bad:
+            await stream.write_all(fail(self._error(
+                "ERROR", "42703", f'no column "{bad[0]}"')))
+            return
+        # Copy{In,Out}Response: int8 overall format (0 = text), int16 column
+        # count, int16 per-column format codes.
+        fmt = struct.pack("!BH", 0, len(want)) + b"\0\0" * len(want)
+
+        if m_in is None:  # COPY ... TO STDOUT
+            idx = [cols.index(c) for c in want]
+            out = _msg(b"H", fmt)
+            for row in data:
+                out += _msg(b"d", copy_encode_row([row[i] for i in idx]))
+            out += (_msg(b"c", b"") + self._complete(f"COPY {len(data)}")
+                    + _msg(b"Z", sess.txn.encode()))
+            await stream.write_all(out)
+            return
+
+        # COPY ... FROM STDIN
+        await stream.write_all(_msg(b"G", fmt))
+        buf = bytearray()
+        while True:
+            mtype, payload = await _read_message(stream)
+            if mtype == b"d":
+                buf += payload
+            elif mtype == b"c":
+                break
+            elif mtype == b"f":
+                msg = payload.rstrip(b"\0").decode()
+                await stream.write_all(fail(self._error(
+                    "ERROR", "57014", f"COPY from stdin failed: {msg}")))
+                return
+            elif mtype == b"H":
+                continue
+            elif mtype == b"X":
+                # Terminate mid-COPY: treat as a vanished client so the
+                # session's finally block rolls back the open transaction.
+                raise BrokenPipe("client terminated during COPY")
+            else:
+                await stream.write_all(fail(self._error(
+                    "ERROR", "08P01",
+                    f"unexpected message {mtype!r} during COPY")))
+                return
+        try:
+            rows = copy_decode(bytes(buf))
+        except UnicodeDecodeError:
+            await stream.write_all(fail(self._error(
+                "ERROR", "22P04", "invalid COPY data")))
+            return
+        added: List[List[Optional[str]]] = []
+        for r in rows:
+            if len(r) != len(want):
+                await stream.write_all(fail(self._error(
+                    "ERROR", "22P04",
+                    f"row has {len(r)} columns, expected {len(want)}")))
+                return
+            full: List[Optional[str]] = [None] * len(cols)
+            for c, v in zip(want, r):
+                full[cols.index(c)] = v
+            added.append(full)
+        data.extend(added)
+        if sess.txn == "T" and added:
+            def _undo_copy(data=data, added=added):
+                for row in added:
+                    for i in range(len(data) - 1, -1, -1):
+                        if data[i] is row:
+                            del data[i]
+                            break
+
+            sess.undo.append(_undo_copy)
+        await stream.write_all(self._complete(f"COPY {len(added)}")
+                               + _msg(b"Z", sess.txn.encode()))
 
     # -- extended-protocol handlers -------------------------------------
     def _on_parse(self, payload: bytes, sess: _Session) -> Tuple[bytes, bool]:
