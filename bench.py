@@ -765,9 +765,9 @@ def main() -> None:
     def headline(args) -> dict:
         """Device + host headline rates; per-half errors go in the dict."""
         smoke = args.smoke
-        # 256k worlds is the measured single-chip sweet spot (HBM-resident,
-        # past the per-iteration overhead knee; larger starts spilling).
-        n_worlds = args.worlds or (256 if smoke else 262_144)
+        # 512k worlds is the measured single-chip sweet spot (HBM-resident,
+        # past the per-iteration overhead knee; 1M+ starts regressing).
+        n_worlds = args.worlds or (256 if smoke else 524_288)
         n_host = args.host_seeds or (8 if smoke else 32)
         out = {}
         try:

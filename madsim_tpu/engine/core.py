@@ -30,19 +30,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .lanes import sel, sel2, upd, upd2
+from .lanes import sel, sel2, sel_many, upd, upd2
 from .queue import (
     Event,
     EventQueue,
     FLAG_FAULT,
     FLAG_TIMER,
+    GEN_MASK,
     INF_TIME,
+    depth as queue_depth,
     empty_queue,
     next_deadline,
     pop,
     push,
 )
-from .rng import DevRng, make_rng, uniform_f32, uniform_u32
+from .rng import DevRng, make_rng, next_u32_vec, uniform_f32, uniform_u32
 
 # Device-engine RNG stream id (host streams occupy 0..3, see core/rng.py).
 STREAM_DEVICE = 16
@@ -145,6 +147,17 @@ class DeviceEngine:
     """
 
     def __init__(self, actor, cfg: EngineConfig):
+        # Packed-meta width limits (queue.pack_meta): 8-bit node ids,
+        # 6-bit event kinds. num_kinds is required so the kind-width
+        # guard actually covers every actor.
+        if cfg.n_nodes > 256:
+            raise ValueError("DeviceEngine supports at most 256 nodes/world")
+        num_kinds = getattr(actor, "num_kinds", None)
+        if num_kinds is None:
+            raise ValueError("actor must declare num_kinds (its event-kind "
+                             "count; packed event kinds are 6 bits)")
+        if num_kinds > 64:
+            raise ValueError("actor.num_kinds must be <= 64")
         self.actor = actor
         self.cfg = cfg
         self._step_one = self._build_step()
@@ -179,6 +192,20 @@ class DeviceEngine:
             faults = np.asarray(faults, np.int32)
             if faults.ndim == 2:
                 faults = np.broadcast_to(faults, (w,) + faults.shape)
+            # Validate enabled rows here, at the API boundary: the packed
+            # queue stores node ids in 8 bits, so an out-of-range id would
+            # otherwise alias onto a real node (a=256 would kill node 0)
+            # instead of erroring.
+            live = faults[..., 0] >= 0
+            ops = faults[..., 1]
+            nodes = faults[..., 2:4]
+            if np.any(live & ((ops < FAULT_KILL) | (ops > FAULT_UNCLOG_LINK))):
+                raise ValueError("fault op must be one of FAULT_KILL.."
+                                 "FAULT_UNCLOG_LINK")
+            if np.any(live[..., None]
+                      & ((nodes < 0) | (nodes >= self.cfg.n_nodes))):
+                raise ValueError(
+                    f"fault-row node ids must be in [0, {self.cfg.n_nodes})")
 
         return self._init_batched(jnp.asarray(lo), jnp.asarray(hi),
                                   jnp.asarray(faults))
@@ -215,7 +242,7 @@ class DeviceEngine:
             delivered=jnp.int32(0),
             dropped=jnp.int32(0),
             overflow=overflow,
-            qmax=jnp.sum(q.valid.astype(jnp.int32)),
+            qmax=queue_depth(q),
             bug=jnp.asarray(False),
             bug_time=INF_TIME,
         )
@@ -249,34 +276,45 @@ class DeviceEngine:
                                clog_link=clog_link, astate=astate, rng=rng), ob
 
         def push_outbox(ws: WorldState, src, ob: Outbox) -> WorldState:
-            q, rng, overflow = ws.queue, ws.rng, ws.overflow
+            m = cfg.m
             loss = jnp.float32(cfg.loss_rate)
-            src_clogged = sel(ws.clog_node, src)
-            for m in range(cfg.m):  # static unroll
-                # Two draws per slot regardless of validity: the draw count
-                # per step is static, so RNG counters depend only on step
-                # index — replayable and backend-independent.
-                lat, rng = uniform_u32(rng, cfg.latency_min_us, cfg.latency_max_us)
-                u, rng = uniform_f32(rng)
-                dst = jnp.clip(ob.dst[m], 0, cfg.n_nodes - 1)
-                clogged = src_clogged | sel(ws.clog_node, dst) | \
-                    sel2(ws.clog_link, src, dst)
-                dropped = (~ob.is_timer[m]) & (clogged | (u < loss))
-                # Saturating schedule time: now + delay can wrap int32 when
-                # t_limit_us or an actor delay is near 2^31. Both operands
-                # are <= INF_TIME, so min-before-add cannot overflow.
-                delay = jnp.maximum(
-                    jnp.where(ob.is_timer[m], ob.delay_us[m], lat), 0)
-                t = ws.now + jnp.minimum(delay, INF_TIME - ws.now)
-                ev = Event(
-                    time=t, kind=ob.kind[m],
-                    flags=jnp.where(ob.is_timer[m], FLAG_TIMER, 0).astype(jnp.int32),
-                    src=jnp.asarray(src, jnp.int32), dst=dst, gen=sel(ws.gen, dst),
-                    payload=ob.payload[m],
-                )
-                q, ok = push(q, ev, enable=ob.valid[m] & ~dropped)
+            # Two draws per slot regardless of validity, batched into one
+            # Threefry block: the draw count per step is static, so RNG
+            # counters depend only on step index — replayable and
+            # backend-independent. Counters (and therefore values) are
+            # bit-identical to the per-slot sequential draws.
+            xs, rng = next_u32_vec(ws.rng, 2 * m)
+            width = jnp.uint32(jnp.int32(cfg.latency_max_us)
+                               - jnp.int32(cfg.latency_min_us))
+            lat = jnp.int32(cfg.latency_min_us) + \
+                (xs[0::2] % width).astype(jnp.int32)               # (M,)
+            u = (xs[1::2] >> jnp.uint32(8)).astype(jnp.float32) \
+                * jnp.float32(2.0 ** -24)                          # (M,)
+            dst = jnp.clip(ob.dst, 0, cfg.n_nodes - 1)             # (M,)
+            clogged = sel(ws.clog_node, src) \
+                | sel_many(ws.clog_node, dst) \
+                | sel_many(sel(ws.clog_link, src), dst)            # (M,)
+            dropped = (~ob.is_timer) & (clogged | (u < loss))
+            # Saturating schedule time: now + delay can wrap int32 when
+            # t_limit_us or an actor delay is near 2^31. Both operands
+            # are <= INF_TIME, so min-before-add cannot overflow.
+            delay = jnp.maximum(jnp.where(ob.is_timer, ob.delay_us, lat), 0)
+            t = ws.now + jnp.minimum(delay, INF_TIME - ws.now)
+            flags = jnp.where(ob.is_timer, FLAG_TIMER, 0).astype(jnp.int32)
+            gen_dst = sel_many(ws.gen, dst)
+            enable = ob.valid & ~dropped
+            # Sequential one-hot pushes (not a rank-matched batch insert):
+            # XLA fuses this unrolled chain into one queue rewrite, whereas
+            # the (Q, M) matching matrices of a batched insert materialize
+            # *more* HBM traffic — measured 271k → 190k seeds/s on TPU.
+            q, overflow = ws.queue, ws.overflow
+            for i in range(m):  # static unroll
+                ev = Event(time=t[i], kind=ob.kind[i], flags=flags[i],
+                           src=jnp.asarray(src, jnp.int32), dst=dst[i],
+                           gen=gen_dst[i], payload=ob.payload[i])
+                q, ok = push(q, ev, enable=enable[i])
                 overflow = overflow | ~ok
-            qmax = jnp.maximum(ws.qmax, jnp.sum(q.valid.astype(jnp.int32)))
+            qmax = jnp.maximum(ws.qmax, queue_depth(q))
             return ws._replace(queue=q, rng=rng, overflow=overflow, qmax=qmax)
 
         def step(ws: WorldState) -> WorldState:
@@ -288,7 +326,8 @@ class DeviceEngine:
             dst = jnp.clip(ev.dst, 0, cfg.n_nodes - 1)
             is_fault = (ev.flags & FLAG_FAULT) != 0
             is_timer = (ev.flags & FLAG_TIMER) != 0
-            stale = is_timer & (ev.gen != sel(ws1.gen, dst))
+            # Generations compare modulo the packed width (queue.GEN_MASK).
+            stale = is_timer & (ev.gen != (sel(ws1.gen, dst) & GEN_MASK))
             dead = ~sel(ws1.alive, dst)
             deliver = found & in_time & ~is_fault & ~stale & ~dead
             do_fault = found & in_time & is_fault
@@ -381,7 +420,7 @@ class DeviceEngine:
             dst_c = jnp.clip(ev.dst, 0, self.cfg.n_nodes - 1)
             is_fault = (ev.flags & FLAG_FAULT) != 0
             stale = ((ev.flags & FLAG_TIMER) != 0) & \
-                (ev.gen != sel(s2.gen, dst_c))
+                (ev.gen != (sel(s2.gen, dst_c) & GEN_MASK))
             dead = ~sel(s2.alive, dst_c)
             delivered = ~is_fault & ~stale & ~dead
             rec = (found & s.active & in_time, ev.time, ev.kind, ev.flags,
@@ -454,8 +493,7 @@ class DeviceEngine:
             "qmax": state.qmax,
             "bug": state.bug,
             "bug_time_us": state.bug_time,
-            "queue_depth": jax.vmap(
-                lambda q: jnp.sum(q.valid.astype(jnp.int32)))(state.queue),
+            "queue_depth": jax.vmap(queue_depth)(state.queue),
         }
         out.update(self.actor.observe(self.cfg, state.astate))
         return {k: np.asarray(v) for k, v in out.items()}

@@ -39,6 +39,16 @@ def next_u32(rng: DevRng) -> Tuple[jnp.ndarray, DevRng]:
     return x0, rng._replace(counter=rng.counter + jnp.uint32(1))
 
 
+def next_u32_vec(rng: DevRng, k: int) -> Tuple[jnp.ndarray, DevRng]:
+    """``k`` draws in one Threefry evaluation, at counters
+    ``counter + 0 .. counter + k-1`` — bit-identical to ``k`` sequential
+    :func:`next_u32` calls, but one vectorized block instead of ``k``
+    scalar ones (the engine's per-step draws all batch through this)."""
+    counters = rng.counter + jnp.arange(k, dtype=jnp.uint32)
+    xs, _ = threefry2x32_jax(rng.k0, rng.k1, counters, jnp.zeros((k,), jnp.uint32))
+    return xs, rng._replace(counter=rng.counter + jnp.uint32(k))
+
+
 def uniform_u32(rng: DevRng, low, high) -> Tuple[jnp.ndarray, DevRng]:
     """Uniform integer in [low, high) as int32 (modulo method, like the host
     GlobalRng.gen_range). ``high`` must be > ``low``."""

@@ -287,3 +287,24 @@ def test_trace_includes_faults():
                    and not e["kind"].startswith("fault:")]
     assert dead_window, "some traffic addressed the dead node"
     assert all(e.get("dropped") for e in dead_window)
+
+
+def test_packed_width_guards(raft_engine):
+    # Fault rows are validated at the init() boundary: the packed queue
+    # stores node ids in 8 bits, so out-of-range ids must error rather
+    # than alias onto a real node.
+    with pytest.raises(ValueError, match="node ids"):
+        raft_engine.init(np.arange(4),
+                         faults=np.array([[1000, FAULT_KILL, 3, 0]], np.int32))
+    with pytest.raises(ValueError, match="fault op"):
+        raft_engine.init(np.arange(4),
+                         faults=np.array([[1000, 9, 0, 0]], np.int32))
+    # Disabled rows (time < 0) are exempt — ragged schedules pad with them.
+    raft_engine.init(np.arange(4),
+                     faults=np.array([[-1, 0, 99, 99]], np.int32))
+    # Actors must declare num_kinds so the 6-bit kind guard has teeth.
+    class NoKinds:
+        pass
+
+    with pytest.raises(ValueError, match="num_kinds"):
+        DeviceEngine(NoKinds(), ECFG)
