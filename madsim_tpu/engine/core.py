@@ -44,7 +44,15 @@ from .queue import (
     pop,
     push,
 )
-from .rng import DevRng, make_rng, next_u32_vec, uniform_f32, uniform_u32
+from .rng import (
+    DevRng,
+    _u32_to_range,
+    _u32_to_unit_f32,
+    make_rng,
+    next_u32_vec,
+    uniform_f32,
+    uniform_u32,
+)
 
 # Device-engine RNG stream id (host streams occupy 0..3, see core/rng.py).
 STREAM_DEVICE = 16
@@ -284,12 +292,9 @@ class DeviceEngine:
             # backend-independent. Counters (and therefore values) are
             # bit-identical to the per-slot sequential draws.
             xs, rng = next_u32_vec(ws.rng, 2 * m)
-            width = jnp.uint32(jnp.int32(cfg.latency_max_us)
-                               - jnp.int32(cfg.latency_min_us))
-            lat = jnp.int32(cfg.latency_min_us) + \
-                (xs[0::2] % width).astype(jnp.int32)               # (M,)
-            u = (xs[1::2] >> jnp.uint32(8)).astype(jnp.float32) \
-                * jnp.float32(2.0 ** -24)                          # (M,)
+            lat = _u32_to_range(xs[0::2], cfg.latency_min_us,
+                                cfg.latency_max_us)                # (M,)
+            u = _u32_to_unit_f32(xs[1::2])                         # (M,)
             dst = jnp.clip(ob.dst, 0, cfg.n_nodes - 1)             # (M,)
             clogged = sel(ws.clog_node, src) \
                 | sel_many(ws.clog_node, dst) \

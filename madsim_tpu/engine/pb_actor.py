@@ -156,14 +156,142 @@ class PBActor:
     # ------------------------------------------------------------------
     def handle(self, cfg: EngineConfig, s: PBState, ev: Event, now, rng: DevRng
                ) -> Tuple[PBState, Outbox, DevRng, jnp.ndarray]:
-        branches = [self._on_write, self._on_replicate, self._on_ack,
-                    self._on_commit, self._on_heartbeat, self._on_watchdog]
-
-        def mk(fn):
-            return lambda a, e, t, r: fn(cfg, a, e, t, r)
-
+        """Merged handler (same rationale as RaftActor.handle: under vmap a
+        switch runs every branch for every world, so shared work — views,
+        log row reads, outbox assembly, the watchdog-delay draw — is
+        computed once and combined with kind-masked writes). Bit-identical
+        to the former six-branch ``lax.switch`` (verified state-for-state
+        over fault-schedule workloads with the bug switch on and off)."""
+        p = self.pcfg
+        n, L = p.n, p.log_cap
         kind = jnp.clip(ev.kind, 0, NUM_KINDS - 1)
-        return jax.lax.switch(kind, [mk(f) for f in branches], s, ev, now, rng)
+        me = jnp.clip(ev.dst, 0, n - 1)
+        pl = ev.payload
+        is_w = kind == K_WRITE
+        is_rep = kind == K_REPLICATE
+        is_ack = kind == K_ACK
+        is_cm = kind == K_COMMIT
+        is_hb = kind == K_HEARTBEAT
+        is_wd = kind == K_WATCHDOG
+
+        view_me = sel(s.view, me)
+        llen = sel(s.log_len, me)
+        epoch_me = sel(s.wd_epoch, me)
+        commit_me = sel(s.commit, me)
+        arange_n = jnp.arange(n)
+        i_am_primary = me == self._primary_of(view_me)
+
+        # One watchdog-delay draw serves replicate and watchdog (same
+        # range, same counter); the counter advances only for those kinds.
+        delay, rng_drawn = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
+        rng = rng._replace(counter=jnp.where(is_rep | is_wd,
+                                             rng_drawn.counter, rng.counter))
+
+        # -- write (primary appends) --
+        accept = is_w & i_am_primary & (llen < L)
+        pos_w = jnp.clip(llen, 0, L - 1)
+        llen_w = llen + accept.astype(jnp.int32)
+
+        # -- replicate (backup appends in order, adopts view) --
+        v_rep, idx_rep, cmd_rep = pl[0], pl[1], pl[2]
+        current = is_rep & (v_rep >= view_me)
+        view_rep = jnp.maximum(view_me, jnp.where(is_rep, v_rep, view_me))
+        in_order = current & (idx_rep == llen + 1) & (idx_rep <= L)
+        pos_r = jnp.clip(idx_rep - 1, 0, L - 1)
+
+        # -- ack (primary counts; commit on quorum) --
+        backup = jnp.clip(pl[2], 0, n - 1)
+        live_ack = is_ack & (pl[0] == view_me) & i_am_primary & \
+            (pl[1] >= 1) & (pl[1] <= L)
+        pos_a = jnp.clip(pl[1] - 1, 0, L - 1)
+        acks2 = sel2(s.acks, me, pos_a) | jnp.where(live_ack, 1 << backup, 0)
+        if p.buggy_commit_early:
+            # THE BUG: one ack is "enough". A fault schedule that kills
+            # the primary before the rest replicate loses the entry.
+            quorum = jax.lax.population_count(acks2) >= 2
+        else:
+            quorum = acks2 == jnp.int32((1 << n) - 1)
+        committed = live_ack & quorum & (pl[1] > commit_me)
+        commit_a = jnp.where(committed, pl[1], commit_me)
+        krange = jnp.arange(L)
+        fill = committed & (krange >= commit_me) & (krange < pl[1])
+
+        # -- commit message (backup adopts commit index) --
+        cm_current = is_cm & (pl[0] >= view_me)
+        commit_c = jnp.where(cm_current,
+                             jnp.maximum(commit_me, jnp.minimum(pl[1], llen)),
+                             commit_me)
+
+        # -- heartbeat --
+        live_hb = is_hb & (pl[0] == view_me) & i_am_primary
+
+        # -- watchdog (view change) --
+        epoch_ok = is_wd & (pl[1] == epoch_me)
+        fire = epoch_ok & ~(pl[0] < view_me) & ~i_am_primary
+        cand = view_me + ((me - self._primary_of(view_me)) % n + n) % n
+        view_wd = jnp.where(fire, jnp.maximum(cand, view_me + 1), view_me)
+        became_primary = fire & (me == self._primary_of(view_wd))
+
+        # -- combined single-position log/acks writes --
+        pos = jnp.where(is_rep, pos_r, jnp.where(is_ack, pos_a, pos_w))
+        cmd_at = sel2(s.log_cmd, me, pos)
+        ack_at = sel2(s.acks, me, pos)
+        log_cmd_new = jnp.where(in_order, cmd_rep,
+                                jnp.where(accept, pl[0], cmd_at))
+        acks_new = jnp.where(is_ack, acks2,
+                             jnp.where(accept, 1 << me, ack_at))
+
+        view2 = jnp.where(is_rep, view_rep, jnp.where(is_wd, view_wd, view_me))
+        epoch2 = epoch_me + current.astype(jnp.int32) + fire.astype(jnp.int32)
+
+        s2 = s._replace(
+            view=upd(s.view, me, view2),
+            log_cmd=upd2(s.log_cmd, me, pos, log_cmd_new),
+            log_len=upd(s.log_len, me, jnp.where(
+                in_order, idx_rep, jnp.where(is_w, llen_w, llen))),
+            acks=upd2(s.acks, me, pos, acks_new),
+            commit=upd(s.commit, me, jnp.where(
+                is_ack, commit_a, jnp.where(is_cm, commit_c, commit_me))),
+            wd_epoch=upd(s.wd_epoch, me, jnp.where(
+                is_rep | is_wd, epoch2, epoch_me)),
+            committed_cmd=jnp.where(fill, sel(s.log_cmd, me), s.committed_cmd),
+            committed_max=jnp.maximum(s.committed_max,
+                                      jnp.where(committed, pl[1], 0)),
+            views_changed=s.views_changed + fire.astype(jnp.int32),
+            writes_done=s.writes_done + accept.astype(jnp.int32),
+        )
+
+        # -- combined outbox --
+        primary_rep = self._primary_of(view_rep)
+        msg_valid = jnp.where(
+            is_rep, in_order & (arange_n == primary_rep),
+            jnp.where(is_ack, committed & (arange_n != me),
+                      (accept | live_hb | became_primary) & (arange_n != me)))
+        msg_kind = jnp.full((n,), jnp.where(
+            is_rep, K_ACK, jnp.where(is_ack, K_COMMIT, K_REPLICATE)),
+            jnp.int32)
+        w0 = jnp.where(is_rep | is_wd, view2, view_me)
+        w1 = jnp.where(is_w, llen_w,
+                       jnp.where(is_rep, idx_rep,
+                                 jnp.where(is_ack, commit_a, 0)))
+        w2 = jnp.where(is_w, pl[0], jnp.where(is_rep, me, 0))
+        msg_payload = self._bcast(cfg, [w0, w1, w2, 0])
+
+        timer_valid = current | live_hb | epoch_ok | fire
+        hb_timer = live_hb | became_primary
+        ob = self._outbox(
+            cfg,
+            msg_valid=msg_valid, msg_kind=msg_kind, msg_payload=msg_payload,
+            timer_valid=timer_valid,
+            timer_kind=jnp.where(hb_timer, K_HEARTBEAT,
+                                 K_WATCHDOG).astype(jnp.int32),
+            timer_dst=me,
+            timer_delay=jnp.where(hb_timer, jnp.int32(p.heartbeat_us),
+                                  delay).astype(jnp.int32),
+            timer_payload=self._pad(cfg, [
+                jnp.where(is_rep | is_wd, view2, view_me),
+                jnp.where(is_rep | is_wd, epoch2, 0)]))
+        return s2, ob, rng, jnp.asarray(False)
 
     # ------------------------------------------------------------------
     def invariant(self, cfg: EngineConfig, s: PBState) -> jnp.ndarray:
@@ -193,193 +321,10 @@ class PBActor:
         }
 
     # ==================================================================
-    # Handlers: (state, outbox, rng, bug)
+    # Helpers (same layout discipline as the Raft actor)
     # ==================================================================
     def _primary_of(self, view):
         return view % jnp.int32(self.pcfg.n)
-
-    def _on_write(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        me = jnp.clip(ev.dst, 0, n - 1)
-        cmd = ev.payload[0]
-        view_me = sel(s.view, me)
-        llen = sel(s.log_len, me)
-        is_primary = me == self._primary_of(view_me)
-        accept = is_primary & (llen < L)
-        pos = jnp.clip(llen, 0, L - 1)
-        llen2 = llen + accept.astype(jnp.int32)
-        s2 = s._replace(
-            log_cmd=upd2(s.log_cmd, me, pos, jnp.where(
-                accept, cmd, sel2(s.log_cmd, me, pos))),
-            log_len=upd(s.log_len, me, llen2),
-            acks=upd2(s.acks, me, pos, jnp.where(
-                accept, 1 << me, sel2(s.acks, me, pos))),
-            writes_done=s.writes_done + accept.astype(jnp.int32),
-        )
-        payload = self._bcast(cfg, [view_me, llen2, cmd, 0])
-        ob = self._outbox(
-            cfg,
-            msg_valid=accept & (jnp.arange(n) != me),
-            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
-            msg_payload=payload,
-            timer_valid=jnp.asarray(False), timer_kind=jnp.int32(0),
-            timer_dst=me, timer_delay=jnp.int32(0),
-            timer_payload=self._pad(cfg, []))
-        return s2, ob, rng, jnp.asarray(False)
-
-    def _on_replicate(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        me = jnp.clip(ev.dst, 0, n - 1)
-        v, idx, cmd = ev.payload[0], ev.payload[1], ev.payload[2]
-        view_me = sel(s.view, me)
-        # Adopt newer views from the primary's traffic.
-        view2 = jnp.maximum(view_me, v)
-        current = v >= view_me
-        # Append in order only (idx == len + 1); out-of-order is ignored
-        # (the primary's retransmit-free pipeline keeps this dense).
-        llen = sel(s.log_len, me)
-        in_order = current & (idx == llen + 1) & (idx <= L)
-        pos = jnp.clip(idx - 1, 0, L - 1)
-        # Primary sign-of-life (current traffic only): reset the watchdog.
-        epoch2 = sel(s.wd_epoch, me) + current.astype(jnp.int32)
-        s2 = s._replace(
-            view=upd(s.view, me, view2),
-            log_cmd=upd2(s.log_cmd, me, pos, jnp.where(
-                in_order, cmd, sel2(s.log_cmd, me, pos))),
-            log_len=upd(s.log_len, me, jnp.where(in_order, idx, llen)),
-            wd_epoch=upd(s.wd_epoch, me, epoch2),
-        )
-        payload = self._bcast(cfg, [view2, idx, me, 0])
-        primary = self._primary_of(view2)
-        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
-        ob = self._outbox(
-            cfg,
-            msg_valid=in_order & (jnp.arange(n) == primary),
-            msg_kind=jnp.full((n,), K_ACK, jnp.int32),
-            msg_payload=payload,
-            timer_valid=current, timer_kind=jnp.int32(K_WATCHDOG),
-            timer_dst=me, timer_delay=delay,
-            timer_payload=self._pad(cfg, [view2, epoch2]))
-        return s2, ob, rng, jnp.asarray(False)
-
-    def _on_ack(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        me = jnp.clip(ev.dst, 0, n - 1)
-        v, idx, backup = ev.payload[0], ev.payload[1], \
-            jnp.clip(ev.payload[2], 0, n - 1)
-        view_me = sel(s.view, me)
-        live = (v == view_me) & (me == self._primary_of(view_me)) & \
-            (idx >= 1) & (idx <= L)
-        pos = jnp.clip(idx - 1, 0, L - 1)
-        acks2 = sel2(s.acks, me, pos) | jnp.where(live, 1 << backup, 0)
-        all_mask = jnp.int32((1 << n) - 1)
-        quorum = acks2 == all_mask
-        if p.buggy_commit_early:
-            # THE BUG: one ack is "enough". A fault schedule that kills
-            # the primary before the rest replicate loses the entry.
-            quorum = jax.lax.population_count(acks2) >= 2
-        old_commit = sel(s.commit, me)
-        committed = live & quorum & (idx > old_commit)
-        commit2 = jnp.where(committed, idx, old_commit)
-        # Record the global committed prefix at commit time from the
-        # primary's own log — the WHOLE (old_commit, idx] range, not just
-        # slot idx: acks can arrive out of order, so a commit may jump
-        # several indices and every skipped slot is committed with it.
-        krange = jnp.arange(L)
-        fill = committed & (krange >= old_commit) & (krange < idx)
-        committed_cmd2 = jnp.where(fill, sel(s.log_cmd, me), s.committed_cmd)
-        s2 = s._replace(
-            acks=upd2(s.acks, me, pos, acks2),
-            commit=upd(s.commit, me, commit2),
-            committed_cmd=committed_cmd2,
-            committed_max=jnp.maximum(s.committed_max,
-                                      jnp.where(committed, idx, 0)),
-        )
-        payload = self._bcast(cfg, [view_me, commit2, 0, 0])
-        ob = self._outbox(
-            cfg,
-            msg_valid=committed & (jnp.arange(n) != me),
-            msg_kind=jnp.full((n,), K_COMMIT, jnp.int32),
-            msg_payload=payload,
-            timer_valid=jnp.asarray(False), timer_kind=jnp.int32(0),
-            timer_dst=me, timer_delay=jnp.int32(0),
-            timer_payload=self._pad(cfg, []))
-        return s2, ob, rng, jnp.asarray(False)
-
-    def _on_commit(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n = p.n
-        me = jnp.clip(ev.dst, 0, n - 1)
-        v, cidx = ev.payload[0], ev.payload[1]
-        current = v >= sel(s.view, me)
-        commit2 = jnp.where(current,
-                            jnp.maximum(sel(s.commit, me),
-                                        jnp.minimum(cidx, sel(s.log_len, me))),
-                            sel(s.commit, me))
-        s2 = s._replace(commit=upd(s.commit, me, commit2))
-        return s2, Outbox.empty(cfg), rng, jnp.asarray(False)
-
-    def _on_heartbeat(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n = p.n
-        me = jnp.clip(ev.dst, 0, n - 1)
-        view_me = sel(s.view, me)
-        live = (ev.payload[0] == view_me) & (me == self._primary_of(view_me))
-        # Heartbeats ride the replicate channel with idx 0 (kept by backups
-        # as a watchdog reset only).
-        payload = self._bcast(cfg, [view_me, 0, 0, 0])
-        ob = self._outbox(
-            cfg,
-            msg_valid=live & (jnp.arange(n) != me),
-            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
-            msg_payload=payload,
-            timer_valid=live, timer_kind=jnp.int32(K_HEARTBEAT), timer_dst=me,
-            timer_delay=jnp.int32(p.heartbeat_us),
-            timer_payload=self._pad(cfg, [view_me]))
-        return s, ob, rng, jnp.asarray(False)
-
-    def _on_watchdog(self, cfg, s: PBState, ev: Event, now, rng):
-        p = self.pcfg
-        n = p.n
-        me = jnp.clip(ev.dst, 0, n - 1)
-        view_me = sel(s.view, me)
-        # A watchdog is live only if nothing reset it since it was armed:
-        # every primary sign-of-life bumps wd_epoch and arms a fresh timer,
-        # so stale timers (old epoch or old view) are no-ops.
-        epoch_ok = ev.payload[1] == sel(s.wd_epoch, me)
-        stale = (ev.payload[0] < view_me) | ~epoch_ok
-        fire = ~stale & (me != self._primary_of(view_me))
-        # View change: bump until THIS node is primary of the new view
-        # (deterministic successor rule — the node whose watchdog fires
-        # first wins; others adopt its view from its heartbeats).
-        cand = view_me + ((me - self._primary_of(view_me)) % n + n) % n
-        view2 = jnp.where(fire, jnp.maximum(cand, view_me + 1), view_me)
-        became_primary = fire & (me == self._primary_of(view2))
-        s2 = s._replace(
-            view=upd(s.view, me, view2),
-            views_changed=s.views_changed + fire.astype(jnp.int32),
-        )
-        # New primary announces itself via heartbeat; a stale-timer holder
-        # re-arms its watchdog against the current epoch.
-        epoch2 = sel(s.wd_epoch, me) + fire.astype(jnp.int32)
-        s2 = s2._replace(wd_epoch=upd(s2.wd_epoch, me, epoch2))
-        payload = self._bcast(cfg, [view2, 0, 0, 0])
-        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
-        timer_kind = jnp.where(became_primary, K_HEARTBEAT, K_WATCHDOG)
-        timer_delay = jnp.where(became_primary, p.heartbeat_us, delay)
-        ob = self._outbox(
-            cfg,
-            msg_valid=became_primary & (jnp.arange(n) != me),
-            msg_kind=jnp.full((n,), K_REPLICATE, jnp.int32),
-            msg_payload=payload,
-            timer_valid=epoch_ok | fire,
-            timer_kind=timer_kind.astype(jnp.int32), timer_dst=me,
-            timer_delay=timer_delay.astype(jnp.int32),
-            timer_payload=self._pad(cfg, [view2, epoch2]))
-        return s2, ob, rng, jnp.asarray(False)
 
     # ==================================================================
     # Helpers (same layout discipline as the Raft actor)

@@ -49,18 +49,30 @@ def next_u32_vec(rng: DevRng, k: int) -> Tuple[jnp.ndarray, DevRng]:
     return xs, rng._replace(counter=rng.counter + jnp.uint32(k))
 
 
+def _u32_to_range(x, low, high) -> jnp.ndarray:
+    """Map uint32 draw(s) to [low, high) int32 — the ONE copy of the modulo
+    method (host GlobalRng.gen_range parity); scalar and vector draws must
+    share it or bit-identical replay breaks."""
+    width = jnp.uint32(jnp.asarray(high, jnp.int32) - jnp.asarray(low, jnp.int32))
+    return jnp.asarray(low, jnp.int32) + (x % width).astype(jnp.int32)
+
+
+def _u32_to_unit_f32(x) -> jnp.ndarray:
+    """Map uint32 draw(s) to [0, 1) float32 from the top 24 bits."""
+    return (x >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
 def uniform_u32(rng: DevRng, low, high) -> Tuple[jnp.ndarray, DevRng]:
     """Uniform integer in [low, high) as int32 (modulo method, like the host
     GlobalRng.gen_range). ``high`` must be > ``low``."""
     x, rng = next_u32(rng)
-    width = jnp.uint32(jnp.asarray(high, jnp.int32) - jnp.asarray(low, jnp.int32))
-    return jnp.asarray(low, jnp.int32) + (x % width).astype(jnp.int32), rng
+    return _u32_to_range(x, low, high), rng
 
 
 def uniform_f32(rng: DevRng) -> Tuple[jnp.ndarray, DevRng]:
     """Uniform float32 in [0, 1) from the top 24 bits of one draw."""
     x, rng = next_u32(rng)
-    return (x >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24), rng
+    return _u32_to_unit_f32(x), rng
 
 
 def bernoulli(rng: DevRng, p) -> Tuple[jnp.ndarray, DevRng]:
