@@ -111,11 +111,13 @@ class Endpoint:
         from ..core.backend import is_real
 
         if is_real():
-            # Production backend: the same tag-matching API over framed
-            # real TCP (`std/net/tcp.rs:20-324` analog).
-            from ..real.net import RealEndpoint
+            # Production backend: the same tag-matching API over a real
+            # framed transport — TCP by default, Unix sockets with
+            # MADSIM_REAL_TRANSPORT=uds (`std/net/tcp.rs:20-324` analog;
+            # transport selection mirrors the ucx/erpc feature flags).
+            from ..real.net import real_endpoint_class
 
-            return await RealEndpoint.bind(addr)
+            return await real_endpoint_class().bind(addr)
         socket = _EndpointSocket()
         guard = await BindGuard.bind(addr, IpProtocol.UDP, socket)
         return Endpoint(guard, socket)
@@ -125,9 +127,9 @@ class Endpoint:
         from ..core.backend import is_real
 
         if is_real():
-            from ..real.net import RealEndpoint
+            from ..real.net import real_endpoint_class
 
-            return await RealEndpoint.connect(addr)
+            return await real_endpoint_class().connect(addr)
         peer = (await lookup_host(addr))[0]
         ep = await Endpoint.bind("0.0.0.0:0")
         ep._peer = peer

@@ -22,6 +22,7 @@ send reconnects.
 from __future__ import annotations
 
 import asyncio
+import os
 import pickle
 import socket as _socket
 import struct
@@ -146,26 +147,43 @@ class RealEndpoint:
         self._closed = False
 
     # -- constructors ------------------------------------------------------
-    @staticmethod
-    async def bind(addr: AddrLike) -> "RealEndpoint":
+    @classmethod
+    async def bind(cls, addr: AddrLike) -> "RealEndpoint":
         host, port = await real_lookup(addr)
-        ep = RealEndpoint()
-        ep._server = await asyncio.start_server(ep._on_accept, host, port)
-        sock = ep._server.sockets[0]
+        ep = cls()
+        await ep._listen(host, port)
+        return ep
+
+    @classmethod
+    async def connect(cls, addr: AddrLike) -> "RealEndpoint":
+        peer = await real_lookup(addr)
+        ep = await cls.bind("0.0.0.0:0")
+        ep._peer = peer
+        return ep
+
+    # -- transport hooks (overridden by alternative wire transports) -------
+    async def _listen(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(self._on_accept, host, port)
+        sock = self._server.sockets[0]
         ip, bound_port = sock.getsockname()[:2]
         # A wildcard bind IP is not a routable peer-facing address:
         # local_addr() reports loopback (usable in-process), and each
         # outgoing handshake advertises that connection's interface IP.
-        ep._bound_wildcard = ip in ("0.0.0.0", "::")
-        ep._addr = ("127.0.0.1" if ep._bound_wildcard else ip, bound_port)
-        return ep
+        self._bound_wildcard = ip in ("0.0.0.0", "::")
+        self._addr = ("127.0.0.1" if self._bound_wildcard else ip, bound_port)
 
-    @staticmethod
-    async def connect(addr: AddrLike) -> "RealEndpoint":
-        peer = await real_lookup(addr)
-        ep = await RealEndpoint.bind("0.0.0.0:0")
-        ep._peer = peer
-        return ep
+    async def _dial(self, dst: Addr):
+        return await asyncio.open_connection(dst[0], dst[1])
+
+    def _advertised_addr(self, writer: asyncio.StreamWriter) -> str:
+        # Advertise the address the peer can reach our listener at. For a
+        # wildcard bind the bound IP is not routable, so use this
+        # connection's local interface IP — loopback for loopback peers,
+        # the NIC address cross-host.
+        adv_ip = self._addr[0]
+        if self._bound_wildcard:
+            adv_ip = writer.get_extra_info("sockname")[0]
+        return f"{adv_ip}:{self._addr[1]}"
 
     # -- introspection -----------------------------------------------------
     def local_addr(self) -> Addr:
@@ -248,7 +266,7 @@ class RealEndpoint:
             fut = asyncio.get_running_loop().create_future()
             self._conns[dst] = fut
             try:
-                reader, writer = await asyncio.open_connection(dst[0], dst[1])
+                reader, writer = await self._dial(dst)
             except BaseException as exc:
                 # Cancellation (or any failure) must not leave a forever-
                 # pending future cached: later senders would await it and
@@ -262,14 +280,8 @@ class RealEndpoint:
                     fut.exception()  # mark retrieved: no waiter may exist
                 raise
             try:
-                # Handshake: advertise the address the peer can reach our
-                # listener at. For a wildcard bind the bound IP is not
-                # routable, so use this connection's local interface IP —
-                # loopback for loopback peers, the NIC address cross-host.
-                adv_ip = self._addr[0]
-                if self._bound_wildcard:
-                    adv_ip = writer.get_extra_info("sockname")[0]
-                text = f"{adv_ip}:{self._addr[1]}".encode()
+                # Handshake: advertise our listener's canonical address.
+                text = self._advertised_addr(writer).encode()
                 writer.write(_HDR.pack(len(text)) + text)
                 await writer.drain()
                 self._spawn_reader(reader, writer, dst)
@@ -358,11 +370,117 @@ class RealEndpoint:
         return False
 
 
+class UdsEndpoint(RealEndpoint):
+    """The same framed tag protocol over Unix-domain sockets.
+
+    The analog of the reference's feature-selected alternative wire
+    transports behind one Endpoint API (UCX `std/net/ucx.rs`, eRPC
+    `std/net/erpc.rs`, chosen by Cargo feature): here the transport is
+    chosen by ``MADSIM_REAL_TRANSPORT=uds``, for same-host deployments
+    where the kernel UDS path beats loopback TCP. Addresses stay virtual
+    ``(ip, port)`` pairs — each maps to one socket file under
+    ``MADSIM_UDS_DIR`` (default ``$TMPDIR/madsim-uds-<uid>``) so
+    application code is transport-agnostic, like the reference keeping
+    ``SocketAddr`` across its UCX/eRPC backends.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._path: Optional[str] = None
+
+    @staticmethod
+    def _dir() -> str:
+        import tempfile
+
+        d = os.environ.get("MADSIM_UDS_DIR") or os.path.join(
+            tempfile.gettempdir(), f"madsim-uds-{os.getuid()}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @classmethod
+    def _path_for(cls, ip: str, port: int) -> str:
+        return os.path.join(cls._dir(), f"{ip}_{port}.sock")
+
+    async def _listen(self, host: str, port: int) -> None:
+        import errno
+
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        ephemeral = port == 0
+        for _attempt in range(32):
+            if ephemeral:
+                # Ephemeral "port": an unused path in the map directory.
+                # Collisions (two endpoints drawing the same port between
+                # the exists-check and the bind) fall through to
+                # EADDRINUSE below and redraw.
+                port = 49152 + int.from_bytes(os.urandom(2), "little") % 16384
+                if os.path.exists(self._path_for(host, port)):
+                    continue
+            path = self._path_for(host, port)
+            try:
+                self._server = await asyncio.start_unix_server(
+                    self._on_accept, path)
+            except OSError as exc:
+                if exc.errno != errno.EADDRINUSE:
+                    raise  # e.g. ENAMETOOLONG / EACCES — report faithfully
+                # A socket file exists. If nothing answers it, it's stale
+                # (dead process): reclaim the address, the systemd-style
+                # unlink-and-rebind convention.
+                try:
+                    _r, w = await asyncio.open_unix_connection(path)
+                except (ConnectionError, OSError):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    self._server = await asyncio.start_unix_server(
+                        self._on_accept, path)
+                else:
+                    w.close()
+                    if ephemeral:
+                        continue  # live listener won the race: redraw
+                    raise OSError(f"address {host}:{port} already in use (uds)")
+            self._path = path
+            self._addr = (host, port)
+            self._bound_wildcard = False
+            return
+        raise OSError("could not find a free ephemeral uds address")
+
+    async def _dial(self, dst: Addr):
+        return await asyncio.open_unix_connection(self._path_for(dst[0], dst[1]))
+
+    def _advertised_addr(self, writer: asyncio.StreamWriter) -> str:
+        return f"{self._addr[0]}:{self._addr[1]}"
+
+    def close(self) -> None:
+        was_closed = self._closed
+        super().close()
+        if not was_closed and self._path is not None:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+def real_endpoint_class() -> type:
+    """The Endpoint implementation selected by ``MADSIM_REAL_TRANSPORT``
+    (``tcp`` default; ``uds``/``unix`` for same-host Unix sockets) — the
+    env-var analog of the reference's transport feature flags."""
+    t = os.environ.get("MADSIM_REAL_TRANSPORT", "tcp").lower()
+    if t == "tcp":
+        return RealEndpoint
+    if t in ("uds", "unix"):
+        return UdsEndpoint
+    raise ValueError(f"unknown MADSIM_REAL_TRANSPORT {t!r} "
+                     "(expected 'tcp' or 'uds')")
+
+
 # The backend-generic RPC layer rides on the endpoint surface
 # (`std/net/rpc.rs` analog); attach the same ergonomic methods the sim
 # endpoint carries. Done here so sim-only runs never import this module.
 from ..net import rpc as _rpc  # noqa: E402
 
+# (Transport subclasses like UdsEndpoint inherit these.)
 RealEndpoint.call = _rpc.call  # type: ignore[attr-defined]
 RealEndpoint.call_with_data = _rpc.call_with_data  # type: ignore[attr-defined]
 RealEndpoint.add_rpc_handler = _rpc.add_rpc_handler  # type: ignore[attr-defined]

@@ -30,12 +30,20 @@ class Unhandled:
     x: int = 0
 
 
-@pytest.fixture(params=["sim", "real"])
-def mode(request, monkeypatch):
-    if request.param == "real":
+@pytest.fixture(params=["sim", "real", "real-uds"])
+def mode(request, monkeypatch, tmp_path):
+    if request.param.startswith("real"):
         monkeypatch.setenv("MADSIM_BACKEND", "real")
     else:
         monkeypatch.delenv("MADSIM_BACKEND", raising=False)
+    if request.param == "real-uds":
+        # Third leg of the matrix: the alternative real wire transport
+        # (Unix sockets) behind the same Endpoint API — the reference's
+        # ucx/erpc feature-flag analog.
+        monkeypatch.setenv("MADSIM_REAL_TRANSPORT", "uds")
+        monkeypatch.setenv("MADSIM_UDS_DIR", str(tmp_path / "uds"))
+    else:
+        monkeypatch.delenv("MADSIM_REAL_TRANSPORT", raising=False)
     return request.param
 
 
