@@ -387,6 +387,7 @@ class UdsEndpoint(RealEndpoint):
     def __init__(self):
         super().__init__()
         self._path: Optional[str] = None
+        self._lock_fd: Optional[int] = None
 
     @staticmethod
     def _dir() -> str:
@@ -403,43 +404,38 @@ class UdsEndpoint(RealEndpoint):
 
     async def _listen(self, host: str, port: int) -> None:
         import errno
+        import fcntl
 
         if host in ("0.0.0.0", "::"):
             host = "127.0.0.1"
         ephemeral = port == 0
         for _attempt in range(32):
             if ephemeral:
-                # Ephemeral "port": an unused path in the map directory.
-                # Collisions (two endpoints drawing the same port between
-                # the exists-check and the bind) fall through to
-                # EADDRINUSE below and redraw.
                 port = 49152 + int.from_bytes(os.urandom(2), "little") % 16384
-                if os.path.exists(self._path_for(host, port)):
-                    continue
             path = self._path_for(host, port)
+            # Address ownership is an flock on a sidecar file, held for the
+            # listener's lifetime: the kernel drops it when the owner dies,
+            # so "lock held" IS the liveness test — no probe-connect, and
+            # no window where two binders both decide a socket file is
+            # stale and unlink each other's fresh listener.
+            lock_fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o600)
             try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(lock_fd)
+                if ephemeral:
+                    continue  # a live listener owns this draw: redraw
+                raise OSError(errno.EADDRINUSE,
+                              f"address {host}:{port} already in use (uds)")
+            try:
+                if os.path.exists(path):
+                    os.unlink(path)  # stale socket of a dead owner
                 self._server = await asyncio.start_unix_server(
                     self._on_accept, path)
-            except OSError as exc:
-                if exc.errno != errno.EADDRINUSE:
-                    raise  # e.g. ENAMETOOLONG / EACCES — report faithfully
-                # A socket file exists. If nothing answers it, it's stale
-                # (dead process): reclaim the address, the systemd-style
-                # unlink-and-rebind convention.
-                try:
-                    _r, w = await asyncio.open_unix_connection(path)
-                except (ConnectionError, OSError):
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
-                    self._server = await asyncio.start_unix_server(
-                        self._on_accept, path)
-                else:
-                    w.close()
-                    if ephemeral:
-                        continue  # live listener won the race: redraw
-                    raise OSError(f"address {host}:{port} already in use (uds)")
+            except BaseException:
+                os.close(lock_fd)  # releases the flock
+                raise
+            self._lock_fd = lock_fd
             self._path = path
             self._addr = (host, port)
             self._bound_wildcard = False
@@ -460,6 +456,9 @@ class UdsEndpoint(RealEndpoint):
                 os.unlink(self._path)
             except OSError:
                 pass
+        if not was_closed and self._lock_fd is not None:
+            os.close(self._lock_fd)  # releases the address flock
+            self._lock_fd = None
 
 
 def real_endpoint_class() -> type:

@@ -110,12 +110,24 @@ class Builder:
                 return self._run_one(seed, make_coro)
             except BaseException:
                 config = self.config if self.config is not None else Config()
-                print(
+                banner = (
                     "note: run with environment variable "
                     f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"
-                    f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}",
-                    file=sys.stderr,
+                    f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}"
                 )
+                if sys.flags.hash_randomization:
+                    # The reference seeds std's RandomState so HashMap
+                    # iteration is part of the deterministic world
+                    # (`rand.rs:174-182`). Python dicts are insertion-
+                    # ordered (safe), but str/bytes SET iteration follows
+                    # the per-process randomized hash — flag it so a repro
+                    # in a fresh process can pin it.
+                    banner += (
+                        "\nnote: str-hash randomization is on; if this test"
+                        " iterates sets of str/bytes, reproduce with"
+                        " PYTHONHASHSEED pinned (e.g. PYTHONHASHSEED=0)"
+                    )
+                print(banner, file=sys.stderr)
                 raise
 
         if self.jobs == 1:

@@ -5,6 +5,7 @@ and `madsim/src/sim/task.rs:58-82` (per-node/per-task tracing spans).
 """
 import dataclasses
 import logging
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -198,3 +199,28 @@ def test_greeter_example_runs_deterministically():
     assert "world done" in a
     assert a == b, "same-seed example runs must be bit-identical"
     assert a != c
+
+
+def test_kv_store_example_finds_missing_fsync():
+    example = Path(__file__).resolve().parent.parent / "examples" / "kv_store.py"
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin",
+           "MADSIM_TEST_SEED": "0", "MADSIM_TEST_NUM": "8"}
+
+    clean = subprocess.run([sys.executable, str(example)], env=env,
+                           capture_output=True, text=True, timeout=180)
+    assert clean.returncode == 0, clean.stdout + clean.stderr[-500:]
+    assert "DURABILITY BUG" not in clean.stdout
+
+    buggy = subprocess.run([sys.executable, str(example), "--buggy"], env=env,
+                           capture_output=True, text=True, timeout=180)
+    assert buggy.returncode == 0, buggy.stdout + buggy.stderr[-500:]
+    assert "DURABILITY BUG" in buggy.stdout
+    assert "MADSIM_TEST_SEED=" in buggy.stdout  # repro line
+
+    # The failing seed reproduces in isolation: same seed, same bug.
+    m = re.search(r"MADSIM_TEST_SEED=(\d+)", buggy.stdout)
+    repro = subprocess.run(
+        [sys.executable, str(example), "--buggy"],
+        env={**env, "MADSIM_TEST_SEED": m.group(1), "MADSIM_TEST_NUM": "1"},
+        capture_output=True, text=True, timeout=120)
+    assert "DURABILITY BUG" in repro.stdout
