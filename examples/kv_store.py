@@ -149,11 +149,7 @@ async def world(buggy: bool):
         log.info("supervisor: restarting kv node at t=%.3f", vtime.monotonic())
         h.restart(server)
 
-    return await vtime.timeout(60, _await(done))
-
-
-async def _await(fut):
-    return await fut
+    return await vtime.timeout(60, done)
 
 
 def main():

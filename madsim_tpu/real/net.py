@@ -418,11 +418,18 @@ class UdsEndpoint(RealEndpoint):
             # so "lock held" IS the liveness test — no probe-connect, and
             # no window where two binders both decide a socket file is
             # stale and unlink each other's fresh listener.
+            # Lock files are deliberately never unlinked (removing one can
+            # race a new binder that already open()ed it, splitting the
+            # lock across two inodes); they are zero-byte and bounded by
+            # the port range.
             lock_fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o600)
             try:
                 fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            except OSError:
+            except OSError as exc:
                 os.close(lock_fd)
+                if exc.errno not in (errno.EAGAIN, errno.EWOULDBLOCK,
+                                     errno.EACCES):
+                    raise  # e.g. ENOLCK (no-flock fs): report faithfully
                 if ephemeral:
                     continue  # a live listener owns this draw: redraw
                 raise OSError(errno.EADDRINUSE,

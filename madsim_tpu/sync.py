@@ -12,7 +12,8 @@ from typing import Any, Deque, List, Optional
 
 from .core.futures import Channel, ChannelClosed, SimFuture  # noqa: F401 (re-export)
 
-__all__ = ["Event", "Barrier", "Lock", "Semaphore", "Notify", "Queue", "oneshot",
+__all__ = ["Event", "Barrier", "Lock", "RwLock", "Semaphore", "Notify",
+           "Queue", "oneshot", "watch", "broadcast", "Lagged",
            "Channel", "ChannelClosed", "SimFuture"]
 
 
@@ -208,3 +209,282 @@ class Queue:
 def oneshot() -> SimFuture:
     """A oneshot channel is just a future: sender calls set_result."""
     return SimFuture()
+
+
+class RwLock:
+    """Fair async reader-writer lock (tokio::sync::RwLock semantics: FIFO
+    fairness — a queued writer blocks later readers, so writers never
+    starve). ``async with rw.read(): ...`` / ``async with rw.write(): ...``.
+    Interrupt-safe like :class:`Lock`: a cancelled waiter that was already
+    handed the lock releases it onward."""
+
+    def __init__(self):
+        self._readers = 0
+        self._writer = False
+        self._waiters: Deque[tuple] = deque()  # ("r"|"w", SimFuture)
+
+    # -- guards ------------------------------------------------------------
+    class _Guard:
+        __slots__ = ("_rw", "_kind")
+
+        def __init__(self, rw: "RwLock", kind: str):
+            self._rw = rw
+            self._kind = kind
+
+        async def __aenter__(self):
+            await (self._rw.acquire_read() if self._kind == "r"
+                   else self._rw.acquire_write())
+            return self._rw
+
+        async def __aexit__(self, *exc):
+            (self._rw.release_read() if self._kind == "r"
+             else self._rw.release_write())
+            return False
+
+    def read(self) -> "_Guard":
+        return RwLock._Guard(self, "r")
+
+    def write(self) -> "_Guard":
+        return RwLock._Guard(self, "w")
+
+    # -- core --------------------------------------------------------------
+    async def acquire_read(self) -> None:
+        # Fairness: a new reader queues behind ANY waiter (else a stream
+        # of readers starves a queued writer forever).
+        if not self._writer and not self._waiters:
+            self._readers += 1
+            return
+        fut = SimFuture()
+        self._waiters.append(("r", fut))
+        await _await_waiter(
+            fut, _RwWaiterView(self._waiters), lambda _f: self.release_read())
+
+    async def acquire_write(self) -> None:
+        if not self._writer and self._readers == 0 and not self._waiters:
+            self._writer = True
+            return
+        fut = SimFuture()
+        self._waiters.append(("w", fut))
+        await _await_waiter(
+            fut, _RwWaiterView(self._waiters), lambda _f: self.release_write())
+
+    def release_read(self) -> None:
+        self._readers -= 1
+        if self._readers == 0:
+            self._wake()
+
+    def release_write(self) -> None:
+        self._writer = False
+        self._wake()
+
+    def _wake(self) -> None:
+        # Hand off in FIFO order: one writer, or every reader up to the
+        # next queued writer. Counters are charged at handoff time so a
+        # release racing the wakeup sees a consistent state.
+        while self._waiters:
+            kind, fut = self._waiters[0]
+            if fut.done():
+                self._waiters.popleft()
+                continue
+            if kind == "w":
+                if self._readers == 0 and not self._writer:
+                    self._waiters.popleft()
+                    self._writer = True
+                    fut.set_result(None)
+                return
+            if self._writer:
+                return
+            self._waiters.popleft()
+            self._readers += 1
+            fut.set_result(None)
+
+
+class _RwWaiterView:
+    """Adapter so _await_waiter's ``waiters.remove(fut)`` deregisters a
+    (kind, fut) entry from the RwLock queue."""
+
+    __slots__ = ("_q",)
+
+    def __init__(self, q):
+        self._q = q
+
+    def remove(self, fut) -> None:
+        for i, (_kind, f) in enumerate(self._q):
+            if f is fut:
+                del self._q[i]
+                return
+        raise ValueError
+
+
+# ---------------------------------------------------------------------------
+# watch channel (tokio::sync::watch): single slot, many observers
+# ---------------------------------------------------------------------------
+
+class _WatchShared:
+    __slots__ = ("value", "version", "closed", "waiters")
+
+    def __init__(self, value):
+        self.value = value
+        self.version = 0
+        self.closed = False
+        self.waiters: List[SimFuture] = []
+
+    def wake_all(self) -> None:
+        waiters, self.waiters = self.waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+
+class WatchSender:
+    def __init__(self, shared: _WatchShared):
+        self._shared = shared
+
+    def send(self, value) -> None:
+        if self._shared.closed:
+            raise ChannelClosed()
+        self._shared.value = value
+        self._shared.version += 1
+        self._shared.wake_all()
+
+    def borrow(self):
+        return self._shared.value
+
+    def close(self) -> None:
+        self._shared.closed = True
+        self._shared.wake_all()
+
+    def subscribe(self) -> "WatchReceiver":
+        return WatchReceiver(self._shared)
+
+
+class WatchReceiver:
+    """Observes the latest value; ``changed()`` waits for a version newer
+    than the last one this receiver saw (intermediate values may be
+    skipped — watch is last-write-wins, like the reference's)."""
+
+    def __init__(self, shared: _WatchShared):
+        self._shared = shared
+        self._seen = shared.version
+
+    def borrow(self):
+        return self._shared.value
+
+    def borrow_and_update(self):
+        self._seen = self._shared.version
+        return self._shared.value
+
+    async def changed(self) -> None:
+        while self._shared.version == self._seen:
+            if self._shared.closed:
+                raise ChannelClosed()
+            fut = SimFuture()
+            self._shared.waiters.append(fut)
+            try:
+                await fut
+            except BaseException:
+                if fut in self._shared.waiters:
+                    self._shared.waiters.remove(fut)
+                raise
+        self._seen = self._shared.version
+
+    def clone(self) -> "WatchReceiver":
+        rx = WatchReceiver(self._shared)
+        rx._seen = self._seen
+        return rx
+
+
+def watch(initial) -> tuple:
+    """``tx, rx = watch(initial)`` — a single-value channel where every
+    receiver sees the latest value and can await changes."""
+    shared = _WatchShared(initial)
+    return WatchSender(shared), WatchReceiver(shared)
+
+
+# ---------------------------------------------------------------------------
+# broadcast channel (tokio::sync::broadcast): ring buffer, lag detection
+# ---------------------------------------------------------------------------
+
+class Lagged(Exception):
+    """A slow receiver was overrun; ``skipped`` messages were dropped."""
+
+    def __init__(self, skipped: int):
+        super().__init__(f"lagged: {skipped} messages skipped")
+        self.skipped = skipped
+
+
+class _BroadcastShared:
+    __slots__ = ("buf", "head", "capacity", "closed", "waiters")
+
+    def __init__(self, capacity: int):
+        self.buf: Deque[Any] = deque()
+        self.head = 0  # sequence number of the NEXT message to be sent
+        self.capacity = capacity
+        self.closed = False
+        self.waiters: List[SimFuture] = []
+
+
+class BroadcastSender:
+    def __init__(self, shared: _BroadcastShared):
+        self._shared = shared
+
+    def send(self, value) -> None:
+        sh = self._shared
+        if sh.closed:
+            raise ChannelClosed()
+        sh.buf.append(value)
+        if len(sh.buf) > sh.capacity:
+            sh.buf.popleft()  # overrun the slowest receivers
+        sh.head += 1
+        waiters, sh.waiters = sh.waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    def close(self) -> None:
+        self._shared.closed = True
+        waiters, self._shared.waiters = self._shared.waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    def subscribe(self) -> "BroadcastReceiver":
+        # A new receiver sees only messages sent after it subscribes.
+        return BroadcastReceiver(self._shared, self._shared.head)
+
+
+class BroadcastReceiver:
+    def __init__(self, shared: _BroadcastShared, next_seq: int):
+        self._shared = shared
+        self._next = next_seq
+
+    async def recv(self):
+        sh = self._shared
+        while True:
+            oldest = sh.head - len(sh.buf)
+            if self._next < oldest:
+                skipped = oldest - self._next
+                self._next = oldest
+                raise Lagged(skipped)
+            if self._next < sh.head:
+                value = sh.buf[self._next - oldest]
+                self._next += 1
+                return value
+            if sh.closed:
+                raise ChannelClosed()
+            fut = SimFuture()
+            sh.waiters.append(fut)
+            try:
+                await fut
+            except BaseException:
+                if fut in sh.waiters:
+                    sh.waiters.remove(fut)
+                raise
+
+
+def broadcast(capacity: int) -> BroadcastSender:
+    """``tx = broadcast(16); rx = tx.subscribe()`` — multi-consumer fanout
+    with bounded history; slow receivers observe :class:`Lagged`."""
+    if capacity < 1:
+        raise ValueError("broadcast capacity must be >= 1")
+    return BroadcastSender(_BroadcastShared(capacity))

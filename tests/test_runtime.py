@@ -321,3 +321,142 @@ def test_check_determinism_catches_nondeterminism():
 
     with pytest.raises(ms.DeterminismError):
         ms.Runtime.check_determinism(42, None, main)
+
+
+# ---------------------------------------------------------------------------
+# Sync primitives: RwLock / watch / broadcast (tokio::sync parity)
+# ---------------------------------------------------------------------------
+
+def test_rwlock_readers_share_writers_exclude():
+    rt = ms.Runtime(seed=3)
+    events = []
+
+    async def main():
+        rw = sync.RwLock()
+        gate = sync.Event()
+
+        async def reader(name):
+            async with rw.read():
+                events.append(("r+", name))
+                await gate.wait()
+                events.append(("r-", name))
+
+        async def writer():
+            async with rw.write():
+                events.append("w")
+
+        r1 = task.spawn(reader("a"))
+        r2 = task.spawn(reader("b"))
+        await time.sleep(0.01)  # both readers inside
+        w = task.spawn(writer())
+        await time.sleep(0.01)
+        assert "w" not in events  # writer excluded while readers hold
+        gate.set()
+        await w
+        await r1
+        await r2
+        return events
+
+    out = rt.block_on(main())
+    # Both readers entered before the writer ran.
+    assert {e for e in out[:2]} == {("r+", "a"), ("r+", "b")}
+    assert out[-1] == "w" or out[-3] == "w"  # writer after reader releases
+
+
+def test_rwlock_fair_queued_writer_blocks_new_readers():
+    rt = ms.Runtime(seed=4)
+
+    async def main():
+        rw = sync.RwLock()
+        order = []
+        gate = sync.Event()
+
+        async def hold_read():
+            async with rw.read():
+                await gate.wait()
+
+        async def want_write():
+            async with rw.write():
+                order.append("w")
+
+        async def late_read():
+            async with rw.read():
+                order.append("r")
+
+        h = task.spawn(hold_read())
+        await time.sleep(0.01)
+        w = task.spawn(want_write())
+        await time.sleep(0.01)
+        r = task.spawn(late_read())  # queues BEHIND the writer (fairness)
+        await time.sleep(0.01)
+        gate.set()
+        await w
+        await r
+        await h
+        return order
+
+    assert rt.block_on(main()) == ["w", "r"]
+
+
+def test_watch_latest_value_and_skips():
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        tx, rx = sync.watch(0)
+        seen = []
+
+        async def observer():
+            while True:
+                try:
+                    await rx.changed()
+                except sync.ChannelClosed:
+                    return
+                seen.append(rx.borrow())
+
+        ob = task.spawn(observer())
+        tx.send(1)
+        tx.send(2)  # may coalesce with 1: watch is last-write-wins
+        await time.sleep(0.01)
+        tx.send(3)
+        await time.sleep(0.01)
+        tx.close()
+        await ob
+        return seen
+
+    seen = rt.block_on(main())
+    assert seen[-1] == 3 and 2 in seen  # latest always observed
+
+
+def test_broadcast_fanout_and_lag():
+    rt = ms.Runtime(seed=6)
+
+    async def main():
+        tx = sync.broadcast(2)
+        a, b = tx.subscribe(), tx.subscribe()
+        tx.send(1)
+        tx.send(2)
+        assert await a.recv() == 1 and await a.recv() == 2
+        assert await b.recv() == 1
+        # Overrun (capacity 2): after 3,4,5 only [4,5] remain; b (cursor at
+        # message 2) lost messages 2 and 3.
+        tx.send(3)
+        tx.send(4)
+        tx.send(5)
+        with pytest.raises(sync.Lagged) as ei:
+            await b.recv()
+        assert ei.value.skipped == 2
+        assert await b.recv() == 4
+        # A new subscriber only sees the future.
+        c = tx.subscribe()
+        tx.send(6)
+        assert await c.recv() == 6
+        # a (cursor at 3) lost message 3 to the overrun, then drains.
+        with pytest.raises(sync.Lagged):
+            await a.recv()
+        assert [await a.recv() for _ in range(2)] == [5, 6]
+        tx.close()
+        with pytest.raises(sync.ChannelClosed):
+            await a.recv()
+        return True
+
+    assert rt.block_on(main())
