@@ -418,14 +418,14 @@ class RaftActor:
     # Protocol: invariants (the bug flag)
     # ------------------------------------------------------------------
     def invariant(self, cfg: EngineConfig, s: RaftState) -> jnp.ndarray:
-        n = self.rcfg.n
-        # Election safety: at most one leader per term (models/raft.py
-        # InvariantChecker.on_become_leader).
-        is_leader = s.role == LEADER
-        same_term = s.term[:, None] == s.term[None, :]
-        pair = is_leader[:, None] & is_leader[None, :] & same_term
-        off_diag = ~jnp.eye(n, dtype=bool)
-        two_leaders = jnp.any(pair & off_diag)
+        # Election safety is enforced at win time by the won_terms bitset
+        # check in handle() (the host checker's on_become_leader
+        # semantics): a second win of any term raises the bug flag on the
+        # very step it happens, which strictly subsumes a per-step
+        # two-current-leaders scan — two live leaders in term T requires
+        # two wins of T, and roles only become LEADER via a win. Dropping
+        # the pairwise scan here saves O(N^2) per step with identical bug
+        # flags and timing (verified bitwise against the scanning version).
         # Log matching on committed prefixes (on_commit analog).
         L = self.rcfg.log_cap
         k = jnp.arange(L)
@@ -434,7 +434,7 @@ class RaftActor:
         diff = (s.log_term[:, None, :] != s.log_term[None, :, :]) | \
                (s.log_cmd[:, None, :] != s.log_cmd[None, :, :])
         log_mismatch = jnp.any(mask & diff)
-        return two_leaders | log_mismatch
+        return log_mismatch
 
     # ------------------------------------------------------------------
     # Protocol: observation

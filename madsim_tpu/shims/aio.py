@@ -575,6 +575,16 @@ def install() -> None:
 
     patch(_aio, "create_task", passthrough(_aio.create_task, _sim_create_task))
     patch(_aio, "ensure_future", passthrough(_aio.ensure_future, _sim_create_task))
+
+    async def _sim_to_thread(fn, /, *a, **kw):
+        # In-sim "thread offload" runs the callable as a deterministic task
+        # (madsim-tokio's spawn_blocking mapping); real threads inside a
+        # simulation would reintroduce scheduling nondeterminism.
+        from .. import task as _task_mod
+
+        return await _task_mod.spawn_blocking(lambda: fn(*a, **kw))
+
+    patch(_aio, "to_thread", passthrough(_aio.to_thread, _sim_to_thread))
     patch(_aio, "wait", passthrough(_aio.wait, wait))
     patch(_aio, "as_completed", passthrough(_aio.as_completed, as_completed))
     patch(_aio, "timeout", passthrough(_aio.timeout, timeout))

@@ -141,6 +141,33 @@ def test_patched_runs_unmodified_asyncio_code_deterministically():
     assert years <= {2022, 2023}
 
 
+def test_patched_to_thread_is_deterministic_in_sim():
+    # asyncio.to_thread inside a patched sim must run as a deterministic
+    # task (real threads would reintroduce scheduling nondeterminism) and
+    # still be real threads outside.
+    async def main():
+        import asyncio
+        import time as walltime
+
+        def work(x):
+            return (x * 2, walltime.monotonic())
+
+        pairs = await asyncio.gather(asyncio.to_thread(work, 1),
+                                     asyncio.to_thread(work, 2))
+        return pairs
+
+    with aio.patched():
+        a = ms.run(main(), seed=9)
+        b = ms.run(main(), seed=9)
+    assert a == b  # identical results AND identical virtual timestamps
+    assert [v for v, _t in a] == [2, 4]
+
+    import asyncio as real_asyncio
+    with aio.patched():
+        out = real_asyncio.run(main())  # outside sim: passthrough
+    assert [v for v, _t in out] == [2, 4]
+
+
 def test_patched_randrange_respects_step():
     async def main():
         import random
