@@ -34,10 +34,12 @@ from .checkpoint import load as load_checkpoint
 from .checkpoint import save as save_checkpoint
 from .pb_actor import PBActor, PBDeviceConfig
 from .raft_actor import RaftActor, RaftDeviceConfig
+from .tpc_actor import TPCActor, TPCDeviceConfig
 
 __all__ = [
     "DeviceEngine", "EngineConfig", "Event", "Outbox", "WorldState",
     "RaftActor", "RaftDeviceConfig", "PBActor", "PBDeviceConfig",
+    "TPCActor", "TPCDeviceConfig",
     "save_checkpoint", "load_checkpoint", "CheckpointError",
     "FAULT_KILL", "FAULT_RESTART", "FAULT_CLOG_NODE", "FAULT_UNCLOG_NODE",
     "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "INF_TIME",
