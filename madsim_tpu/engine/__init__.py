@@ -29,6 +29,7 @@ from .core import (
     FAULT_UNCLOG_LINK,
     INF_TIME,
 )
+from .conformance import ConformanceError, check_actor
 from .checkpoint import CheckpointError
 from .checkpoint import load as load_checkpoint
 from .checkpoint import save as save_checkpoint
@@ -40,6 +41,7 @@ __all__ = [
     "DeviceEngine", "EngineConfig", "Event", "Outbox", "WorldState",
     "RaftActor", "RaftDeviceConfig", "PBActor", "PBDeviceConfig",
     "TPCActor", "TPCDeviceConfig",
+    "check_actor", "ConformanceError",
     "save_checkpoint", "load_checkpoint", "CheckpointError",
     "FAULT_KILL", "FAULT_RESTART", "FAULT_CLOG_NODE", "FAULT_UNCLOG_NODE",
     "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "INF_TIME",

@@ -1,0 +1,146 @@
+"""Actor-protocol conformance checker.
+
+``check_actor(actor, cfg)`` validates a DeviceEngine actor implementation
+against the contract documented in docs/ACTORS.md, catching the mistakes
+that otherwise surface as cryptic trace-time errors or — worse — as silent
+nondeterminism deep inside a sweep:
+
+- the engine accepts it (num_kinds declared and within packed width);
+- state and outbox shapes are fixed and well-formed;
+- ``handle``/``on_restart``/``invariant`` are pure: same inputs ⇒ bitwise
+  same outputs across two traced evaluations;
+- runs are seed-deterministic end-to-end (two identical sweeps agree
+  bitwise) and distinct seeds actually diverge;
+- restart resets are exercised (a kill/restart fault schedule runs clean);
+- the RNG draw discipline holds on a sampled state: ``handle`` is
+  call-pure and advances the counter forward by a small bounded amount
+  per kind (state-dependent advances are legal — the merged-handler
+  pattern — so this is a sanity bound, not a proof of world-invariance).
+
+Returns a report dict; raises ``ConformanceError`` on the first violation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import DeviceEngine, EngineConfig, FAULT_KILL, FAULT_RESTART
+
+__all__ = ["check_actor", "ConformanceError"]
+
+
+class ConformanceError(AssertionError):
+    pass
+
+
+def _require(ok: bool, msg: str) -> None:
+    if not ok:
+        raise ConformanceError(msg)
+
+
+def check_actor(actor, cfg: EngineConfig, n_worlds: int = 64,
+                max_steps: int = 2_000) -> Dict[str, Any]:
+    """Validate ``actor`` against ``cfg``; see module docstring."""
+    _require(hasattr(actor, "handle") and hasattr(actor, "init")
+             and hasattr(actor, "invariant") and hasattr(actor, "observe")
+             and hasattr(actor, "on_restart"),
+             "actor must implement init/handle/on_restart/invariant/observe")
+    eng = DeviceEngine(actor, cfg)  # raises on num_kinds problems
+
+    seeds = np.arange(n_worlds)
+    state = eng.init(seeds)
+
+    # -- fixed shapes, int-family dtypes -------------------------------
+    for i, leaf in enumerate(jax.tree.leaves(state.astate)):
+        _require(leaf.shape[:1] == (n_worlds,),
+                 f"astate leaf {i} lacks the leading world axis: {leaf.shape}")
+        _require(jnp.issubdtype(leaf.dtype, jnp.integer)
+                 or leaf.dtype == jnp.bool_,
+                 f"astate leaf {i} has non-integer dtype {leaf.dtype} "
+                 "(device state must be int/bool for bitwise replay)")
+
+    # -- end-to-end determinism + seed sensitivity ---------------------
+    final_a = eng.run(eng.init(seeds), max_steps=max_steps)
+    final_b = eng.run(eng.init(seeds), max_steps=max_steps)
+    leaves_a, leaves_b = jax.tree.leaves(final_a), jax.tree.leaves(final_b)
+    for i, (a, b) in enumerate(zip(leaves_a, leaves_b)):
+        _require(np.array_equal(np.asarray(a), np.asarray(b)),
+                 f"two identical runs diverged at leaf {i}: "
+                 "handle/init is impure (Python-level randomness, "
+                 "iteration-order dependence, or global state)")
+    # Seeds must actually diverge somewhere in the world TRAJECTORY (actor
+    # state may legitimately converge to one canonical outcome — e.g. a
+    # replication log with no timestamps — but per-world clocks, step
+    # counts, queue contents, or counter advances must not all coincide).
+    # The RNG keys are excluded: they are seed-derived and always
+    # distinct, which would make this check vacuous.
+    trajectory = ([final_a.now, final_a.steps, final_a.delivered,
+                   final_a.qmax, final_a.rng.counter]
+                  + jax.tree.leaves(final_a.astate)
+                  + jax.tree.leaves(final_a.queue))
+    distinct = any(
+        len(np.unique(np.asarray(x).reshape(n_worlds, -1), axis=0)) > 1
+        for x in trajectory)
+    _require(distinct,
+             f"all {n_worlds} seeds produced bitwise-identical "
+             "trajectories — nothing consumed randomness or virtual time; "
+             "is init wiring the RNG through?")
+
+    # -- RNG discipline: handle() is pure and its counter consumption per
+    # kind is small and monotone (a handler may advance conditionally —
+    # the merged-handler pattern — but never backwards or unboundedly).
+    from .queue import Event
+    from .rng import make_rng
+
+    rng0 = make_rng(jnp.uint32(1), jnp.uint32(0), 99)
+    astate0 = jax.tree.map(lambda x: x[0], final_a.astate)
+    draws_per_kind = []
+    for kind in range(actor.num_kinds):
+        ev = Event.make(time=1000, kind=kind,
+                        payload_words=cfg.payload_words, src=0, dst=0,
+                        payload=[0])
+        s1, ob1, rng_out, bug1 = actor.handle(cfg, astate0, ev,
+                                              jnp.int32(1000), rng0)
+        s2, ob2, rng_out2, bug2 = actor.handle(cfg, astate0, ev,
+                                               jnp.int32(1000), rng0)
+        for i, (a, b) in enumerate(zip(jax.tree.leaves((s1, ob1, rng_out, bug1)),
+                                       jax.tree.leaves((s2, ob2, rng_out2, bug2)))):
+            _require(np.array_equal(np.asarray(a), np.asarray(b)),
+                     f"handle(kind={kind}) is impure: leaf {i} differs "
+                     "between two calls on identical inputs")
+        delta = int(np.asarray(rng_out.counter) - np.asarray(rng0.counter))
+        _require(0 <= delta <= 64,
+                 f"kind {kind} consumed {delta} draws — counter must "
+                 "advance forward by a small bounded amount")
+        draws_per_kind.append(delta)
+
+    # -- restart path runs clean under a kill/restart schedule ---------
+    faults = np.array([[cfg.t_limit_us // 4, FAULT_KILL, 0, 0],
+                       [cfg.t_limit_us // 2, FAULT_RESTART, 0, 0]], np.int32)
+    final_f = eng.run(eng.init(seeds, faults=faults), max_steps=max_steps)
+    obs = eng.observe(final_f)
+    _require(not obs["overflow"].any(),
+             f"queue overflow under restart schedule (qmax="
+             f"{int(obs['qmax'].max())}): raise cfg.queue_cap")
+    _require(not obs["bug"].any(),
+             f"invariant violated in {int(obs['bug'].sum())}/{n_worlds} "
+             "worlds under a plain kill/restart schedule — on_restart "
+             "corrupts durable state (or the clean config has a real bug)")
+
+    # -- observe() respects the batch axis -----------------------------
+    for key, val in obs.items():
+        _require(np.asarray(val).shape[:1] == (n_worlds,),
+                 f"observe()[{key!r}] lost the world axis "
+                 f"(shape {np.asarray(val).shape}); reduce node axes with "
+                 "axis=-1/-2, not axis=0")
+
+    return {
+        "n_worlds": n_worlds,
+        "steps_mean": float(np.asarray(final_a.steps).mean()),
+        "draws_per_kind": draws_per_kind,
+        "bug_rate": float(np.asarray(final_a.bug).mean()),
+        "qmax": int(np.asarray(obs["qmax"]).max()),
+    }

@@ -1,0 +1,93 @@
+"""Actor-protocol conformance checker tests (engine/conformance.py)."""
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import (
+    ConformanceError, EngineConfig, Outbox,
+    PBActor, PBDeviceConfig, RaftActor, RaftDeviceConfig,
+    TPCActor, TPCDeviceConfig, check_actor,
+)
+
+
+def test_all_shipped_actors_conform():
+    cases = [
+        (RaftActor(RaftDeviceConfig(n=3, n_proposals=2)),
+         EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=2_000_000)),
+        (PBActor(PBDeviceConfig(n=3, n_writes=3)),
+         EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=2_000_000)),
+        (TPCActor(TPCDeviceConfig(n=4, n_txns=4)),
+         EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                      t_limit_us=2_000_000)),
+    ]
+    for actor, cfg in cases:
+        report = check_actor(actor, cfg, n_worlds=32, max_steps=3_000)
+        assert report["bug_rate"] == 0.0
+        assert report["steps_mean"] > 1
+        assert all(0 <= d <= 8 for d in report["draws_per_kind"])
+
+
+def test_impure_handler_is_caught():
+    import itertools
+
+    counter = itertools.count()  # Python-level state: the impurity
+
+    class Impure(RaftActor):
+        def handle(self, cfg, s, ev, now, rng):
+            s2, ob, rng2, bug = super().handle(cfg, s, ev, now, rng)
+            # Sneak host-side mutable state into the trace: each CALL bakes
+            # a different constant in, so two runs (fresh traces) differ.
+            leak = jnp.int32(next(counter))
+            return s2._replace(elections_won=s2.elections_won + 0 * leak
+                               + leak), ob, rng2, bug
+
+    actor = Impure(RaftDeviceConfig(n=3))
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000)
+    with pytest.raises(ConformanceError, match="impure|diverged"):
+        check_actor(actor, cfg, n_worlds=16, max_steps=1_000)
+
+
+def test_float_state_is_rejected():
+    class FloatState(RaftActor):
+        def init(self, cfg, rng):
+            s, evs, rng = super().init(cfg, rng)
+            return s._replace(
+                first_leader_time=jnp.float32(s.first_leader_time)), evs, rng
+
+    actor = FloatState(RaftDeviceConfig(n=3))
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000)
+    with pytest.raises(ConformanceError, match="dtype"):
+        check_actor(actor, cfg, n_worlds=16, max_steps=500)
+
+
+def test_seed_insensitive_actor_is_caught():
+    class Frozen:
+        num_kinds = 1
+
+        def init(self, cfg, rng):
+            from madsim_tpu.engine.queue import Event
+
+            s = {"x": jnp.zeros((cfg.n_nodes,), jnp.int32)}
+            evs = [Event.make(time=10, kind=0,
+                              payload_words=cfg.payload_words)]
+            return s, evs, rng
+
+        def handle(self, cfg, s, ev, now, rng):
+            return s, Outbox.empty(cfg), rng, jnp.asarray(False)
+
+        def on_restart(self, cfg, s, node, now, rng):
+            return s, Outbox.empty(cfg), rng
+
+        def invariant(self, cfg, s):
+            return jnp.asarray(False)
+
+        def observe(self, cfg, s):
+            return {"x0": s["x"][..., 0]}
+
+    cfg = EngineConfig(n_nodes=2, outbox_cap=3, queue_cap=8,
+                       t_limit_us=1_000_000)
+    with pytest.raises(ConformanceError, match="randomness"):
+        check_actor(Frozen(), cfg, n_worlds=16, max_steps=100)
