@@ -554,6 +554,24 @@ def bench_crosscheck(n_worlds: int) -> dict:
     out_f = crosscheck_backends(eng2, np.arange(n_worlds), faults=faults,
                                 max_steps=5_000)
     out["bitwise_equal_with_faults"] = out_f["bitwise_equal"]
+    # The contract holds for every actor family, not just the flagship:
+    # primary-backup and two-phase-commit crosscheck bitwise too (smaller
+    # batches — the point is coverage, not throughput).
+    from madsim_tpu.engine import (PBActor, PBDeviceConfig, TPCActor,
+                                   TPCDeviceConfig)
+
+    pb = DeviceEngine(
+        PBActor(PBDeviceConfig(n=3, n_writes=4)),
+        EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.05))
+    out["bitwise_equal_pb"] = crosscheck_backends(
+        pb, np.arange(min(n_worlds, 1024)), max_steps=5_000)["bitwise_equal"]
+    tpc = DeviceEngine(
+        TPCActor(TPCDeviceConfig(n=4, n_txns=4, buggy_presumed_commit=True)),
+        EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.1))
+    out["bitwise_equal_tpc"] = crosscheck_backends(
+        tpc, np.arange(min(n_worlds, 1024)), max_steps=5_000)["bitwise_equal"]
     log(f"crosscheck: {out}")
     return out
 

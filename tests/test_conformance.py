@@ -63,6 +63,23 @@ def test_float_state_is_rejected():
         check_actor(actor, cfg, n_worlds=16, max_steps=500)
 
 
+def test_handler_dtype_drift_is_caught():
+    class Drift(RaftActor):
+        def handle(self, cfg, s, ev, now, rng):
+            s2, ob, rng2, bug = super().handle(cfg, s, ev, now, rng)
+            # A handler that floats a leaf mid-run: the classic cryptic
+            # while-loop carry mismatch, surfaced as ConformanceError.
+            return s2._replace(
+                elections_won=s2.elections_won * jnp.float32(1.0)), \
+                ob, rng2, bug
+
+    actor = Drift(RaftDeviceConfig(n=3))
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000)
+    with pytest.raises(ConformanceError, match="carry mismatch|dtype"):
+        check_actor(actor, cfg, n_worlds=16, max_steps=500)
+
+
 def test_seed_insensitive_actor_is_caught():
     class Frozen:
         num_kinds = 1
