@@ -68,22 +68,22 @@ def check_actor(actor, cfg: EngineConfig, n_worlds: int = 64,
     except TypeError as exc:
         # A while-loop carry mismatch means handle()/on_restart changed a
         # leaf's shape or dtype mid-run — surface it as conformance.
-        raise ConformanceError(
-            "handle()/on_restart() changed the state pytree's structure, "
-            f"shapes, or dtypes (jit carry mismatch): {exc}") from exc
+        # Unrelated TypeErrors (wrong handle() signature, bad payload
+        # indexing) re-raise untouched so the diagnosis stays accurate.
+        text = str(exc)
+        if any(marker in text for marker in
+               ("carry", "body_fun", "while_loop", "same type structure",
+                "pytree structure")):
+            raise ConformanceError(
+                "handle()/on_restart() changed the state pytree's "
+                f"structure, shapes, or dtypes (jit carry mismatch): {exc}"
+            ) from exc
+        raise
     obs_clean = eng.observe(final_a)
     _require(not obs_clean["overflow"].any(),
              f"queue overflow in the clean run (qmax="
              f"{int(obs_clean['qmax'].max())}): raise cfg.queue_cap — all "
              "later checks would run on silently-lossy trajectories")
-    # Re-check shapes/dtypes on the RUN state: handle()/on_restart() must
-    # preserve them (a drifted dtype otherwise dies as a cryptic while-loop
-    # carry mismatch inside jit).
-    for i, leaf in enumerate(jax.tree.leaves(final_a.astate)):
-        _require(jnp.issubdtype(leaf.dtype, jnp.integer)
-                 or leaf.dtype == jnp.bool_,
-                 f"astate leaf {i} has dtype {leaf.dtype} after running — "
-                 "a handler introduced non-integer state")
     final_b = eng.run(eng.init(seeds), max_steps=max_steps)
     leaves_a, leaves_b = jax.tree.leaves(final_a), jax.tree.leaves(final_b)
     for i, (a, b) in enumerate(zip(leaves_a, leaves_b)):

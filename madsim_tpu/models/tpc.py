@@ -1,0 +1,179 @@
+"""Two-phase commit on the HOST engine: the device TPC actor's twin.
+
+Same protocol and same injected bug as :mod:`madsim_tpu.engine.tpc_actor`,
+written as ordinary Python coroutines against the framework API (Endpoint
+RPC, timers, seeded randomness) — the second workload family with
+implementations on BOTH engines, so host↔device cross-validation
+(bug-rate comparison, tests/test_crossvalidation.py) does not rest on the
+Raft pair alone.
+
+Node 0 coordinates; participants vote yes/no (no with probability
+``no_vote_p``, drawn from the world's seeded RNG), abort unilaterally on a
+no-vote, and apply the coordinator's decision. The coordinator commits iff
+every vote arrived yes within the timeout; on timeout it aborts — unless
+``buggy_presumed_commit``, which presumes commit and violates atomicity
+whenever a no-vote (or a PREPARE) was lost to the network.
+
+The invariant is checked at apply time by a world-global
+:class:`TPCChecker`: any transaction recorded both COMMIT and ABORT raises
+:class:`TPCInvariantViolation`, failing the simulation like the device
+engine's bug flag fails the world.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import madsim_tpu as ms
+from madsim_tpu import rand, task, time
+from madsim_tpu.net import Endpoint
+from madsim_tpu.net import rpc as msrpc
+
+COMMIT, ABORT = 1, 2
+
+
+class TPCInvariantViolation(AssertionError):
+    """Atomicity broken: a txn committed at one node, aborted at another."""
+
+
+@dataclass
+class Prepare:
+    txn: int
+
+
+@dataclass
+class Decide:
+    txn: int
+    decision: int
+
+
+class TPCChecker:
+    """Apply-time atomicity record across every node of one world."""
+
+    def __init__(self):
+        self.applied: Dict[int, Dict[int, int]] = {}  # txn -> node -> outcome
+
+    def record(self, node: int, txn: int, decision: int) -> None:
+        per = self.applied.setdefault(txn, {})
+        per[node] = decision
+        outcomes = set(per.values())
+        if COMMIT in outcomes and ABORT in outcomes:
+            raise TPCInvariantViolation(
+                f"txn {txn} committed at "
+                f"{[n for n, d in per.items() if d == COMMIT]} but aborted "
+                f"at {[n for n, d in per.items() if d == ABORT]}")
+
+
+class Participant:
+    """Votes on PREPARE (once, idempotently) and applies DECIDE."""
+
+    def __init__(self, idx: int, checker: TPCChecker, no_vote_p: float):
+        self.idx = idx
+        self.checker = checker
+        self.no_vote_p = no_vote_p
+        self.votes: Dict[int, bool] = {}
+        self.applied: Dict[int, int] = {}
+
+    async def serve(self, addr) -> None:
+        ep = await Endpoint.bind(addr)
+
+        async def on_prepare(req: Prepare) -> bool:
+            if req.txn not in self.votes:
+                vote_no = rand.thread_rng().gen_bool(self.no_vote_p)
+                self.votes[req.txn] = not vote_no
+                if vote_no:
+                    # Unilateral abort: no lock is held for a rejected txn.
+                    self.applied[req.txn] = ABORT
+                    self.checker.record(self.idx, req.txn, ABORT)
+            return self.votes[req.txn]
+
+        async def on_decide(req: Decide) -> bool:
+            if req.txn not in self.applied:
+                self.applied[req.txn] = req.decision
+                self.checker.record(self.idx, req.txn, req.decision)
+            return True
+
+        msrpc.add_rpc_handler(ep, Prepare, on_prepare)
+        msrpc.add_rpc_handler(ep, Decide, on_decide)
+        await time.sleep(3600.0)
+
+
+class Coordinator:
+    """Runs one 2PC round per scheduled transaction."""
+
+    def __init__(self, checker: TPCChecker, participants: List[str],
+                 vote_timeout: float, buggy_presumed_commit: bool):
+        self.checker = checker
+        self.participants = participants
+        self.vote_timeout = vote_timeout
+        self.buggy = buggy_presumed_commit
+        self.decided: Dict[int, int] = {}
+
+    async def run_txn(self, ep: Endpoint, txn: int) -> int:
+        async def ask(addr) -> Optional[bool]:
+            try:
+                return await msrpc.call(ep, addr, Prepare(txn),
+                                        timeout=self.vote_timeout)
+            except TimeoutError:
+                return None  # lost PREPARE or lost vote
+
+        votes = [await h for h in
+                 [task.spawn(ask(a)) for a in self.participants]]
+        if all(v is True for v in votes):
+            decision = COMMIT
+        elif any(v is False for v in votes):
+            decision = ABORT
+        else:
+            # Stragglers only: the timeout decision — the bug switch.
+            decision = COMMIT if self.buggy else ABORT
+        self.decided[txn] = decision
+        # The coordinator applies its own decision too (its durable log).
+        self.checker.record(0, txn, decision)
+        for addr in self.participants:
+            try:
+                await msrpc.call(ep, addr, Decide(txn, decision),
+                                 timeout=self.vote_timeout)
+            except TimeoutError:
+                pass  # lost DECIDE: that participant stays blocked
+        return decision
+
+
+async def run_tpc_world(n: int = 4, n_txns: int = 6, no_vote_p: float = 0.125,
+                        vote_timeout: float = 0.06,
+                        txn_interval: float = 0.12,
+                        buggy_presumed_commit: bool = False) -> Dict[str, int]:
+    """Build an n-node world, run the txn schedule, return outcome counts.
+
+    Raises :class:`TPCInvariantViolation` when atomicity breaks (buggy
+    mode under packet loss). Mirrors the device actor's shape: same vote
+    probability, timeout-vs-interval ratio, and decision rules.
+    """
+    h = ms.Handle.current()
+    checker = TPCChecker()
+    addrs = [f"10.0.0.{i + 2}:400{i}" for i in range(n - 1)]
+    for i, addr in enumerate(addrs):
+        part = Participant(i + 1, checker, no_vote_p)
+
+        def init(p=part, a=addr):
+            async def body():
+                await p.serve(a)
+            return body
+
+        h.create_node(name=f"part{i + 1}", ip=f"10.0.0.{i + 2}", init=init())
+
+    coord = Coordinator(checker, addrs, vote_timeout, buggy_presumed_commit)
+    done = ms.sync.SimFuture()
+
+    async def coord_body():
+        await time.sleep(0.05)  # participants bind
+        ep = await Endpoint.bind("10.0.0.1:4100")
+        for t in range(n_txns):
+            await coord.run_txn(ep, t)
+            await time.sleep(txn_interval)
+        done.set_result(True)
+
+    h.create_node(name="coord", ip="10.0.0.1", init=lambda: coord_body())
+    await time.timeout(120.0, done)
+    outcomes = list(coord.decided.values())
+    return {"commits": outcomes.count(COMMIT),
+            "aborts": outcomes.count(ABORT)}

@@ -144,3 +144,54 @@ def test_crosscheck_cpu_devices_bit_identical():
     out = crosscheck_backends(eng, np.arange(64), max_steps=2_000,
                               device_a=devs[0], device_b=devs[-1])
     assert out["bitwise_equal"] == 1
+
+
+def test_tpc_bug_rates_comparable_host_vs_device():
+    """Second cross-engine family (alongside Raft): the presumed-commit
+    bug must be found by BOTH engines at comparable per-seed densities
+    under the same loss rate, vote probability, and timeout ratios."""
+    import madsim_tpu as ms
+    from madsim_tpu.engine import DeviceEngine, EngineConfig, TPCActor, TPCDeviceConfig
+    from madsim_tpu.models.tpc import run_tpc_world, TPCInvariantViolation
+
+    loss = 0.1
+
+    # Host: sequential seeds.
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = loss
+    n_host = 48
+    host_hits = 0
+    for seed in range(n_host):
+        rt = ms.Runtime(seed=seed, config=cfg)
+        rt.set_time_limit(60.0)
+        try:
+            rt.block_on(run_tpc_world(buggy_presumed_commit=True))
+        except TPCInvariantViolation:
+            host_hits += 1
+    host_rate = host_hits / n_host
+
+    # Device: one vmapped batch, matched protocol constants.
+    eng = DeviceEngine(
+        TPCActor(TPCDeviceConfig(n=4, n_txns=6, buggy_presumed_commit=True)),
+        EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                     t_limit_us=2_000_000, loss_rate=loss))
+    obs = eng.observe(eng.run(eng.init(np.arange(2048)), max_steps=8000))
+    dev_rate = obs["bug"].mean()
+
+    assert host_hits > 0, "host engine never found the presumed-commit bug"
+    assert dev_rate > 0, "device engine never found the presumed-commit bug"
+    ratio = host_rate / dev_rate
+    assert 0.1 <= ratio <= 10.0, \
+        f"bug densities diverge: host {host_rate:.3f} vs device {dev_rate:.3f}"
+
+    # And both clean variants stay silent under the same chaos.
+    clean_eng = DeviceEngine(
+        TPCActor(TPCDeviceConfig(n=4, n_txns=6)),
+        EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                     t_limit_us=2_000_000, loss_rate=loss))
+    assert not clean_eng.observe(
+        clean_eng.run(clean_eng.init(np.arange(512)), max_steps=8000))["bug"].any()
+    for seed in range(12):
+        rt = ms.Runtime(seed=seed, config=cfg)
+        rt.set_time_limit(60.0)
+        rt.block_on(run_tpc_world())  # must not raise
