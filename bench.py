@@ -140,7 +140,11 @@ def bench_rpc_real(n_rounds: int) -> dict:
     import os
 
     prior_backend = os.environ.get("MADSIM_BACKEND")
+    prior_transport = os.environ.get("MADSIM_REAL_TRANSPORT")
     os.environ["MADSIM_BACKEND"] = "real"
+    # Pin the first leg to TCP explicitly so a pre-set uds env can't turn
+    # the tcp-vs-uds comparison into uds-vs-uds with a wrong label.
+    os.environ["MADSIM_REAL_TRANSPORT"] = "tcp"
     try:
         import madsim_tpu as ms
         from madsim_tpu.net import Endpoint, rpc
@@ -172,13 +176,23 @@ def bench_rpc_real(n_rounds: int) -> dict:
             dt = ms.run(world(b"\xab" * size, data_rounds))
             rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
         out["payload_mb_per_sec"] = rates
-        log(f"rpc_real (production TCP backend): {out}")
+        # The alternative wire transport (Unix sockets) on the same world:
+        # same frames, kernel UDS path instead of loopback TCP.
+        os.environ["MADSIM_REAL_TRANSPORT"] = "uds"
+        dt = ms.run(world(b"", n_rounds))
+        out["uds_empty_rpc_roundtrips_per_sec"] = round(n_rounds / dt, 2)
+        out["uds_empty_rpc_latency_us"] = round(dt / n_rounds * 1e6, 1)
+        log(f"rpc_real (production backend, tcp + uds): {out}")
         return out
     finally:
         if prior_backend is None:
             os.environ.pop("MADSIM_BACKEND", None)
         else:
             os.environ["MADSIM_BACKEND"] = prior_backend
+        if prior_transport is None:
+            os.environ.pop("MADSIM_REAL_TRANSPORT", None)
+        else:
+            os.environ["MADSIM_REAL_TRANSPORT"] = prior_transport
 
 
 # ---------------------------------------------------------------------------

@@ -377,7 +377,9 @@ class UdsEndpoint(RealEndpoint):
     transports behind one Endpoint API (UCX `std/net/ucx.rs`, eRPC
     `std/net/erpc.rs`, chosen by Cargo feature): here the transport is
     chosen by ``MADSIM_REAL_TRANSPORT=uds``, for same-host deployments
-    where the kernel UDS path beats loopback TCP. Addresses stay virtual
+    that want filesystem-scoped addressing and permissions instead of the
+    shared TCP port namespace (latency is comparable to loopback TCP —
+    bench.py measures both). Addresses stay virtual
     ``(ip, port)`` pairs — each maps to one socket file under
     ``MADSIM_UDS_DIR`` (default ``$TMPDIR/madsim-uds-<uid>``) so
     application code is transport-agnostic, like the reference keeping

@@ -289,6 +289,32 @@ def test_trace_includes_faults():
     assert all(e.get("dropped") for e in dead_window)
 
 
+def test_queue_meta_packing_roundtrip():
+    from madsim_tpu.engine.queue import pack_meta, unpack_meta
+
+    # Full-width corners incl. gen=255 (sets the int32 sign bit packed).
+    for kind, flags, src, dst, gen in [(0, 0, 0, 0, 0), (63, 3, 255, 255, 255),
+                                       (7, 1, 3, 200, 128), (42, 2, 17, 0, 1)]:
+        meta = pack_meta(jnp.int32(kind), jnp.int32(flags), jnp.int32(src),
+                         jnp.int32(dst), jnp.int32(gen))
+        k, f, s, d, g = (int(x) for x in unpack_meta(meta))
+        assert (k, f, s, d, g) == (kind, flags, src, dst, gen)
+
+
+def test_queue_inf_time_event_is_dropped_not_stored():
+    q = empty_queue(2, 4)
+    # An event at INF_TIME would alias the free-slot sentinel: it is
+    # dropped at push (ok=True — it could never fire anyway) and consumes
+    # no capacity.
+    q, ok = push(q, Event.make(time=int(INF_TIME), kind=1, payload_words=4))
+    assert bool(ok)
+    q, ok1 = push(q, Event.make(time=5, kind=2, payload_words=4))
+    q, ok2 = push(q, Event.make(time=6, kind=3, payload_words=4))
+    assert bool(ok1) and bool(ok2)  # both real slots were still free
+    q, ev, found = pop(q)
+    assert bool(found) and int(ev.kind) == 2
+
+
 def test_packed_width_guards(raft_engine):
     # Fault rows are validated at the init() boundary: the packed queue
     # stores node ids in 8 bits, so out-of-range ids must error rather
