@@ -29,7 +29,7 @@ import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..net.addr import Addr, AddrLike, AddrParseError, lookup_host
-from ..net.network import BrokenPipe, NetworkError
+from ..net.network import BrokenPipe, ConnectionReset, NetworkError
 
 
 async def real_lookup(addr: AddrLike) -> Addr:
@@ -133,6 +133,105 @@ def _encode(tag: int, data: Any) -> bytes:
     return _HDR.pack(len(body)) + body
 
 
+class _FrameError(Exception):
+    """Malformed frame: the byte stream is desynced beyond recovery."""
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    """The ONE frame decoder (endpoint reader loop and channel receivers
+    share it): one framed message → (tag, data); None at orderly EOF or a
+    broken socket; :class:`_FrameError` on a malformed length."""
+    try:
+        hdr = await reader.readexactly(_HDR.size)
+        (n,) = _HDR.unpack(hdr)
+        if n < _TAGFMT.size or n > _MAX_FRAME:
+            raise _FrameError(f"bad frame length {n}")
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+    tag, fmt = _TAGFMT.unpack_from(body)
+    payload = body[_TAGFMT.size:]
+    return tag, (pickle.loads(payload) if fmt == FMT_PICKLE else payload)
+
+
+class RealChannelSender:
+    """Sending half of a real ``connect1`` channel (one dedicated framed
+    connection). ``close()`` shuts down the write direction only, so the
+    peer's receiver sees EOF while this side can keep reading — matching
+    the sim channel halves' independent-close semantics."""
+
+    __slots__ = ("_writer", "_lock")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._lock = asyncio.Lock()
+
+    async def send(self, payload) -> None:
+        try:
+            async with self._lock:
+                self._writer.write(_encode(0, payload))
+                await self._writer.drain()
+        except (ConnectionError, OSError, RuntimeError):
+            # RuntimeError: write after write_eof/close — the sim raises
+            # ConnectionReset for sends on a closed channel; match it.
+            raise ConnectionReset("connection reset") from None
+
+    def close(self) -> None:
+        try:
+            if self._writer.can_write_eof():
+                self._writer.write_eof()
+            else:
+                self._writer.close()
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+
+class RealChannelReceiver:
+    """Receiving half of a real ``connect1`` channel: reads frames on
+    demand; EOF or a broken socket surfaces like the sim's closed
+    channel."""
+
+    __slots__ = ("_reader", "_writer")
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+
+    async def recv(self):
+        msg = await self._recv_raw()
+        if msg is _EOF:
+            raise ConnectionReset("connection reset")
+        return msg
+
+    async def recv_or_eof(self):
+        """Like recv but returns None at EOF (for stream adapters)."""
+        msg = await self._recv_raw()
+        return None if msg is _EOF else msg
+
+    async def _recv_raw(self):
+        try:
+            frame = await _read_frame(self._reader)
+        except _FrameError:
+            # Desynced stream: tear the connection down (a plain EOF must
+            # NOT close — the peer may have half-closed and still expect
+            # our replies).
+            self._writer.close()
+            return _EOF
+        return _EOF if frame is None else frame[1]
+
+    def close(self) -> None:
+        self._writer.close()  # tears down the whole connection
+
+
+class _EofType:
+    pass
+
+
+_EOF = _EofType()
+_CLOSED = object()  # accept1 wake-up sentinel after endpoint close
+
+
 class RealEndpoint:
     """Bindable, tag-matching endpoint over real TCP."""
 
@@ -145,6 +244,8 @@ class RealEndpoint:
         self._tasks: List[asyncio.Task] = []
         self._peer: Optional[Addr] = None
         self._closed = False
+        # Inbound connect1 channels park here until accept1 takes them.
+        self._chan_queue: "asyncio.Queue" = asyncio.Queue()
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -198,16 +299,23 @@ class RealEndpoint:
     async def _on_accept(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
         try:
-            # Handshake: the connector's listener address (`tcp.rs:87-96`).
+            # Handshake: the connector's listener address (`tcp.rs:87-96`),
+            # or "chan:<addr>" marking a dedicated connect1 channel.
             hdr = await reader.readexactly(_HDR.size)
             (n,) = _HDR.unpack(hdr)
             if n > 4096:
                 raise NetworkError("bad handshake")
             text = (await reader.readexactly(n)).decode()
-            peer = (await lookup_host(text))[0]
+            is_chan = text.startswith("chan:")
+            peer = (await lookup_host(text[5:] if is_chan else text))[0]
         except (asyncio.IncompleteReadError, UnicodeDecodeError,
                 NetworkError, ValueError):
             writer.close()
+            return
+        if is_chan:
+            self._chan_queue.put_nowait(
+                (RealChannelSender(writer),
+                 RealChannelReceiver(reader, writer), peer))
             return
         prev = self._conns.get(peer)
         if prev is not None and not prev.done():
@@ -237,17 +345,13 @@ class RealEndpoint:
     async def _reader_loop(self, reader, writer, peer: Addr) -> None:
         try:
             while True:
-                hdr = await reader.readexactly(_HDR.size)
-                (n,) = _HDR.unpack(hdr)
-                if n < _TAGFMT.size or n > _MAX_FRAME:
+                try:
+                    frame = await _read_frame(reader)
+                except _FrameError:
                     break
-                body = await reader.readexactly(n)
-                tag, fmt = _TAGFMT.unpack_from(body)
-                payload = body[_TAGFMT.size:]
-                data = pickle.loads(payload) if fmt == FMT_PICKLE else payload
-                self._mailbox.deliver(_Message(tag, data, peer))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
+                if frame is None:
+                    break
+                self._mailbox.deliver(_Message(frame[0], frame[1], peer))
         finally:
             # Closed by remote: drop the cached sender so later sends
             # reconnect (`tcp.rs:144-150`) — but only if the cache still
@@ -337,6 +441,33 @@ class RealEndpoint:
             raise
         return msg.data, msg.from_addr
 
+    # -- connection-oriented path (sim connect1/accept1 twins) -------------
+    async def connect1(self, addr: AddrLike):
+        """Open a dedicated ordered duplex channel to a peer's endpoint
+        (the sim ``connect1`` twin): returns (sender, receiver)."""
+        dst = await real_lookup(addr)
+        reader, writer = await self._dial(dst)
+        try:
+            text = f"chan:{self._advertised_addr(writer)}".encode()
+            writer.write(_HDR.pack(len(text)) + text)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            writer.close()
+            raise ConnectionReset("connection reset") from None
+        return RealChannelSender(writer), RealChannelReceiver(reader, writer)
+
+    async def accept1(self):
+        """Await an inbound channel: returns (sender, receiver, peer).
+        Raises :class:`ConnectionReset` once the endpoint closes — the
+        sim accept1's closed-endpoint behavior."""
+        if self._closed:
+            raise ConnectionReset("endpoint closed")
+        item = await self._chan_queue.get()
+        if item is _CLOSED:
+            self._chan_queue.put_nowait(_CLOSED)  # wake further waiters
+            raise ConnectionReset("endpoint closed")
+        return item
+
     async def send(self, tag: int, data: Any) -> None:
         await self.send_to(self.peer_addr(), tag, data)
 
@@ -361,6 +492,12 @@ class RealEndpoint:
         for t in self._tasks:
             t.cancel()
         self._mailbox.close()
+        # Tear down parked inbound channels and wake accept1 waiters.
+        while not self._chan_queue.empty():
+            item = self._chan_queue.get_nowait()
+            if item is not _CLOSED:
+                item[1].close()
+        self._chan_queue.put_nowait(_CLOSED)
 
     def __enter__(self):
         return self

@@ -154,6 +154,67 @@ async def primitives_world():
     return True
 
 
+async def connect1_world():
+    """Connection-oriented channels (connect1/accept1) — ordered duplex
+    with EOF propagation, in sim AND over real transports."""
+    from madsim_tpu import task
+
+    a = await Endpoint.bind("127.0.0.1:0")
+    b = await Endpoint.bind("127.0.0.1:0")
+
+    async def server():
+        tx, rx, src = await b.accept1()
+        n = 0
+        while True:
+            msg = await rx.recv_or_eof()
+            if msg is None:
+                break
+            await tx.send(("echo", msg))
+            n += 1
+        tx.close()
+        return n, src
+
+    srv = task.spawn(server())
+    tx, rx = await a.connect1(b.local_addr())
+    for i in range(5):
+        await tx.send({"seq": i})
+        tag, payload = await rx.recv()
+        assert tag == "echo" and payload == {"seq": i}
+    tx.close()  # half-close: the server sees EOF and closes its side
+    assert await rx.recv_or_eof() is None
+    n, src = await srv
+    assert n == 5
+    assert src == a.local_addr()
+    # The strict receive raises at EOF, and sends on a closed channel
+    # raise ConnectionReset — identical contract in sim and real mode.
+    from madsim_tpu.net.netsim import ConnectionReset
+    try:
+        await rx.recv()
+        raise AssertionError("recv at EOF must raise")
+    except ConnectionReset:
+        pass
+    try:
+        await tx.send("late")
+        raise AssertionError("send after close must raise")
+    except ConnectionReset:
+        pass
+    # Closing the endpoint wakes a blocked accept1 with ConnectionReset.
+    async def acceptor():
+        try:
+            await b.accept1()
+            return "accepted"
+        except ConnectionReset:
+            return "reset"
+
+    h = task.spawn(acceptor())
+    from madsim_tpu import time as mt
+    await mt.sleep(0.01)
+    b.close()
+    assert await h == "reset"
+    a.close()
+    return True
+
+
 async def tcp_world():
     from madsim_tpu.net import TcpListener, TcpStream
 
@@ -230,6 +291,10 @@ def test_rpc_pingpong(mode):
 
 def test_primitives(mode):
     assert ms.run(primitives_world(), seed=3)
+
+
+def test_connect1_channels(mode):
+    assert ms.run(connect1_world(), seed=4, time_limit=120.0)
 
 
 def test_tcp_streams(mode):
