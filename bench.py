@@ -76,8 +76,11 @@ def bench_rpc_pingpong(n_rounds: int) -> dict:
             async def server_init():
                 ep = await Endpoint.bind("10.0.0.1:9000")
 
+                # The reference's criterion handler consumes the data and
+                # returns an empty sidecar (`benches/rpc.rs:35-38`); echoing
+                # it back would double the measured wire traffic.
                 async def handle(req, data):
-                    return Ping(req.n + 1), data
+                    return Ping(req.n + 1), b""
 
                 rpc.add_rpc_handler_with_data(ep, Ping, handle)
                 await simtime.sleep(1e6)
@@ -152,8 +155,10 @@ def bench_rpc_real(n_rounds: int) -> dict:
         async def world(payload: bytes, rounds: int) -> float:
             server = await Endpoint.bind("127.0.0.1:0")
 
+            # Reference handler shape: consume data, empty response sidecar
+            # (`benches/rpc.rs:35-38`).
             async def handle(req, data):
-                return BenchPing(req.n + 1), data
+                return BenchPing(req.n + 1), b""
 
             rpc.add_rpc_handler_with_data(server, BenchPing, handle)
             client = await Endpoint.bind("127.0.0.1:0")

@@ -377,7 +377,12 @@ class Connection:
                 self.txn_status = payload[:1].decode() or "I"
                 if error is not None:
                     raise error
-                return copy_decode(bytes(data))
+                try:
+                    return copy_decode(bytes(data))
+                except UnicodeDecodeError as exc:
+                    raise PostgresError(
+                        "ERROR", "22P04",
+                        f"invalid COPY data from server: {exc}") from exc
             elif mtype in (b"S", b"N"):
                 continue
             else:
@@ -686,6 +691,15 @@ class SimPostgresServer:
                 # session's finally block rolls back the open transaction.
                 raise BrokenPipe("client terminated during COPY")
             else:
+                # Real postgres discards the rest of the copy stream before
+                # reporting the error, so the request/response cycle stays in
+                # sync; drain to CopyDone/CopyFail (EOF propagates) first.
+                while True:
+                    drained, _ = await _read_message(stream)
+                    if drained in (b"c", b"f"):
+                        break
+                    if drained == b"X":
+                        raise BrokenPipe("client terminated during COPY")
                 await stream.write_all(fail(self._error(
                     "ERROR", "08P01",
                     f"unexpected message {mtype!r} during COPY")))

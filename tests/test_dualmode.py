@@ -403,6 +403,32 @@ def test_real_mode_cross_process_rpc(monkeypatch, tmp_path):
         proc.wait()
 
 
+def test_real_peer_restart_reconnects(monkeypatch):
+    # Regression (round-4 review): a peer endpoint closing must evict the
+    # cached sender connection at EOF, so a send after the peer rebinds the
+    # same port reconnects instead of writing into the dead socket.
+    monkeypatch.setenv("MADSIM_BACKEND", "real")
+
+    async def main():
+        import asyncio
+
+        a = await Endpoint.bind("127.0.0.1:0")
+        b = await Endpoint.bind("127.0.0.1:0")
+        addr = b.local_addr()
+        await a.send_to(addr, 7, b"one")
+        assert (await b.recv_from(7))[0] == b"one"
+        b.close()
+        await asyncio.sleep(0.1)  # let the FIN reach a's protocol
+        b2 = await Endpoint.bind(f"127.0.0.1:{addr[1]}")
+        await a.send_to(addr, 7, b"two")
+        data, _ = await b2.recv_from(7)
+        a.close()
+        b2.close()
+        return data
+
+    assert ms.run(main()) == b"two"
+
+
 def test_sim_wins_inside_runtime(monkeypatch):
     # MADSIM_BACKEND=real must NOT leak into a running simulation: inside a
     # Runtime the sim backend always wins (tests stay simulated).

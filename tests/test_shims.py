@@ -745,6 +745,76 @@ def test_postgres_copy_transactional_and_failures():
     assert _pg_world(body) == []
 
 
+def test_postgres_copy_unexpected_message_drains_stream():
+    # Regression (round-4 advice): an unexpected message mid-COPY must make
+    # the server drain the rest of the copy stream (to CopyDone/CopyFail)
+    # before reporting one error; the trailing CopyData frames must not
+    # desync the request/response cycle (real-postgres behavior).
+    async def body(conn):
+        await conn.execute("CREATE TABLE t (k)")
+        await conn.copy_in("COPY t FROM STDIN")
+        raw = (postgres._msg(b"d", b"1\n")
+               + postgres._msg(b"?", b"")       # unexpected mid-COPY
+               + postgres._msg(b"d", b"2\n")    # client still mid-stream
+               + postgres._msg(b"c", b""))
+        await conn._stream.write_all(raw)
+        with pytest.raises(postgres.PostgresError) as ei:
+            await conn._read_until_ready()
+        assert ei.value.code == "08P01"
+        # Exactly one error + ReadyForQuery: the session is back in sync
+        # and the partial copy was discarded.
+        return await conn.query("SELECT * FROM t")
+
+    assert _pg_world(body) == []
+
+
+def test_postgres_copy_out_invalid_utf8_is_postgres_error():
+    # Regression (round-4 advice): non-UTF-8 CopyData from the server must
+    # surface as PostgresError 22P04, not a raw UnicodeDecodeError.
+    import struct
+
+    from madsim_tpu.net.tcp import TcpListener
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def rogue_server():
+            listener = await TcpListener.bind(("10.0.0.1", 5432))
+            stream, _ = await listener.accept()
+            head = await stream.read_exact(8)
+            (length, _ver) = struct.unpack("!II", head)
+            if length > 8:
+                await stream.read_exact(length - 8)
+            await stream.write_all(
+                postgres._msg(b"R", b"\0\0\0\0")
+                + postgres._msg(b"Z", b"I"))
+            mtype, _ = await postgres._read_message(stream)
+            assert mtype == b"Q"
+            await stream.write_all(
+                postgres._msg(b"H", b"\0\0\0")
+                + postgres._msg(b"d", b"\xff\xfe\n")   # invalid UTF-8
+                + postgres._msg(b"c", b"")
+                + postgres._msg(b"C", b"COPY 1\0")
+                + postgres._msg(b"Z", b"I"))
+
+        h.create_node(name="db", ip="10.0.0.1", init=rogue_server)
+        result = ms.sync.SimFuture()
+
+        async def client():
+            await time.sleep(0.1)
+            conn = await postgres.connect("10.0.0.1")
+            try:
+                await conn.copy_out("COPY t TO STDOUT")
+                result.set_result("no error")
+            except postgres.PostgresError as exc:
+                result.set_result(exc.code)
+
+        h.create_node(name="app", ip="10.0.0.2", init=client)
+        return await time.timeout(60, _await(result))
+
+    assert ms.run(main(), seed=7) == "22P04"
+
+
 def test_postgres_prepared_txn_under_loss_and_restart():
     # The VERDICT bar: prepared statements + transaction rollback while the
     # network drops packets and the DB node restarts mid-run.
