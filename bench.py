@@ -682,6 +682,103 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Config 8: the host<->device BRIDGE — sweep the UNMODIFIED rpc ping-pong
+# host workload (config 1's world) across seeds with the device decision
+# kernel (bridge/), vs the same seeds run sequentially on the pure host
+# engine. Reports the honest speedup and where the time goes; per-seed
+# trajectories are bit-identical across the two engines (tests/test_bridge).
+# ---------------------------------------------------------------------------
+
+def bench_bridge_sweep(n_host: int, n_bridge: int) -> dict:
+    import madsim_tpu as ms
+    from madsim_tpu import time as simtime
+    from madsim_tpu.bridge import sweep
+    from madsim_tpu.net import Endpoint, rpc
+
+    ROUNDS = 20
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def server_init():
+            ep = await Endpoint.bind("10.0.0.1:9000")
+
+            async def handle(req, data):
+                return BenchPing(req.n + 1), b""
+
+            rpc.add_rpc_handler_with_data(ep, BenchPing, handle)
+            await simtime.sleep(1e6)
+
+        h.create_node(name="server", ip="10.0.0.1", init=server_init)
+        client = h.create_node(name="client", ip="10.0.0.2")
+        done = ms.sync.SimFuture()
+
+        async def client_body():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            for i in range(ROUNDS):
+                await rpc.call_with_data(ep, "10.0.0.1:9000", BenchPing(i),
+                                         b"x" * 64, timeout=5.0)
+            done.set_result(True)
+
+        client.spawn(client_body())
+
+        async def _await(f):
+            return await f
+
+        return await simtime.timeout(600, _await(done))
+
+    import os
+
+    # jobs sweep FIRST: forked workers need a jax-uninitialized parent.
+    jobs = os.cpu_count() or 1
+    out = {"world": f"rpc_pingpong x{ROUNDS} (bench config 1)",
+           "jobs": jobs}
+    if jobs > 1:
+        t0 = walltime.perf_counter()
+        outs = sweep(world, list(range(n_bridge)), jobs=jobs)
+        dt = walltime.perf_counter() - t0
+        assert all(o.error is None for o in outs)
+        out["bridge_jobs_seeds_per_sec"] = round(n_bridge / dt, 1)
+
+    t0 = walltime.perf_counter()
+    polls = 0
+    for seed in range(n_host):
+        rt = ms.Runtime(seed=seed)
+        assert rt.block_on(world())
+        polls += rt.task.poll_count
+    host_dt = walltime.perf_counter() - t0
+    host_rate = n_host / host_dt
+    out.update({
+        "host_seeds_per_sec": round(host_rate, 1),
+        "host_us_per_poll": round(host_dt / polls * 1e6, 2),
+    })
+
+    async def tiny():
+        await simtime.sleep(0.001)
+
+    sweep(tiny, list(range(n_bridge)))  # jit warmup at the measured W
+    t0 = walltime.perf_counter()
+    outs = sweep(world, list(range(n_bridge)))
+    dt = walltime.perf_counter() - t0
+    assert all(o.error is None for o in outs)
+    rate = n_bridge / dt
+    out.update({
+        "bridge_w": n_bridge,
+        "bridge_seeds_per_sec": round(rate, 1),
+        "bridge_vs_host": round(rate / host_rate, 2),
+        "note": ("per-seed trajectories bit-identical to host "
+                 "(tests/test_bridge.py); task bodies are serial Python, "
+                 "so single-core speedup is bounded by the decision-kernel "
+                 "fraction — see docs/bridge.md"),
+    })
+    if "bridge_jobs_seeds_per_sec" in out:
+        out["bridge_jobs_vs_host"] = round(
+            out["bridge_jobs_seeds_per_sec"] / host_rate, 2)
+    log(f"bridge_sweep: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Main
 # ---------------------------------------------------------------------------
 
@@ -705,6 +802,9 @@ _CONFIGS = [
          device_worlds=1_024 if a.smoke else 65_536)),
     ("5node", "madraft_5node",
      lambda a: bench_madraft_5node(256 if a.smoke else 100_000)),
+    ("bridge", "bridge_sweep",
+     lambda a: bench_bridge_sweep(n_host=16 if a.smoke else 64,
+                                  n_bridge=64 if a.smoke else 512)),
 ]
 
 
@@ -784,7 +884,7 @@ def main() -> None:
     ap.add_argument("--host-seeds", type=int, default=None)
     ap.add_argument("--only", type=str, default=None,
                     help="comma list: 3node,rpc,rpc_real,grpc,postgres,"
-                         "5node,crosscheck,bug (3node = the headline)")
+                         "5node,crosscheck,bug,bridge (3node = the headline)")
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "
                          "failure isolation keeps the headline alive")

@@ -1,0 +1,22 @@
+"""Host↔device bridge: TPU acceleration for arbitrary host workloads.
+
+``sweep(world_fn, seeds)`` runs any coroutine written against the
+madsim_tpu host API across many seeds, with the decision kernel
+(next-event selection, virtual clock, timer wheel, per-message
+loss/latency sampling) batched on the device and task bodies on the
+host — SURVEY §7 stage 4. Per seed, trajectories are bit-identical to
+``Runtime.block_on`` (tests/test_bridge.py).
+"""
+from .kernel import BridgeKernel, HostBatch, StepOut  # noqa: F401
+from .runtime import (  # noqa: F401
+    BridgeNetSim,
+    BridgeRuntime,
+    BridgeTime,
+    Outcome,
+    sweep,
+    sweep_traced,
+)
+
+__all__ = ["sweep", "sweep_traced", "Outcome", "BridgeRuntime",
+           "BridgeKernel", "BridgeNetSim", "BridgeTime", "HostBatch",
+           "StepOut"]

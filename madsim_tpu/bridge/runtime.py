@@ -1,0 +1,523 @@
+"""Host-side half of the host↔device bridge: lockstep sweep of W worlds.
+
+``sweep(world_fn, seeds)`` runs one *unmodified* host-engine workload —
+any coroutine written against the madsim_tpu API (Endpoint/RPC, gRPC
+shims, sleep/timeout, kill/restart/clog chaos) — for many seeds at once:
+
+- each seed gets a full host world (executor, nodes, coroutines, NetSim
+  mailboxes) exactly like ``Runtime``; task bodies always run on host —
+  the one thing that cannot be vectorized (SURVEY §7 "hard parts");
+- the *decision kernel* — timer wheel, next-event selection, virtual
+  clock advance, per-message loss/latency sampling — lives on the device
+  as [W]-shaped arrays, advanced by one jitted XLA step per lockstep
+  round (`bridge/kernel.py`).
+
+Determinism contract: per seed, a bridge world walks the **bit-identical
+trajectory** of a plain ``Runtime`` world (same poll sequence, same
+virtual timestamps, same RNG streams) — the property tested in
+tests/test_bridge.py. It holds because every framework draw is addressed
+as (seed, purpose-stream, counter) (`core/rng.py`) and the device samples
+the same counters with the same integer math.
+
+Reference parity: this is the batched analog of the multi-seed test
+driver (`madsim/src/sim/runtime/builder.rs:118-136`) — the reference
+fans seeds out to OS threads; here the per-seed decision work fans into
+one device batch while hosts bodies run under the GIL, and seed batches
+shard across chips via the parallel/ meshes.
+"""
+from __future__ import annotations
+
+import copy
+import inspect
+import os
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..core import context
+from ..core.config import Config
+from ..core.rng import GlobalRng, loss_threshold
+from ..core.runtime import Runtime
+from ..core.task import Deadlock, TimeLimitExceeded
+from ..core.timewheel import NANOS_PER_SEC, TimeRuntime, to_ns
+from ..net.addr import ip_is_loopback, unspecified_for
+from ..net.netsim import NetSim
+from ..net.network import LOCALHOST_V4
+from .kernel import BridgeKernel, HostBatch, StepOut, bucket
+
+_I64_MAX = 2**63 - 1
+
+
+class _TimerHandle:
+    """Cancellation handle for a bridge timer (TimerEntry.cancel parity)."""
+
+    __slots__ = ("_time", "seq")
+
+    def __init__(self, time: "BridgeTime", seq: int):
+        self._time = time
+        self.seq = seq
+
+    def cancel(self) -> None:
+        self._time.cancel_seq(self.seq)
+
+
+class _Send(NamedTuple):
+    ctr: int       # NET-stream counter of the loss draw (latency = ctr+1)
+    base_ns: int   # elapsed at the send
+    slot: int      # delivery lane slot (-1 for count-only sends)
+    seq: int
+    thr: int       # loss threshold (u64, clamped)
+    lossall: bool  # loss rate >= 1.0
+    lat_lo: int
+    lat_w: int
+    live: bool     # has a destination socket
+
+
+class BridgeTime(TimeRuntime):
+    """TimeRuntime whose wheel lives on the device: ``add_timer_at`` and
+    ``cancel`` record lane operations; the sweep driver ships them each
+    lockstep round and dispatches the popped events. The clock is a local
+    mirror (host advances it during polls; the driver overwrites it with
+    the device's post-advance value)."""
+
+    def __init__(self, rng: GlobalRng, cap: int):
+        super().__init__(rng)
+        self._native_heap = None  # the wheel is device-resident
+        self.cap = cap
+        self._free = list(range(cap - 1, -1, -1))
+        # slot -> (deadline, seq) recorded but not yet shipped this round.
+        self.pending_add: Dict[int, Tuple[int, int]] = {}
+        self.cancels: List[int] = []          # device-resident cancels
+        self.sends: List[_Send] = []
+        self.send_cbs: List[Optional[Callable]] = []
+        self.callbacks: Dict[int, Tuple[Callable, int]] = {}
+
+    # -- the TimeRuntime surface ------------------------------------------
+    def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]):
+        deadline_ns = min(max(deadline_ns, self.elapsed_ns), _I64_MAX)
+        seq = self._seq
+        self._seq += 1
+        slot = self._alloc()
+        self.pending_add[slot] = (deadline_ns, seq)
+        self.callbacks[seq] = (callback, slot)
+        return _TimerHandle(self, seq)
+
+    def cancel_seq(self, seq: int) -> None:
+        ent = self.callbacks.pop(seq, None)
+        if ent is None:
+            return  # already fired or cancelled
+        _cb, slot = ent
+        pend = self.pending_add.get(slot)
+        if pend is not None and pend[1] == seq:
+            del self.pending_add[slot]  # never reached the device
+        else:
+            self.cancels.append(slot)
+        self._free.append(slot)
+
+    def next_deadline_ns(self):  # pragma: no cover — driver-owned
+        raise NotImplementedError("bridge worlds are driven by sweep()")
+
+    def advance_to_next_event(self):  # pragma: no cover — driver-owned
+        raise NotImplementedError("bridge worlds are driven by sweep()")
+
+    # -- bridge bookkeeping ------------------------------------------------
+    def _alloc(self) -> int:
+        try:
+            return self._free.pop()
+        except IndexError:
+            raise RuntimeError(
+                f"bridge timer capacity exceeded ({self.cap} concurrent "
+                "timers in one world); raise sweep(cap=...)") from None
+
+    def record_send(self, ctr: int, thr: int, lossall: bool, lat_lo: int,
+                    lat_w: int, cb: Optional[Callable]) -> None:
+        live = cb is not None
+        if live:
+            slot = self._alloc()
+            seq = self._seq
+            self._seq += 1
+            self.callbacks[seq] = (cb, slot)
+        else:
+            slot, seq = -1, 0
+        self.sends.append(_Send(ctr, self.elapsed_ns, slot, seq,
+                                min(thr, (1 << 64) - 1), lossall,
+                                lat_lo, lat_w, live))
+        self.send_cbs.append(cb)
+
+    def fire(self, seq: int) -> None:
+        ent = self.callbacks.pop(seq, None)
+        if ent is None:
+            return
+        cb, slot = ent
+        self._free.append(slot)
+        cb()
+
+    def drop_send(self, send: _Send) -> None:
+        """A live send the device declared lost: release its lane slot."""
+        ent = self.callbacks.pop(send.seq, None)
+        if ent is not None:
+            self._free.append(ent[1])
+
+    def take_round(self):
+        adds = self.pending_add
+        cancels = self.cancels
+        sends = self.sends
+        self.pending_add = {}
+        self.cancels = []
+        self.sends = []
+        self.send_cbs = []
+        return adds, cancels, sends
+
+
+class BridgeNetSim(NetSim):
+    """NetSim whose datagram sampling runs on the device.
+
+    The send-side processing delay and the connection-oriented paths
+    (connect1 relays, whose latency value is needed inline for their
+    sleep) keep drawing host-side from the same NET cursor — counters
+    stay aligned with pure-host mode either way, because both modes
+    consume exactly the same blocks in the same order."""
+
+    async def send(self, node_id, port, dst, protocol, msg) -> None:
+        await self.rand_delay()
+        net = self.network
+        dst_node = net.resolve_dest_node(node_id, dst, protocol)
+        if dst_node is None:
+            return
+        ctr = self.rand.reserve(2)  # loss @ctr, latency @ctr+1 — on device
+        if net.link_clogged(node_id, dst_node):
+            return  # draws consumed, like the host test_link
+        sockets = net.nodes[dst_node].sockets
+        socket = sockets.get((dst, protocol))
+        if socket is None:
+            socket = sockets.get(((unspecified_for(dst[0]), dst[1]), protocol))
+        cfg = net.config
+        lo_ns = to_ns(cfg.send_latency[0])
+        width = max(to_ns(cfg.send_latency[1]), lo_ns + 1) - lo_ns
+        p = cfg.packet_loss_rate
+        if socket is None:
+            cb = None  # loss draw still decides stat.msg_count
+        else:
+            src_ip = (LOCALHOST_V4 if ip_is_loopback(dst[0])
+                      else net.nodes[node_id].ip)
+            src = (src_ip, port)
+
+            def cb(socket=socket, src=src, dst=dst, msg=msg):
+                socket.deliver(src, dst, msg)
+
+        self.time.record_send(ctr, loss_threshold(p), p >= 1.0,
+                              lo_ns, width, cb)
+
+
+class BridgeRuntime(Runtime):
+    """Runtime wired for the bridge: device-backed time + NetSim."""
+
+    def __init__(self, seed: int = 0, config: Optional[Config] = None,
+                 cap: int = 128):
+        self._cap = cap
+        super().__init__(seed=seed, config=config)
+
+    def _make_time(self) -> BridgeTime:
+        return BridgeTime(self.rand, self._cap)
+
+    def _default_simulators(self) -> tuple:
+        from ..fs import FsSim
+
+        return (BridgeNetSim, FsSim)
+
+    def block_on(self, coro):  # pragma: no cover
+        raise NotImplementedError("bridge worlds are driven by sweep()")
+
+
+class Outcome(NamedTuple):
+    """Per-seed sweep outcome: exactly what ``Runtime.block_on`` would
+    have returned (value) or raised (error)."""
+
+    seed: int
+    value: Any
+    error: Optional[BaseException]
+
+
+class _World:
+    __slots__ = ("idx", "rt", "root", "done", "stat")
+
+    def __init__(self, idx: int, rt: BridgeRuntime, root):
+        self.idx = idx
+        self.rt = rt
+        self.root = root
+        self.done = False
+        self.stat = rt.handle.sims.get(NetSim).network.stat
+
+
+def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
+          configs: Optional[List[Config]] = None, cap: int = 128,
+          k_events: int = 4, time_limit: Optional[float] = None,
+          trace: bool = False, device: Optional[str] = None,
+          jobs: int = 1) -> List[Outcome]:
+    """Sweep an unmodified host workload over many seeds with the device
+    decision kernel (`builder.rs:118-136`, batched).
+
+    ``world_fn`` is called once per seed (with the seed if it accepts an
+    argument) and must return the root coroutine. ``configs`` gives each
+    world its own Config — the (seeds × configs) sweep axis. With
+    ``trace=True`` each world records (task_id, elapsed_ns) per poll for
+    trajectory-equality checks.
+
+    ``jobs`` shards seeds across forked worker processes, each running
+    its own lockstep loop — the MADSIM_TEST_JOBS analog
+    (`builder.rs:55-107`; the reference forks OS threads, which a GIL
+    rules out for Python task bodies). Task bodies are CPU-bound Python,
+    so jobs only helps up to the machine's core count; jobs=0 picks
+    ``os.cpu_count()``."""
+    if jobs == 0:
+        jobs = os.cpu_count() or 1
+    if jobs > 1 and len(seeds) > 1 and not _jax_initialized():
+        # fork is only safe before this process touches a jax backend
+        # (forked XLA clients deadlock); with jax already live, fall back
+        # to the in-process loop.
+        return _sweep_jobs(world_fn, seeds, jobs, config=config,
+                           configs=configs, cap=cap, k_events=k_events,
+                           time_limit=time_limit, device=device)
+    outcomes, _ = _sweep_impl(world_fn, seeds, config=config,
+                              configs=configs, cap=cap, k_events=k_events,
+                              time_limit=time_limit, trace=trace,
+                              device=device)
+    return outcomes
+
+
+def _jax_initialized() -> bool:
+    import sys
+
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(xb is not None and getattr(xb, "_backends", None))
+
+
+def _sweep_jobs(world_fn, seeds, jobs, *, configs=None, **kw):
+    """Fork one worker per seed shard; each runs its own kernel + loop.
+
+    fork (not spawn) so ``world_fn`` closures carry over without
+    pickling; outcomes return through pipes. Errors that cannot pickle
+    are re-wrapped as RuntimeError with the original repr."""
+    import pickle
+
+    seeds = list(seeds)
+    jobs = min(jobs, len(seeds))
+    shards = [list(range(i, len(seeds), jobs)) for i in range(jobs)]
+    pipes = []
+    pids = []
+    for shard in shards:
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            os.close(r)
+            try:
+                sub_cfgs = ([configs[i] for i in shard]
+                            if configs is not None else None)
+                outs, _ = _sweep_impl(world_fn, [seeds[i] for i in shard],
+                                      configs=sub_cfgs, **kw)
+                payload = []
+                for o in outs:
+                    try:
+                        pickle.dumps(o)
+                        payload.append(o)
+                    except Exception:
+                        payload.append(Outcome(
+                            o.seed, None,
+                            RuntimeError(f"unpicklable outcome: {o!r}")))
+                blob = pickle.dumps(payload)
+            except BaseException as exc:  # noqa: BLE001
+                blob = pickle.dumps(RuntimeError(
+                    f"sweep worker failed: {exc!r}"))
+            with os.fdopen(w, "wb") as f:
+                f.write(blob)
+            os._exit(0)
+        os.close(w)
+        pipes.append(r)
+        pids.append(pid)
+    outcomes: List[Optional[Outcome]] = [None] * len(seeds)
+    for shard, r, pid in zip(shards, pipes, pids):
+        with os.fdopen(r, "rb") as f:
+            data = pickle.loads(f.read())
+        os.waitpid(pid, 0)
+        if isinstance(data, BaseException):
+            raise data
+        for idx, o in zip(shard, data):
+            outcomes[idx] = o
+    return outcomes
+
+
+def sweep_traced(world_fn, seeds, **kw) -> Tuple[List[Outcome], List[list]]:
+    """sweep() + per-seed poll traces (testing hook)."""
+    return _sweep_impl(world_fn, seeds, trace=True, **kw)
+
+
+def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
+                k_events=4, time_limit=None, trace=False, device=None):
+    seeds = [int(s) for s in seeds]
+    W = len(seeds)
+    wants_seed = len(inspect.signature(world_fn).parameters) >= 1
+    worlds: List[_World] = []
+    traces: List[list] = []
+    for i, seed in enumerate(seeds):
+        if configs is not None:
+            cfg = copy.deepcopy(configs[i])
+        else:
+            cfg = copy.deepcopy(config) if config is not None else None
+        rt = BridgeRuntime(seed=seed, config=cfg, cap=cap)
+        if time_limit is not None:
+            rt.set_time_limit(time_limit)
+        tr: list = []
+        if trace:
+            rt.task.trace = tr
+        traces.append(tr)
+        with context.enter_handle(rt.handle):
+            coro = world_fn(seed) if wants_seed else world_fn()
+            root = rt.task.start_root(coro)
+        worlds.append(_World(i, rt, root))
+
+    kernel = BridgeKernel(seeds, cap=cap, k_events=k_events, device=device)
+    outcomes: List[Optional[Outcome]] = [None] * W
+    pending = set(range(W))
+
+    def finish(w: _World, value=None, error=None):
+        outcomes[w.idx] = Outcome(seeds[w.idx], value, error)
+        w.done = True
+        pending.discard(w.idx)
+
+    def run_host(w: _World) -> None:
+        """One host burst: run all ready tasks, then settle the root."""
+        ex = w.rt.task
+        with context.enter_handle(w.rt.handle):
+            ex.run_all_ready()
+        if ex._uncaught is not None:
+            exc, ex._uncaught = ex._uncaught, None
+            finish(w, error=exc)
+        elif w.root.done:
+            fut = w.root.join_future
+            if fut._exception is not None:
+                finish(w, error=fut._exception)
+            else:
+                finish(w, value=fut.result())
+
+    for w in worlds:
+        run_host(w)
+
+    zero_i32 = np.zeros((W, 0), np.int32)
+    while pending:
+        # -- build the padded round batch ---------------------------------
+        rounds = []
+        t_n = c_n = s_n = 0
+        for w in worlds:
+            adds, cancels, sends = w.rt.time.take_round()
+            rounds.append((adds, cancels, sends))
+            t_n = max(t_n, len(adds))
+            c_n = max(c_n, len(cancels))
+            s_n = max(s_n, len(sends))
+        T, C, S = bucket(t_n), bucket(c_n), bucket(s_n)
+        t_slot = np.zeros((W, T), np.int32)
+        t_dl = np.zeros((W, T), np.int64)
+        t_seq = np.zeros((W, T), np.int64)
+        t_mask = np.zeros((W, T), np.bool_)
+        c_slot = np.zeros((W, C), np.int32)
+        c_mask = np.zeros((W, C), np.bool_)
+        s_ctr = np.zeros((W, S), np.uint64)
+        s_base = np.zeros((W, S), np.int64)
+        s_slot = np.zeros((W, S), np.int32)
+        s_seq = np.zeros((W, S), np.int64)
+        s_thr = np.zeros((W, S), np.uint64)
+        s_lossall = np.zeros((W, S), np.bool_)
+        s_lat_lo = np.zeros((W, S), np.int64)
+        s_lat_w = np.ones((W, S), np.int64)
+        s_mask = np.zeros((W, S), np.bool_)
+        s_live = np.zeros((W, S), np.bool_)
+        clock = np.zeros((W,), np.int64)
+        advance = np.zeros((W,), np.bool_)
+        for w, (adds, cancels, sends) in zip(worlds, rounds):
+            i = w.idx
+            clock[i] = w.rt.time.elapsed_ns
+            advance[i] = not w.done
+            for j, (slot, (dl, sq)) in enumerate(adds.items()):
+                t_slot[i, j] = slot
+                t_dl[i, j] = dl
+                t_seq[i, j] = sq
+                t_mask[i, j] = True
+            for j, slot in enumerate(cancels):
+                c_slot[i, j] = slot
+                c_mask[i, j] = True
+            for j, s in enumerate(sends):
+                s_ctr[i, j] = s.ctr
+                s_base[i, j] = s.base_ns
+                s_slot[i, j] = max(s.slot, 0)
+                s_seq[i, j] = s.seq
+                s_thr[i, j] = s.thr
+                s_lossall[i, j] = s.lossall
+                s_lat_lo[i, j] = s.lat_lo
+                s_lat_w[i, j] = s.lat_w
+                s_mask[i, j] = True
+                s_live[i, j] = s.live
+
+        out = kernel.step(HostBatch(
+            t_slot, t_dl, t_seq, t_mask, c_slot, c_mask,
+            s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
+            s_lat_lo, s_lat_w, s_mask, s_live, clock, advance))
+
+        # -- settle sends, dispatch events, detect stops ------------------
+        woke: List[_World] = []
+        for w, (adds, cancels, sends) in zip(worlds, rounds):
+            i = w.idx
+            for j, s in enumerate(sends):
+                if out.send_ok[i, j]:
+                    w.stat.msg_count += 1
+                elif s.live:
+                    w.rt.time.drop_send(s)
+            if w.done:
+                continue
+            w.rt.time.elapsed_ns = int(out.clock[i])
+            if out.deadlock[i]:
+                finish(w, error=Deadlock(
+                    f"deadlock detected at t={w.rt.time.elapsed_ns / 1e9:.9f}s: "
+                    "all tasks are blocked and no timers are pending"))
+                continue
+            lim = w.rt.task.time_limit_ns
+            if lim is not None and w.rt.time.elapsed_ns >= lim:
+                finish(w, error=TimeLimitExceeded(
+                    f"time limit ({lim / NANOS_PER_SEC}s) exceeded"))
+                continue
+            fired = False
+            with context.enter_handle(w.rt.handle):
+                for k in range(out.event_valid.shape[1]):
+                    if not out.event_valid[i, k]:
+                        break
+                    w.rt.time.fire(int(out.event_seq[i, k]))
+                    fired = True
+            if fired or out.more_due[i]:
+                woke.append(w)
+
+        # -- drain rounds: >K events due fire before any poll runs --------
+        while np.any(out.more_due[list(pending)] if pending else False):
+            drained = kernel.step(HostBatch(
+                zero_i32, np.zeros((W, 0), np.int64),
+                np.zeros((W, 0), np.int64), np.zeros((W, 0), np.bool_),
+                zero_i32, np.zeros((W, 0), np.bool_),
+                np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.int64),
+                zero_i32, np.zeros((W, 0), np.int64),
+                np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.bool_),
+                np.zeros((W, 0), np.int64), np.ones((W, 0), np.int64),
+                np.zeros((W, 0), np.bool_), np.zeros((W, 0), np.bool_),
+                np.asarray(out.clock), np.zeros((W,), np.bool_)))
+            for w in worlds:
+                i = w.idx
+                if w.done or not out.more_due[i]:
+                    continue
+                with context.enter_handle(w.rt.handle):
+                    for k in range(drained.event_valid.shape[1]):
+                        if not drained.event_valid[i, k]:
+                            break
+                        w.rt.time.fire(int(drained.event_seq[i, k]))
+            out = drained
+
+        for w in woke:
+            if not w.done:
+                run_host(w)
+
+    return [o for o in outcomes], traces

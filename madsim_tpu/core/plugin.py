@@ -42,13 +42,20 @@ class SimulatorRegistry:
         self._sims[type(sim)] = sim
 
     def get(self, cls: Type[S]) -> S:
-        try:
-            return self._sims[cls]  # type: ignore[return-value]
-        except KeyError:
-            raise KeyError(f"simulator {cls.__name__} is not registered") from None
+        sim = self._sims.get(cls)
+        if sim is None:
+            # A registered subclass satisfies lookups by its base (e.g. the
+            # bridge backend registers a NetSim subclass; user code keeps
+            # asking for NetSim).
+            for s in self._sims.values():
+                if isinstance(s, cls):
+                    return s  # type: ignore[return-value]
+            raise KeyError(f"simulator {cls.__name__} is not registered")
+        return sim  # type: ignore[return-value]
 
     def contains(self, cls: type) -> bool:
-        return cls in self._sims
+        return cls in self._sims or any(
+            isinstance(s, cls) for s in self._sims.values())
 
     def all(self):
         return list(self._sims.values())

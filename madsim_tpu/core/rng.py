@@ -18,14 +18,33 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from ..ops.threefry import derive_stream_np, seed_to_key, threefry2x32_scalar
+from ..ops.threefry import (derive_stream_np, seed_to_key,
+                            threefry2x32_scalar)
 
-# Named stream ids. The host engine draws everything from GLOBAL (matching the
-# reference's single SmallRng); the device engine uses per-purpose streams.
+# Named stream ids. The reference draws everything from one SmallRng
+# (`rand.rs:50-81`); here each purpose owns an independent Threefry stream so
+# any framework decision is addressable as (seed, purpose, draw-index) — the
+# property that lets the batched device kernel reproduce host draws exactly
+# (SURVEY §7 "bit-exact determinism across backends"). STREAM_GLOBAL is the
+# user-visible rng (thread_rng); the others are framework-internal.
 STREAM_GLOBAL = 0
 STREAM_TIME_BASE = 1
-STREAM_SCHED = 2
-STREAM_NET = 3
+STREAM_SCHED = 2   # executor: ready-pick + per-poll jitter
+STREAM_NET = 3     # network: per-message delay, loss, latency
+STREAM_FS = 4      # filesystem: I/O latency
+
+
+def loss_threshold(p: float) -> int:
+    """Packet-loss probability → u64 threshold: lost iff draw < threshold.
+
+    Integer compare instead of float ``random() < p`` so the device kernel
+    reproduces the decision with pure uint64 ops (no float rounding drift
+    between host Python and XLA)."""
+    if p <= 0.0:
+        return 0
+    if p >= 1.0:
+        return 1 << 64  # above any u64 draw: always lost
+    return int(p * 18446744073709551616.0)  # p * 2**64
 
 
 class DeterminismError(Exception):
@@ -37,9 +56,13 @@ class GlobalRng:
 
     def __init__(self, seed: int, stream: int = STREAM_GLOBAL):
         self.seed = seed & ((1 << 64) - 1)
-        k0, k1 = seed_to_key(self.seed)
-        dk0, dk1 = derive_stream_np(k0, k1, stream)
-        self._k0, self._k1 = int(dk0), int(dk1)
+        # Scalar-int derive (bit-identical to derive_stream_np, which stays
+        # for array callers): four GlobalRngs exist per world, and numpy
+        # scalar threefry was a measurable slice of batched world setup.
+        stream &= (1 << 64) - 1
+        self._k0, self._k1 = threefry2x32_scalar(
+            self.seed & 0xFFFFFFFF, self.seed >> 32,
+            stream & 0xFFFFFFFF, stream >> 32)
         self._counter = 0
         self._buf: Optional[int] = None
         # Draw backend: native C++ core when built, else scalar Python —
@@ -126,6 +149,28 @@ class GlobalRng:
         if self._mode is not None:
             self._observe(v)
         return v
+
+    def reserve(self, n: int) -> int:
+        """Consume ``n`` whole u64 blocks and return the first counter.
+
+        The bridge backend reserves draw indices at the event's host-side
+        program point; the device kernel later evaluates
+        ``threefry(key, base..base+n-1)`` — the same values sequential
+        :meth:`next_u64` calls would have produced here."""
+        if self._st is not None:
+            base, _buf = self._lib.rng_get_state(self._st)
+            for _ in range(n):
+                self._lib.rng_next_u64(self._st)
+        else:
+            base = self._counter
+            self._counter += n
+            self._buf = None
+        return base
+
+    @property
+    def key(self) -> tuple:
+        """The derived (k0, k1) stream key (device-kernel parity hook)."""
+        return self._k0, self._k1
 
     # -- distribution helpers (rand-crate-style surface) -------------------
     def gen_range(self, low: int, high: int) -> int:

@@ -15,7 +15,7 @@ from typing import Any, Callable, Coroutine, Optional, Union
 from . import context
 from .config import Config
 from .plugin import Simulator, SimulatorRegistry
-from .rng import GlobalRng
+from .rng import STREAM_SCHED, GlobalRng
 from .task import Executor, Node, TimeLimitExceeded  # noqa: F401 (re-export)
 from .timewheel import TimeRuntime, to_ns
 
@@ -116,17 +116,28 @@ class Runtime:
         self.seed = seed
         self.config = config or Config()
         self.rand = GlobalRng(seed)
-        self.time = TimeRuntime(self.rand)
+        self.time = self._make_time()
         self.rand.set_clock(self.time.now_ns)
-        self.task = Executor(self.rand, self.time)
+        # The scheduler draws (ready-pick, poll jitter) come from their own
+        # stream so they are addressable by poll index — user-code draws on
+        # the GLOBAL stream can no longer shift them (and vice versa).
+        self.task = Executor(GlobalRng(seed, stream=STREAM_SCHED), self.time)
         self.handle = Handle(seed, self.config, self.rand, self.time, self.task)
         self.task.on_reset_node = self._reset_node_in_sims
-        # Default simulators. Late imports keep core free of upper layers.
-        from ..net import NetSim
-        from ..fs import FsSim
+        for sim_cls in self._default_simulators():
+            self.add_simulator(sim_cls)
 
-        self.add_simulator(NetSim)
-        self.add_simulator(FsSim)
+    # Overridable wiring (the bridge backend substitutes a device-backed
+    # timer wheel and a device-sampling NetSim, keeping everything else).
+    def _make_time(self) -> TimeRuntime:
+        return TimeRuntime(self.rand)
+
+    def _default_simulators(self) -> tuple:
+        # Late imports keep core free of upper layers.
+        from ..fs import FsSim
+        from ..net import NetSim
+
+        return (NetSim, FsSim)
 
     def _reset_node_in_sims(self, node_id: int) -> None:
         for sim in self.handle.sims.all():

@@ -147,6 +147,10 @@ class Executor:
         self._next_task_id = 0
         self.time_limit_ns: Optional[int] = None
         self._uncaught: Optional[BaseException] = None
+        # Optional per-poll trace sink: (task_id, elapsed_ns) tuples. Used
+        # by the bridge-equality tests to prove two engines walked the same
+        # trajectory; None (the default) costs one attribute check per poll.
+        self.trace: Optional[List] = None
         self.main_node = self.create_node(name="main", cores=1, init=None)
         # Hooks the Runtime installs so node lifecycle reaches simulators.
         self.on_reset_node: Optional[Callable[[int], None]] = None
@@ -247,10 +251,16 @@ class Executor:
     # ------------------------------------------------------------------
     # The hot loop (`task.rs:121-180`)
     # ------------------------------------------------------------------
-    def block_on(self, coro: Coroutine) -> Any:
+    def start_root(self, coro: Coroutine) -> Task:
+        """Enqueue a root task without entering the loop (the bridge sweep
+        driver owns the loop; ``block_on`` stays the single-world path)."""
         root = Task(self._next_task_id, coro, self.main_node.info)
         self._next_task_id += 1
         self._enqueue(root)
+        return root
+
+    def block_on(self, coro: Coroutine) -> Any:
+        root = self.start_root(coro)
         while True:
             self.run_all_ready()
             if self._uncaught is not None:
@@ -299,6 +309,8 @@ class Executor:
             prev_task = getattr(tls, "task", None)
             tls.task = task
             self.poll_count += 1
+            if self.trace is not None:
+                self.trace.append((task.id, self.time.elapsed_ns))
             try:
                 self._poll(task)
             finally:

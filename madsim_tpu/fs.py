@@ -44,6 +44,11 @@ class FsSim(Simulator):
     def __init__(self, handle):
         super().__init__(handle)
         self._disks: Dict[int, Dict[str, _INode]] = {}
+        # I/O latency draws live on the FS stream (core/rng.py stream map)
+        # so disk activity never shifts scheduler/network/user draw indices.
+        from .core.rng import STREAM_FS, GlobalRng
+
+        self._rand = GlobalRng(handle.seed, stream=STREAM_FS)
 
     def create_node(self, node_id: int) -> None:
         self._disks.setdefault(node_id, {})
@@ -64,7 +69,7 @@ class FsSim(Simulator):
         if hi > 0:
             from . import time as vtime
 
-            await vtime.sleep(self.handle.rand.gen_range_f64(lo, hi))
+            await vtime.sleep(self._rand.gen_range_f64(lo, hi))
 
 
 def _fs() -> FsSim:

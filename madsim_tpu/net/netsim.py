@@ -45,9 +45,15 @@ class NetSim(Simulator):
 
     def __init__(self, handle):
         super().__init__(handle)
-        self.network = Network(handle.rand, handle.config.net)
+        # All network decisions (per-message delay, loss, latency) draw from
+        # the dedicated NET stream: draw k of seed s is threefry block
+        # (net_key(s), k) — the addressing the batched device kernel uses to
+        # reproduce them (core/rng.py stream map).
+        from ..core.rng import STREAM_NET, GlobalRng
+
+        self.rand = GlobalRng(handle.seed, stream=STREAM_NET)
+        self.network = Network(self.rand, handle.config.net)
         self.time = handle.time
-        self.rand = handle.rand
         self.executor = handle.task
 
     # -- Simulator hooks ---------------------------------------------------

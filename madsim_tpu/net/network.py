@@ -13,7 +13,7 @@ import logging
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.config import NetConfig
-from ..core.rng import GlobalRng
+from ..core.rng import GlobalRng, loss_threshold
 from ..core.timewheel import to_ns
 from .addr import Addr, format_addr, ip_is_loopback, ip_is_unspecified
 
@@ -180,13 +180,25 @@ class Network:
 
     # -- sending (`network.rs:249-301`) ------------------------------------
     def test_link(self, src: int, dst: int) -> Optional[int]:
-        """Clog check → Bernoulli loss → uniform latency (ns), None = no
-        delivery now. The fault-injection point of the whole system."""
-        if self.link_clogged(src, dst) or self.rand.gen_bool(self.config.packet_loss_rate):
+        """Clog check → loss → uniform latency (ns), None = no delivery now.
+        The fault-injection point of the whole system.
+
+        Draw discipline (deliberate divergence from the reference's
+        short-circuit at `network.rs:249-257`): every call consumes exactly
+        TWO u64 blocks from the NET stream — loss then latency — regardless
+        of the clog/loss outcome, so each message's draw indices are a pure
+        function of send order. That stability is what lets the device
+        kernel sample the same decisions from (net_key, counter) without
+        knowing fault outcomes in advance. Loss is an integer threshold
+        compare (see :func:`core.rng.loss_threshold`), exact on both
+        backends."""
+        lost = self.rand.next_u64() < loss_threshold(self.config.packet_loss_rate)
+        lo, hi = self.config.send_latency
+        latency = self.rand.gen_range(to_ns(lo), max(to_ns(hi), to_ns(lo) + 1))
+        if self.link_clogged(src, dst) or lost:
             return None
         self.stat.msg_count += 1
-        lo, hi = self.config.send_latency
-        return self.rand.gen_range(to_ns(lo), max(to_ns(hi), to_ns(lo) + 1))
+        return latency
 
     def resolve_dest_node(self, node_id: int, dst: Addr, protocol: IpProtocol) -> Optional[int]:
         node = self.nodes[node_id]
