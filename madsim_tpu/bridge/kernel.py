@@ -37,7 +37,14 @@ from typing import NamedTuple
 
 import numpy as np
 
-INF_NS = (1 << 62)
+from ..core.timewheel import TIMER_MAX_NS
+
+# Empty-lane sentinel. Deadlines clamp at TIMER_MAX_NS = 2^62-1, and the
+# clock can creep slightly past a clamped deadline (advance epsilon, poll
+# jitter), so the sentinel must sit far above any *reachable clock*, not
+# just above any deadline — otherwise empty lanes read as due and the
+# drain loop never terminates. i64 max gives 2^61 ns of headroom.
+INF_NS = (1 << 63) - 1
 _EPSILON_NS = 50  # core/timewheel.py ADVANCE_EPSILON_NS
 
 
@@ -128,7 +135,10 @@ def _step(state: BridgeState, net_k0, net_k1,
     latency = s_lat_lo + (u_lat % s_lat_w.astype(jnp.uint64)).astype(jnp.int64)
     deliver = ok & s_live
     s_slot = jnp.where(deliver, s_slot, dump)
-    lane_dl = lane_dl.at[rows, s_slot].set(s_base + latency)
+    # Same horizon clamp as the host wheel's add_timer_at: a delivery
+    # scheduled past TIMER_MAX_NS must land on the same clamped instant.
+    send_dl = jnp.minimum(s_base + latency, jnp.int64(TIMER_MAX_NS))
+    lane_dl = lane_dl.at[rows, s_slot].set(send_dl)
     lane_seq = lane_seq.at[rows, s_slot].set(s_seq)
 
     # 4. Advance each world's clock to its next event

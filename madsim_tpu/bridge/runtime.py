@@ -39,13 +39,11 @@ from ..core.config import Config
 from ..core.rng import GlobalRng, loss_threshold
 from ..core.runtime import Runtime
 from ..core.task import Deadlock, TimeLimitExceeded
-from ..core.timewheel import NANOS_PER_SEC, TimeRuntime, to_ns
+from ..core.timewheel import NANOS_PER_SEC, TIMER_MAX_NS, TimeRuntime, to_ns
 from ..net.addr import ip_is_loopback, unspecified_for
 from ..net.netsim import NetSim
 from ..net.network import LOCALHOST_V4
 from .kernel import BridgeKernel, HostBatch, StepOut, bucket
-
-_I64_MAX = 2**63 - 1
 
 
 class _TimerHandle:
@@ -94,7 +92,11 @@ class BridgeTime(TimeRuntime):
 
     # -- the TimeRuntime surface ------------------------------------------
     def add_timer_at(self, deadline_ns: int, callback: Callable[[], None]):
-        deadline_ns = min(max(deadline_ns, self.elapsed_ns), _I64_MAX)
+        # Same clamp as the host wheel (timewheel.py): TIMER_MAX_NS is one
+        # below the device kernel's empty-lane sentinel, so an over-range
+        # timer stays visible to has_timer instead of reading as "no timer"
+        # (which would report a spurious Deadlock the host never sees).
+        deadline_ns = min(max(deadline_ns, self.elapsed_ns), TIMER_MAX_NS)
         seq = self._seq
         self._seq += 1
         slot = self._alloc()
@@ -495,6 +497,17 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
 
         # -- drain rounds: >K events due fire before any poll runs --------
         while np.any(out.more_due[list(pending)] if pending else False):
+            # Drain batches are zero-width: anything a fire() callback
+            # recorded would silently miss its own due cluster and fire in
+            # the wrong order vs the host heap. No framework callback does
+            # that today — enforce it rather than assume it.
+            for w in worlds:
+                if w.done or not out.more_due[w.idx]:
+                    continue
+                t = w.rt.time
+                assert not (t.pending_add or t.sends or t.cancels), (
+                    "bridge drain invariant violated: a fire() callback "
+                    "recorded timers/sends during event dispatch")
             drained = kernel.step(HostBatch(
                 zero_i32, np.zeros((W, 0), np.int64),
                 np.zeros((W, 0), np.int64), np.zeros((W, 0), np.bool_),

@@ -28,6 +28,12 @@ NANOS_PER_SEC = 1_000_000_000
 # Epsilon added when advancing to a timer deadline; mirrors the monotonicity
 # workaround at `time/mod.rs:46-56`.
 ADVANCE_EPSILON_NS = 50
+# Largest storable deadline (~146 sim-years). One clamp shared by every
+# backend — Python heap, native int64 heap, and the bridge's device lanes
+# (whose empty-lane sentinel is i64 max, kept 2^61 ns above this horizon
+# so a clock that creeps past a clamped deadline can never reach it) — so
+# an over-range timer fires at the same virtual instant on all of them.
+TIMER_MAX_NS = (1 << 62) - 1
 
 _UNIX_2022 = 1_640_995_200  # 2022-01-01T00:00:00Z
 _SECS_IN_2022 = 365 * 24 * 3600
@@ -111,7 +117,7 @@ class TimeRuntime:
         # deadlines while Python ints are unbounded, and both backends must
         # fire an over-range timer at the *same* (clamped) virtual time or
         # determinism logs recorded on one backend fail replay on the other.
-        deadline_ns = min(max(deadline_ns, self.elapsed_ns), 2**63 - 1)
+        deadline_ns = min(max(deadline_ns, self.elapsed_ns), TIMER_MAX_NS)
         seq = self._seq
         self._seq += 1
         if self._native_heap is not None:

@@ -403,7 +403,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         elif phase == _PH_OOB_HEAD:
             nbufs, slen = _OOB_HEAD.unpack_from(self._target)
             rest = self._lens[0] - _OOB_HEAD.size
-            if nbufs == 0 or 4 * nbufs + slen > rest:
+            if nbufs == 0 or slen == 0 or 4 * nbufs + slen > rest:
                 raise _FrameError(f"bad buffer table ({nbufs} buffers)")
             self._lens = (rest, slen)
             self._begin(_PH_OOB_TABLE, 4 * nbufs)
@@ -411,7 +411,12 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             nbufs = len(self._target) // 4
             rest, slen = self._lens
             lens = struct.unpack(f">{nbufs}I", self._target)
-            if 4 * nbufs + slen + sum(lens) != rest:
+            # Zero-length entries are rejected outright: a zero-size section
+            # only finalizes when *later* bytes arrive (buffer_updated's loop
+            # runs on incoming data), so a frame ending on one would stall
+            # complete in the parser. Legitimate senders never emit them —
+            # only buffers >= _OOB_MIN are hoisted out of band.
+            if 0 in lens or 4 * nbufs + slen + sum(lens) != rest:
                 raise _FrameError("frame length / buffer table mismatch")
             self._lens = lens
             self._bufs = []
@@ -522,10 +527,16 @@ class RealChannelSender:
         self._lock = asyncio.Lock()
 
     async def send(self, payload) -> None:
-        if self._proto._closed:
-            raise ConnectionReset("connection reset")
         try:
             async with self._lock:
+                # Checked under the lock (a sender queued behind the lock
+                # must re-observe transport state). is_closing() covers the
+                # window between transport.close() and connection_lost
+                # delivery: a write there is silently dropped while drain()
+                # reports success, violating the sim's closed-send
+                # semantics (ConnectionReset).
+                if self._proto._closed or self._transport.is_closing():
+                    raise ConnectionReset("connection reset")
                 _write_frames(self._transport, _encode_frames(0, payload))
                 await self._proto.drain()
         except (ConnectionError, OSError, RuntimeError):
@@ -761,9 +772,13 @@ class RealEndpoint:
             raise BrokenPipe("endpoint closed")
         frames = _encode_frames(tag, data)
         conn = await self._get_or_connect(dst)
-        if conn.proto._closed:
-            raise ConnectionReset("connection reset")
         async with conn.lock:
+            # Checked under the lock: a sender queued behind an in-flight
+            # send must re-observe the transport state, and is_closing()
+            # covers the window between a fatal close and connection_lost
+            # where writes are silently discarded while _closed is False.
+            if conn.proto._closed or conn.transport.is_closing():
+                raise ConnectionReset("connection reset")
             _write_frames(conn.transport, frames)
             await conn.proto.drain()
 

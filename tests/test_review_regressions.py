@@ -260,3 +260,25 @@ def test_task_set_iteration_is_insertion_ordered():
 
     info = NodeInfo(0, "n", 1)
     assert isinstance(info.tasks, dict)
+
+
+def test_timer_beyond_2_62_ns_fires_identically_on_bridge():
+    """ADVICE r4 (medium): the bridge kernel's empty-lane sentinel used to
+    sit at 2^62 while deadlines clamped at 2^63-1, so a timer in
+    [2^62, 2^63) was invisible to has_timer and sweep() reported a
+    spurious Deadlock where the host engine advanced. Both wheels now
+    clamp at TIMER_MAX_NS = 2^62 - 1 (one below the sentinel)."""
+    from madsim_tpu.bridge import sweep
+    from madsim_tpu.core.timewheel import TIMER_MAX_NS
+
+    async def world():
+        await time.sleep(5e9)  # 5e18 ns > 2^62 ns: lands in the clamp zone
+        return ms.Handle.current().time.elapsed_ns
+
+    rt = ms.Runtime(seed=7)
+    host_ns = rt.block_on(world())
+    assert host_ns > TIMER_MAX_NS  # clamped deadline + advance epsilon
+
+    (out,) = sweep(world, [7])
+    assert out.error is None, out.error
+    assert out.value == host_ns
