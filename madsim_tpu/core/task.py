@@ -340,9 +340,18 @@ class Executor:
             self._uncaught = exc
         else:
             if not isinstance(yielded, SimFuture):
+                # Name the frame that suspended so drop-in gaps (a stdlib
+                # awaitable reaching the sim executor) are diagnosable.
+                frame = getattr(task.coro, "cr_frame", None)
+                inner = task.coro
+                while (aw := getattr(inner, "cr_await", None)) is not None:
+                    inner = aw
+                    frame = getattr(inner, "cr_frame", frame) or frame
+                at = (f" at {frame.f_code.co_filename}:{frame.f_lineno} "
+                      f"({frame.f_code.co_name})" if frame is not None else "")
                 err = TypeError(
                     f"task awaited a foreign awaitable (yielded a "
-                    f"{type(yielded).__name__}); only madsim_tpu futures "
+                    f"{type(yielded).__name__}){at}; only madsim_tpu futures "
                     "(sleep, channels, endpoints, ...) can suspend a "
                     "simulation task"
                 )

@@ -330,7 +330,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             if self._fill == len(self._target):
                 self._direct = False
                 try:
-                    self._section_done()
+                    self._advance_sections()
                 except _FrameError:
                     self._protocol_error()
             return
@@ -343,7 +343,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 self._fill += take
                 off += take
                 if self._fill == len(self._target):
-                    self._section_done()
+                    self._advance_sections()
         except _FrameError:
             self._protocol_error()
             return
@@ -358,6 +358,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._emit_eof()
         if self.transport is not None:
             self.transport.close()
+
+    def _advance_sections(self) -> None:
+        """Complete the filled section, then any zero-size sections it
+        begins: those are already "full" with no bytes to arrive, so waiting
+        for the next read would stall a complete message in the parser
+        (e.g. a frame whose last out-of-band buffer is 0 bytes)."""
+        self._section_done()
+        while not self._closed and self._fill == len(self._target):
+            self._section_done()
 
     def _section_done(self) -> None:
         phase = self._phase
@@ -403,7 +412,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         elif phase == _PH_OOB_HEAD:
             nbufs, slen = _OOB_HEAD.unpack_from(self._target)
             rest = self._lens[0] - _OOB_HEAD.size
-            if nbufs == 0 or slen == 0 or 4 * nbufs + slen > rest:
+            if nbufs == 0 or 4 * nbufs + slen > rest:
                 raise _FrameError(f"bad buffer table ({nbufs} buffers)")
             self._lens = (rest, slen)
             self._begin(_PH_OOB_TABLE, 4 * nbufs)
@@ -411,12 +420,12 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             nbufs = len(self._target) // 4
             rest, slen = self._lens
             lens = struct.unpack(f">{nbufs}I", self._target)
-            # Zero-length entries are rejected outright: a zero-size section
-            # only finalizes when *later* bytes arrive (buffer_updated's loop
-            # runs on incoming data), so a frame ending on one would stall
-            # complete in the parser. Legitimate senders never emit them —
-            # only buffers >= _OOB_MIN are hoisted out of band.
-            if 0 in lens or 4 * nbufs + slen + sum(lens) != rest:
+            # Zero-length entries are legitimate: pickle's buffer_callback
+            # collects every out-of-band PickleBuffer the payload emits
+            # (an empty numpy array yields a 0-byte one). _advance_sections
+            # finalizes zero-size sections eagerly so a frame ending on one
+            # cannot stall complete in the parser.
+            if 4 * nbufs + slen + sum(lens) != rest:
                 raise _FrameError("frame length / buffer table mismatch")
             self._lens = lens
             self._bufs = []

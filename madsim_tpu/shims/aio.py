@@ -19,9 +19,16 @@ simulation. Two usage modes:
    function falls through to the real implementation, exactly like the
    reference's ``dlsym(RTLD_NEXT)`` passthrough.
 
+``patched()`` also swaps the *running event loop* surface: code that opens
+its own sockets through ``loop.create_connection`` / ``create_server`` /
+``sock_*`` (pip aiohttp, protocol-level DB clients) lands on the simulated
+network — see :mod:`madsim_tpu.shims.eventloop` and tests/test_eventloop.py
+(the tokio-postgres-class proof, `madsim-tokio-postgres/src/socket.rs:6-13`).
+
 Not simulable at this level (documented gap, SURVEY §7): code that drives
 its own event loop (``asyncio.run``/``loop.run_until_complete`` inside the
-sim), raw selectors/sockets, and threads.
+sim), raw selector registration (``loop.add_reader`` on real fds), and
+threads.
 """
 from __future__ import annotations
 
@@ -67,6 +74,7 @@ class Task:
         self._handle = handle
         self._fut = fut
         self._coro = coro
+        self._done_callbacks: List[tuple] = []  # (user cb, installed wrapper)
 
     def cancel(self) -> bool:
         """Request cancellation (asyncio semantics): CancelledError is
@@ -104,7 +112,8 @@ class Task:
         return self._fut.done()
 
     def cancelled(self) -> bool:
-        return self._fut.done() and isinstance(self._fut._exception, Cancelled)
+        return self._fut.done() and isinstance(self._fut._exception,
+                                               CANCELLED_TYPES)
 
     def result(self) -> Any:
         if not self._fut.done():
@@ -115,6 +124,50 @@ class Task:
         if not self._fut.done():
             raise RuntimeError("task is not done")
         return self._fut._exception
+
+    # -- asyncio.Task surface used by third-party code under patched() ----
+    def add_done_callback(self, cb: Callable[["Task"], None]) -> None:
+        """asyncio semantics: the callback receives the *task* object."""
+        def wrapper(_f, cb=cb):
+            cb(self)
+
+        self._done_callbacks.append((cb, wrapper))
+        self._fut.add_done_callback(wrapper)
+
+    def remove_done_callback(self, cb: Callable[["Task"], None]) -> int:
+        removed = 0
+        kept = []
+        for user_cb, wrapper in self._done_callbacks:
+            if user_cb == cb:
+                removed += 1
+                try:
+                    self._fut._callbacks.remove(wrapper)
+                except ValueError:
+                    pass  # already fired
+            else:
+                kept.append((user_cb, wrapper))
+        self._done_callbacks = kept
+        return removed
+
+    def get_name(self) -> str:
+        return f"sim-task-{getattr(self._handle, 'id', '?')}"
+
+    def set_name(self, name: str) -> None:
+        pass
+
+    def get_coro(self) -> Coroutine:
+        return self._coro
+
+    def get_loop(self):
+        from .eventloop import get_sim_loop
+
+        return get_sim_loop()
+
+    def uncancel(self) -> int:
+        return 0
+
+    def cancelling(self) -> int:
+        return 0
 
     def __await__(self):
         return self._fut.__await__()
@@ -255,12 +308,20 @@ class Timeout:
     cancellation into TimeoutError.
     """
 
-    def __init__(self, delay: float):
-        self._delay = delay
+    def __init__(self, delay: "float | None", when: "float | None" = None):
+        self._delay = delay    # relative seconds, or None = never expires
+        self._when = when      # absolute loop-time deadline (timeout_at)
         self._expired = False
         self._timer = None
 
     async def __aenter__(self):
+        if self._when is not None:
+            self._delay = max(0.0, self._when - _time.monotonic())
+        if self._delay is None:  # asyncio.timeout(None): no deadline
+            return self
+        if self._when is None:
+            # asyncio contract: when() is the absolute deadline once armed.
+            self._when = _time.monotonic() + self._delay
         task = _context.current_task()
         executor = _context.current_handle().task
 
@@ -273,7 +334,8 @@ class Timeout:
         return self
 
     async def __aexit__(self, exc_type, exc, tb):
-        self._timer.cancel()
+        if self._timer is not None:
+            self._timer.cancel()
         if self._expired and (exc_type is None
                               or issubclass(exc_type, CANCELLED_TYPES)):
             raise TimeoutError() from None
@@ -282,8 +344,18 @@ class Timeout:
     def expired(self) -> bool:
         return self._expired
 
+    def when(self) -> "float | None":
+        return self._when
 
-def timeout(delay: float):
+    def reschedule(self, when: "float | None") -> None:
+        # Supported only before __aenter__ arms the timer (the common
+        # library pattern: construct, adjust, then enter).
+        if self._timer is not None:
+            raise RuntimeError("cannot reschedule an armed sim timeout")
+        self._when = when
+
+
+def timeout(delay: "float | None"):
     from ..core.backend import is_real
 
     if is_real():
@@ -292,6 +364,20 @@ def timeout(delay: float):
 
         return _real_asyncio.timeout(delay)
     return Timeout(delay)
+
+
+def timeout_at(when: "float | None"):
+    """asyncio.timeout_at on the virtual clock (deadline in loop.time()
+    terms, i.e. virtual monotonic seconds)."""
+    from ..core.backend import is_real
+
+    if is_real():
+        import asyncio as _real_asyncio
+
+        return _real_asyncio.timeout_at(when)
+    if when is None:
+        return Timeout(None)
+    return Timeout(0.0, when=when)
 
 
 class TaskGroup:
@@ -410,23 +496,29 @@ class TaskGroup:
 
 
 def get_event_loop():
-    """Minimal loop object for code that calls loop.time()/create_task()."""
-    return _Loop()
+    """The current world's SimEventLoop: the full transport/protocol
+    surface (create_connection/create_server/sock_*), cached per Handle so
+    library identity checks (``loop is self._loop``) hold. See
+    :mod:`madsim_tpu.shims.eventloop`."""
+    from .eventloop import get_sim_loop
+
+    return get_sim_loop()
 
 
 get_running_loop = get_event_loop
 
 
-class _Loop:
-    def time(self) -> float:
-        return _time.monotonic()
+def current_task(loop=None):
+    """asyncio.current_task over the sim executor: a per-task view with the
+    3.11 cancel/uncancel counting protocol (aiohttp's TimerContext relies
+    on it to convert its own cancellation into TimeoutError)."""
+    from .eventloop import current_task_view
 
-    def create_task(self, coro: Coroutine) -> Task:
-        return create_task(coro)
+    return current_task_view()
 
-    def call_later(self, delay: float, cb: Callable, *args):
-        handle = _context.current_handle()
-        return handle.time.add_timer(_time.to_ns(delay), lambda: cb(*args))
+
+def all_tasks(loop=None):
+    return set()  # introspection-only surface; not tracked in-sim
 
 
 # ---------------------------------------------------------------------------
@@ -576,6 +668,32 @@ def install() -> None:
     patch(_aio, "create_task", passthrough(_aio.create_task, _sim_create_task))
     patch(_aio, "ensure_future", passthrough(_aio.ensure_future, _sim_create_task))
 
+    # Direct asyncio.Task(...) construction (aiohttp's 3.12 eager-start
+    # path) must yield a sim task in-sim, while staying a real *type*:
+    # isinstance(x, asyncio.Task) and `class Mine(asyncio.Task)` keep
+    # working under patched(). A metaclass dispatches only the patched
+    # name's own constructor; subclasses construct normally. Eagerness is a
+    # latency optimization, not semantics — the sim schedules the task
+    # through the seeded ready queue like any other spawn.
+    orig_task_cls = _aio.Task
+
+    class _TaskDispatchMeta(type(orig_task_cls)):
+        def __call__(cls, coro=None, **kw):
+            if cls is task_patch_cls and _in_sim():
+                return create_task(coro)
+            return super().__call__(coro, **kw)
+
+        def __instancecheck__(cls, obj):
+            return isinstance(obj, (orig_task_cls, Task))
+
+    class _TaskPatch(orig_task_cls, metaclass=_TaskDispatchMeta):
+        pass
+
+    task_patch_cls = _TaskPatch
+    _TaskPatch.__name__ = orig_task_cls.__name__
+    _TaskPatch.__qualname__ = orig_task_cls.__qualname__
+    patch(_aio, "Task", _TaskPatch)
+
     async def _sim_to_thread(fn, /, *a, **kw):
         # In-sim "thread offload" runs the callable as a deterministic task
         # (madsim-tokio's spawn_blocking mapping); real threads inside a
@@ -588,6 +706,16 @@ def install() -> None:
     patch(_aio, "wait", passthrough(_aio.wait, wait))
     patch(_aio, "as_completed", passthrough(_aio.as_completed, as_completed))
     patch(_aio, "timeout", passthrough(_aio.timeout, timeout))
+    patch(_aio, "timeout_at", passthrough(_aio.timeout_at, timeout_at))
+    patch(_aio, "current_task", passthrough(_aio.current_task, current_task))
+    patch(_aio, "all_tasks", passthrough(_aio.all_tasks, all_tasks))
+    # Stdlib-internal call sites resolve these through asyncio.events
+    # (``events.get_running_loop()``), not the package namespace — patch
+    # both so library code reaches the sim loop either way.
+    patch(_aio.events, "get_running_loop",
+          passthrough(_aio.events.get_running_loop, get_running_loop))
+    patch(_aio.events, "get_event_loop",
+          passthrough(_aio.events.get_event_loop, get_event_loop))
     for name, cls in [("Event", Event), ("Lock", Lock),
                       ("Semaphore", Semaphore), ("Queue", Queue),
                       ("Condition", Condition), ("TaskGroup", TaskGroup)]:

@@ -1,0 +1,647 @@
+"""Event-loop-level drop-in: asyncio's transport/protocol surface over sim TCP.
+
+This is the deepest interception layer (VERDICT r4 item 2, the
+tokio-postgres-class proof): unmodified third-party libraries that open
+their *own* sockets through the running event loop — aiohttp, asyncpg-style
+protocol clients — run inside the simulation with no source changes.
+Reference analog: `madsim/src/sim/net/tcp/{listener,stream}.rs` presenting
+the tokio TcpListener/TcpStream API so upstream tokio-postgres's
+``socket.rs`` connects over the simulated network unchanged
+(`madsim-tokio-postgres/src/socket.rs:6-13`).
+
+What lives here:
+
+- :class:`SimEventLoop` — the ``asyncio.AbstractEventLoop`` surface used by
+  protocol libraries: ``create_connection`` / ``create_server`` /
+  ``sock_connect`` / ``sock_sendall`` / ``sock_recv`` / ``getaddrinfo`` /
+  ``call_soon`` / ``call_later`` / ``call_at`` / ``create_future`` /
+  ``create_task`` / ``run_in_executor``, all mapped onto the deterministic
+  executor, virtual time, and the simulated network. One instance per
+  world (cached on the Handle) so identity checks (``loop is self._loop``)
+  hold.
+- :class:`SimTransport` — an ``asyncio.Transport`` over a sim
+  :class:`~madsim_tpu.net.tcp.TcpStream`: sync ``write`` with a writer
+  pump task, a reader pump feeding ``protocol.data_received``, EOF and
+  reset mapped to ``eof_received`` / ``connection_lost``.
+- :class:`SimServer` — the object ``create_server`` returns (``sockets``,
+  ``close``, ``wait_closed``), with an in-sim accept loop.
+- A socket *token* registry: modern clients (aiohttp via aiohappyeyeballs)
+  create a real ``socket.socket``, call ``loop.sock_connect(sock, addr)``,
+  then hand the sock to ``create_connection(sock=...)``. The real fd is
+  never connected; it serves as the lookup key for the sim stream
+  established by ``sock_connect`` (and as ``get_extra_info("socket")`` so
+  ``tcp_nodelay``-style tuning finds a live fd to setsockopt on).
+
+TLS is deliberately not simulated (``ssl=`` raises): in-sim traffic rides
+the deterministic network, so tests speak plain protocols, exactly like
+the reference's sim transports.
+"""
+from __future__ import annotations
+
+import socket as _socket
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import task as _task
+from ..core import context as _context
+from ..core.futures import Cancelled, Channel, ChannelClosed, SimFuture
+from ..core.timewheel import to_ns
+from ..net.addr import parse_addr
+from ..net.netsim import ConnectionReset
+from ..net.tcp import TcpListener, TcpStream
+
+import asyncio as _std_asyncio
+
+
+class AsyncioFuture(SimFuture):
+    """``loop.create_future()`` result: a SimFuture whose *cancellation
+    family* is the stdlib's, so unmodified ``except asyncio.CancelledError``
+    handlers around awaited futures keep working."""
+
+    __slots__ = ()
+
+    def cancel(self, msg: Optional[str] = None) -> bool:
+        if self.done():
+            return False
+        self.set_exception(_std_asyncio.CancelledError()
+                           if msg is None else
+                           _std_asyncio.CancelledError(msg))
+        return True
+
+    def cancelled(self) -> bool:
+        return self.done() and isinstance(self._exception,
+                                          _std_asyncio.CancelledError)
+
+    def exception(self):
+        if not self.done():
+            raise RuntimeError("future is not done")
+        return self._exception
+
+    def remove_done_callback(self, cb) -> int:
+        n = len(self._callbacks)
+        self._callbacks = [c for c in self._callbacks if c != cb]
+        return n - len(self._callbacks)
+
+    def get_loop(self):
+        return get_sim_loop()
+
+
+class SimTimerHandle:
+    """``loop.call_later``/``call_at`` handle (asyncio.TimerHandle shape)."""
+
+    __slots__ = ("_entry", "_when", "_cancelled")
+
+    def __init__(self, entry, when: float):
+        self._entry = entry
+        self._when = when
+
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self._entry.cancel()
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def when(self) -> float:
+        return self._when
+
+
+class TaskView:
+    """The object our patched ``asyncio.current_task()`` returns.
+
+    Third-party timeout scopes (aiohttp's TimerContext, stdlib-style
+    timeouts) need exactly the 3.11 cancellation-counting protocol on the
+    current task: ``cancel()`` / ``uncancel()`` / ``cancelling()``.
+    ``cancel`` delivers a *stdlib* CancelledError through the executor's
+    interrupt machinery (thrown into the task at its current await), which
+    every cancel-safe path in the framework already handles via
+    CANCELLED_TYPES."""
+
+    __slots__ = ("_task", "_executor", "_cancelling")
+
+    def __init__(self, task, executor):
+        self._task = task
+        self._executor = executor
+        self._cancelling = 0
+
+    def cancel(self, msg: Optional[str] = None) -> bool:
+        if self._task.done:
+            return False
+        self._cancelling += 1
+        self._executor.interrupt(
+            self._task,
+            _std_asyncio.CancelledError() if msg is None
+            else _std_asyncio.CancelledError(msg))
+        return True
+
+    def uncancel(self) -> int:
+        if self._cancelling > 0:
+            self._cancelling -= 1
+        return self._cancelling
+
+    def cancelling(self) -> int:
+        return self._cancelling
+
+    def done(self) -> bool:
+        return self._task.done
+
+    def cancelled(self) -> bool:
+        return self._task.cancelled
+
+    def get_name(self) -> str:
+        return f"sim-task-{self._task.id}"
+
+    def set_name(self, name: str) -> None:
+        pass
+
+    def get_coro(self):
+        return self._task.coro
+
+
+def current_task_view():
+    """The TaskView for the currently running sim task (None outside)."""
+    task = _context.try_current_task()
+    if task is None:
+        return None
+    executor = _context.current_handle().task
+    views = getattr(executor, "_asyncio_task_views", None)
+    if views is None:
+        views = executor._asyncio_task_views = {}
+    view = views.get(task.id)
+    if view is None:
+        if len(views) > 256:  # prune finished tasks' views
+            for tid in [t for t, v in views.items() if v._task.done]:
+                del views[tid]
+        view = views[task.id] = TaskView(task, executor)
+    return view
+
+
+# ---------------------------------------------------------------------------
+# Transport
+# ---------------------------------------------------------------------------
+
+_EOF_SENTINEL = object()    # write_eof: half-close after flushing
+_CLOSE_SENTINEL = object()  # close: flush, then tear down
+
+
+class SimTransport:
+    """asyncio.Transport over a sim TcpStream (write side pumped by a
+    dedicated task so ``write`` stays synchronous, read side pumped into
+    ``protocol.data_received``)."""
+
+    def __init__(self, loop: "SimEventLoop", stream: TcpStream, protocol,
+                 extra: Dict[str, Any]):
+        self._loop = loop
+        self._stream = stream
+        self._protocol = protocol
+        self._extra = extra
+        self._wq = Channel()
+        self._wbuf_size = 0
+        self._closing = False
+        self._lost = False
+        self._read_gate: Optional[SimFuture] = None
+        self._reader = None
+        self._writer = None
+
+    def start_pumps(self) -> None:
+        """Spawn reader/writer tasks; call after protocol.connection_made
+        (asyncio guarantees no data_received before connection_made)."""
+        self._reader = _task.spawn(self._read_pump())
+        self._writer = _task.spawn(self._write_pump())
+
+    # -- asyncio.BaseTransport ---------------------------------------------
+    def get_extra_info(self, name: str, default: Any = None) -> Any:
+        return self._extra.get(name, default)
+
+    def is_closing(self) -> bool:
+        return self._closing
+
+    def set_protocol(self, protocol) -> None:
+        self._protocol = protocol
+
+    def get_protocol(self):
+        return self._protocol
+
+    def close(self) -> None:
+        """Flush buffered writes, then tear down (asyncio close contract:
+        connection_lost(None) is delivered after pending data ships)."""
+        if self._closing:
+            return
+        self._closing = True
+        try:
+            self._wq.send(_CLOSE_SENTINEL)
+        except ChannelClosed:
+            self._teardown(None)
+
+    def abort(self) -> None:
+        self._closing = True
+        self._teardown(None)
+
+    # -- asyncio.WriteTransport --------------------------------------------
+    def write(self, data) -> None:
+        if self._closing or self._lost or not data:
+            return
+        self._wbuf_size += len(data)
+        try:
+            self._wq.send(bytes(data))
+        except ChannelClosed:
+            pass
+
+    def writelines(self, chunks) -> None:
+        self.write(b"".join(bytes(c) for c in chunks))
+
+    def can_write_eof(self) -> bool:
+        return True
+
+    def write_eof(self) -> None:
+        if self._closing or self._lost:
+            return
+        try:
+            self._wq.send(_EOF_SENTINEL)
+        except ChannelClosed:
+            pass
+
+    def get_write_buffer_size(self) -> int:
+        return self._wbuf_size
+
+    def get_write_buffer_limits(self) -> Tuple[int, int]:
+        return (0, 0)
+
+    def set_write_buffer_limits(self, high: int = None, low: int = None) -> None:
+        pass  # sim channels are unbounded; flow control is not simulated
+
+    # -- asyncio.ReadTransport ---------------------------------------------
+    def pause_reading(self) -> None:
+        if self._read_gate is None and not self._lost:
+            self._read_gate = SimFuture()
+
+    def resume_reading(self) -> None:
+        if self._read_gate is not None:
+            gate, self._read_gate = self._read_gate, None
+            gate.set_result(None)
+
+    def is_reading(self) -> bool:
+        return self._read_gate is None and not self._lost
+
+    # -- pumps -------------------------------------------------------------
+    async def _read_pump(self) -> None:
+        try:
+            while True:
+                if self._read_gate is not None:
+                    await self._read_gate
+                data = await self._stream.read()
+                if data == b"":
+                    keep = False
+                    if not self._lost and not self._closing:
+                        keep = bool(self._protocol.eof_received())
+                    if not keep:
+                        self._teardown(None)
+                    return
+                if self._lost:
+                    return
+                self._protocol.data_received(data)
+        except ConnectionReset as exc:
+            self._teardown(ConnectionResetError(str(exc)))
+        except Cancelled:
+            raise
+
+    async def _write_pump(self) -> None:
+        try:
+            while True:
+                item = await self._wq.recv()
+                if item is _EOF_SENTINEL:
+                    self._stream._tx.close()
+                    continue
+                if item is _CLOSE_SENTINEL:
+                    self._teardown(None)
+                    return
+                self._wbuf_size -= len(item)
+                await self._stream._tx.send(item)
+        except ChannelClosed:
+            pass
+        except ConnectionReset as exc:
+            self._teardown(ConnectionResetError(str(exc)))
+
+    def _teardown(self, exc: Optional[Exception]) -> None:
+        if self._lost:
+            return
+        self._lost = True
+        self._closing = True
+        self._wq.close()
+        self._stream.close()
+        if self._reader is not None:
+            self._reader.abort()
+        if self._writer is not None:
+            self._writer.abort()
+        sock = self._extra.get("socket")
+        if sock is not None:
+            try:
+                sock.close()  # the never-connected token fd
+            except OSError:
+                pass
+        try:
+            self._protocol.connection_lost(exc)
+        except Exception:  # noqa: BLE001 — protocol bugs must not kill the sim
+            pass
+
+
+class _FakeServerSocket:
+    """Stand-in for ``Server.sockets`` entries: consumers only inspect the
+    bound address (aiohttp's runner reads ``getsockname()``)."""
+
+    __slots__ = ("_addr",)
+    family = _socket.AF_INET
+    type = _socket.SOCK_STREAM
+    proto = _socket.IPPROTO_TCP
+
+    def __init__(self, addr: Tuple[str, int]):
+        self._addr = addr
+
+    def getsockname(self):
+        return self._addr
+
+    def fileno(self) -> int:
+        return -1
+
+    def setsockopt(self, *a, **kw) -> None:
+        pass
+
+    def getsockopt(self, *a, **kw) -> int:
+        return 0
+
+
+class SimServer:
+    """``loop.create_server`` result: in-sim accept loop feeding the
+    protocol factory (asyncio.Server shape)."""
+
+    def __init__(self, loop: "SimEventLoop", listener: TcpListener,
+                 factory: Callable[[], Any]):
+        self._loop = loop
+        self._listener = listener
+        self._factory = factory
+        self.sockets: List[_FakeServerSocket] = [
+            _FakeServerSocket(listener.local_addr())]
+        self._closed = SimFuture()
+        self._accept_task = _task.spawn(self._accept_loop())
+
+    async def _accept_loop(self) -> None:
+        try:
+            while True:
+                stream, peer = await self._listener.accept()
+                protocol = self._factory()
+                transport = SimTransport(
+                    self._loop, stream, protocol,
+                    {"peername": peer, "sockname": stream.local_addr()})
+                try:
+                    protocol.connection_made(transport)
+                except Exception:  # noqa: BLE001 — drop the conn, not the server
+                    transport.abort()
+                    continue
+                transport.start_pumps()
+        except (ConnectionReset, ChannelClosed):
+            pass  # listener closed
+        finally:
+            if not self._closed.done():
+                self._closed.set_result(None)
+
+    def close(self) -> None:
+        self._listener.close()
+
+    async def wait_closed(self) -> None:
+        await self._closed
+
+    def is_serving(self) -> bool:
+        return not self._closed.done()
+
+    async def start_serving(self) -> None:
+        pass  # always serving once created
+
+    async def serve_forever(self) -> None:
+        await SimFuture()  # parks forever; cancellation tears it down
+
+    def get_loop(self) -> "SimEventLoop":
+        return self._loop
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        self.close()
+        await self.wait_closed()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The loop
+# ---------------------------------------------------------------------------
+
+class SimEventLoop:
+    """The running-event-loop surface, one per simulation world.
+
+    Methods resolve the *current* handle at call time (timers, tasks, and
+    connections land on whatever node's context is active), but the object
+    itself is cached per Handle so library identity checks hold."""
+
+    def __init__(self, handle):
+        self._handle = handle
+        # Real socket objects used as connect tokens → their sim streams.
+        self._sock_streams: Dict[Any, TcpStream] = {}
+        self._exception_handler: Optional[Callable] = None
+        self.exceptions: List[dict] = []  # call_exception_handler records
+
+    # -- time & scheduling --------------------------------------------------
+    def time(self) -> float:
+        return self._handle.time.now_ns() / 1e9
+
+    def call_soon(self, callback, *args, context=None):
+        return self.call_later(0, callback, *args)
+
+    call_soon_threadsafe = call_soon
+
+    def call_later(self, delay: float, callback, *args, context=None):
+        entry = self._handle.time.add_timer(
+            to_ns(max(0.0, delay)), lambda: callback(*args))
+        return SimTimerHandle(entry, self.time() + delay)
+
+    def call_at(self, when: float, callback, *args, context=None):
+        entry = self._handle.time.add_timer_at(
+            round(when * 1e9), lambda: callback(*args))
+        return SimTimerHandle(entry, when)
+
+    # -- futures & tasks ----------------------------------------------------
+    def create_future(self) -> AsyncioFuture:
+        return AsyncioFuture()
+
+    def create_task(self, coro, *, name: str = None, context=None):
+        from . import aio
+
+        return aio.create_task(coro)
+
+    def run_in_executor(self, executor, fn, *args):
+        from . import aio
+
+        async def _run():
+            return await _task.spawn_blocking(lambda: fn(*args))
+
+        return aio.create_task(_run())
+
+    # -- name resolution ----------------------------------------------------
+    async def getaddrinfo(self, host, port, *, family=0, type=0, proto=0,
+                          flags=0):
+        ip, port = parse_addr((str(host), int(port or 0)))
+        fam = _socket.AF_INET6 if ":" in ip else _socket.AF_INET
+        if family not in (0, fam):
+            raise _socket.gaierror(
+                _socket.EAI_NONAME, f"no address of family {family} for {host}")
+        return [(fam, _socket.SOCK_STREAM, _socket.IPPROTO_TCP, "",
+                 (ip, port))]
+
+    async def getnameinfo(self, sockaddr, flags=0):
+        return (sockaddr[0], str(sockaddr[1]))
+
+    # -- raw-socket surface (token-keyed over sim streams) ------------------
+    async def sock_connect(self, sock, address) -> None:
+        """Associate a (never actually connected) real socket object with a
+        sim stream to ``address``; the sock is the lookup token that
+        ``create_connection(sock=...)`` and ``sock_sendall``/``sock_recv``
+        use. This is the path aiohappyeyeballs-era clients take."""
+        self._sock_streams[sock] = await TcpStream.connect(address)
+
+    async def sock_sendall(self, sock, data) -> None:
+        await self._sim_sock(sock).write_all(bytes(data))
+
+    async def sock_recv(self, sock, nbytes: int) -> bytes:
+        return await self._sim_sock(sock).read(nbytes)
+
+    async def sock_recv_into(self, sock, buf) -> int:
+        data = await self._sim_sock(sock).read(len(buf))
+        buf[: len(data)] = data
+        return len(data)
+
+    def _sim_sock(self, sock) -> TcpStream:
+        try:
+            return self._sock_streams[sock]
+        except KeyError:
+            raise OSError(
+                "socket is not connected through the sim loop "
+                "(sock_connect was never called on it)") from None
+
+    # -- connections --------------------------------------------------------
+    async def create_connection(self, protocol_factory, host=None, port=None,
+                                *, sock=None, ssl=None, family=0, proto=0,
+                                flags=0, local_addr=None, server_hostname=None,
+                                happy_eyeballs_delay=None, interleave=None,
+                                all_errors=False, ssl_handshake_timeout=None,
+                                ssl_shutdown_timeout=None):
+        if ssl:
+            raise NotImplementedError(
+                "TLS is not simulated; connect with plain protocols in-sim")
+        if sock is not None:
+            stream = self._sock_streams.pop(sock, None)
+            if stream is None:
+                raise OSError("sock was not connected through the sim loop")
+        else:
+            stream = await TcpStream.connect((host, port))
+        protocol = protocol_factory()
+        extra = {"peername": stream.peer_addr(),
+                 "sockname": stream.local_addr()}
+        if sock is not None:
+            extra["socket"] = sock  # live fd for tcp_nodelay-style tuning
+        transport = SimTransport(self, stream, protocol, extra)
+        protocol.connection_made(transport)
+        transport.start_pumps()
+        return transport, protocol
+
+    async def create_server(self, protocol_factory, host=None, port=None,
+                            *, sock=None, backlog=100, ssl=None, family=0,
+                            flags=0, reuse_address=None, reuse_port=None,
+                            keep_alive=None, ssl_handshake_timeout=None,
+                            ssl_shutdown_timeout=None, start_serving=True):
+        if ssl:
+            raise NotImplementedError(
+                "TLS is not simulated; serve plain protocols in-sim")
+        if sock is not None:
+            raise NotImplementedError(
+                "create_server(sock=...) is not supported in-sim; pass "
+                "host/port")
+        if not isinstance(host, str):
+            # asyncio accepts a sequence of hosts; sim worlds bind one.
+            host = host[0] if host else "0.0.0.0"
+        listener = await TcpListener.bind((host, port or 0))
+        return SimServer(self, listener, protocol_factory)
+
+    async def start_tls(self, *a, **kw):
+        raise NotImplementedError("TLS is not simulated")
+
+    # -- lifecycle / introspection -----------------------------------------
+    def get_debug(self) -> bool:
+        return False
+
+    def set_debug(self, enabled: bool) -> None:
+        pass
+
+    def is_running(self) -> bool:
+        return True
+
+    def is_closed(self) -> bool:
+        return False
+
+    def close(self) -> None:
+        raise RuntimeError("the sim event loop is owned by the Runtime")
+
+    def stop(self) -> None:
+        raise RuntimeError("the sim event loop is owned by the Runtime")
+
+    def run_until_complete(self, *a):
+        raise RuntimeError(
+            "sim worlds are driven by Runtime.block_on, not loop.run_*")
+
+    run_forever = run_until_complete
+
+    async def shutdown_asyncgens(self) -> None:
+        pass
+
+    async def shutdown_default_executor(self, timeout=None) -> None:
+        pass
+
+    def add_signal_handler(self, sig, callback, *args):
+        raise NotImplementedError("signals do not exist inside a simulation")
+
+    def remove_signal_handler(self, sig) -> bool:
+        return False
+
+    def default_exception_handler(self, ctx: dict) -> None:
+        import logging
+
+        logging.getLogger("madsim_tpu.eventloop").warning(
+            "%s", ctx.get("message", "Unhandled exception in event loop"))
+
+    def set_exception_handler(self, handler) -> None:
+        self._exception_handler = handler
+
+    def get_exception_handler(self):
+        return self._exception_handler
+
+    def call_exception_handler(self, ctx: dict) -> None:
+        self.exceptions.append(ctx)
+        if self._exception_handler is not None:
+            self._exception_handler(self, ctx)
+        else:
+            self.default_exception_handler(ctx)
+
+    def get_task_factory(self):
+        return None
+
+    def set_task_factory(self, factory) -> None:
+        pass
+
+
+def get_sim_loop() -> SimEventLoop:
+    """The current world's SimEventLoop (cached on the Handle so loop
+    identity is stable across get_event_loop/get_running_loop calls)."""
+    handle = _context.current_handle()
+    loop = getattr(handle, "_sim_event_loop", None)
+    if loop is None:
+        loop = handle._sim_event_loop = SimEventLoop(handle)
+    return loop

@@ -282,3 +282,32 @@ def test_timer_beyond_2_62_ns_fires_identically_on_bridge():
     (out,) = sweep(world, [7])
     assert out.error is None, out.error
     assert out.value == host_ns
+
+
+def test_frame_parser_zero_length_oob_buffer():
+    """pickle's buffer_callback collects every out-of-band PickleBuffer,
+    including 0-byte ones (empty numpy arrays). The parser must neither
+    reject them nor stall on a frame ending in a zero-size section."""
+    import numpy as np
+
+    from madsim_tpu.real.net import _FrameProtocol, _encode_frames
+
+    payload = {"big": b"x" * 5000, "empty": np.zeros(0, dtype=np.uint8),
+               "tail": np.arange(4, dtype=np.int32)}
+    frames = _encode_frames(7, payload)
+    wire = b"".join(bytes(f) for f in frames)
+
+    got = []
+    proto = _FrameProtocol()  # no handshake: parsing starts at frame head
+    proto.sink = lambda tag, data, peer: got.append((tag, data))
+    # Feed byte-by-byte: the zero-size sections must finalize eagerly even
+    # when they are the last bytes fed.
+    for i in range(len(wire)):
+        mv = proto.get_buffer(1)
+        mv[0] = wire[i]
+        proto.buffer_updated(1)
+    assert len(got) == 1 and got[0][0] == 7
+    data = got[0][1]
+    assert data["big"] == payload["big"]
+    assert data["empty"].size == 0
+    assert list(data["tail"]) == [0, 1, 2, 3]
