@@ -27,6 +27,10 @@ from .core import (
     FAULT_UNCLOG_NODE,
     FAULT_CLOG_LINK,
     FAULT_UNCLOG_LINK,
+    FAULT_SET_LATENCY,
+    FAULT_SET_LOSS,
+    FAULT_PAUSE,
+    FAULT_RESUME,
     INF_TIME,
 )
 from .conformance import ConformanceError, check_actor
@@ -44,5 +48,6 @@ __all__ = [
     "check_actor", "ConformanceError",
     "save_checkpoint", "load_checkpoint", "CheckpointError",
     "FAULT_KILL", "FAULT_RESTART", "FAULT_CLOG_NODE", "FAULT_UNCLOG_NODE",
-    "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "INF_TIME",
+    "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "FAULT_SET_LATENCY",
+    "FAULT_SET_LOSS", "FAULT_PAUSE", "FAULT_RESUME", "INF_TIME",
 ]

@@ -39,6 +39,7 @@ from .queue import (
     GEN_MASK,
     INF_TIME,
     depth as queue_depth,
+    eligible_mask,
     empty_queue,
     next_deadline,
     pop,
@@ -66,6 +67,20 @@ FAULT_CLOG_NODE = 2
 FAULT_UNCLOG_NODE = 3
 FAULT_CLOG_LINK = 4
 FAULT_UNCLOG_LINK = 5
+# Hot network-config updates (NetSim::update_config, `net/mod.rs:127-130`,
+# `network.rs:74-94`): net parameters are runtime data in WorldState, so a
+# schedule row can change them mid-run without recompiling.
+# FAULT_SET_LATENCY: a = new min µs, b = new max µs.
+# FAULT_SET_LOSS:    a = new loss rate in parts-per-million, b unused.
+FAULT_SET_LATENCY = 6
+FAULT_SET_LOSS = 7
+# Pause/resume (Handle::pause/resume, `runtime/mod.rs:251-268`,
+# `task.rs:243-261`): a paused node's deliveries and timers are BUFFERED
+# (skipped by pop, untouched in the queue), then flush in (time, slot)
+# order on resume. Kill/restart clear the pause, like the reference's
+# fresh NodeInfo.
+FAULT_PAUSE = 8
+FAULT_RESUME = 9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,8 +91,12 @@ class EngineConfig:
     queue_cap: int = 128
     payload_words: int = 8
     outbox_cap: Optional[int] = None  # default n_nodes + 1
-    # Network model (reference defaults: 1-10 ms latency, 0 loss;
-    # `net/network.rs:74-94`). Times are int32 microseconds.
+    # Network model DEFAULTS (reference defaults: 1-10 ms latency, 0 loss;
+    # `net/network.rs:74-94`). Times are int32 microseconds. These seed
+    # WorldState.{lat_min,lat_max,loss} — runtime data, per world — so one
+    # compiled sweep can explore a (seeds × loss × latency) grid via
+    # ``init(seeds, configs=...)`` and schedules can hot-update them
+    # (FAULT_SET_LATENCY / FAULT_SET_LOSS), with zero recompiles.
     latency_min_us: int = 1_000
     latency_max_us: int = 10_000
     loss_rate: float = 0.0
@@ -125,6 +144,7 @@ class WorldState(NamedTuple):
     rng: DevRng
     alive: jnp.ndarray        # (N,) bool
     gen: jnp.ndarray          # (N,) int32 — bumped on kill/restart
+    paused: jnp.ndarray       # (N,) bool — deliveries buffered while set
     clog_node: jnp.ndarray    # (N,) bool
     clog_link: jnp.ndarray    # (N, N) bool, [src, dst]
     astate: Any               # actor pytree
@@ -136,6 +156,12 @@ class WorldState(NamedTuple):
     qmax: jnp.ndarray         # int32 — queue depth high-water mark
     bug: jnp.ndarray          # bool — invariant violation observed
     bug_time: jnp.ndarray     # int32 µs of first bug, INF_TIME if none
+    # Per-world network model (runtime data — the batched sweep axis and
+    # hot-update target the reference's global config cannot be,
+    # `network.rs:74-94`).
+    lat_min: jnp.ndarray      # int32 µs
+    lat_max: jnp.ndarray      # int32 µs
+    loss: jnp.ndarray         # float32 loss probability
 
 
 def tree_select(pred, a, b):
@@ -180,13 +206,23 @@ class DeviceEngine:
     # ------------------------------------------------------------------
     # Initialization
     # ------------------------------------------------------------------
-    def init(self, seeds, faults: Optional[np.ndarray] = None) -> WorldState:
+    def init(self, seeds, faults: Optional[np.ndarray] = None,
+             configs: Optional[np.ndarray] = None) -> WorldState:
         """Build W worlds from a vector of u64 seeds.
 
         ``faults``: optional int32 array of fault-schedule rows
         ``[time_us, op, a, b]``, shape (F, 4) (same schedule every world) or
         (W, F, 4) (per-world schedules). Rows with time < 0 are disabled —
         use that to give worlds ragged schedules under one static F.
+
+        ``configs``: optional per-world network config, shape (3,) (every
+        world) or (W, 3) (per world): columns ``[latency_min_us,
+        latency_max_us, loss_rate]`` (latencies int µs, loss a float
+        probability). Defaults to the EngineConfig values. This is the
+        (seeds × loss × latency) sweep axis: one compiled function explores
+        the whole fault-model grid because net config is world *data*, not
+        a jit constant (reference analog: a fresh run per config,
+        `network.rs:74-94`).
         """
         seeds = np.asarray(seeds, dtype=np.uint64)
         if seeds.ndim != 1:
@@ -206,19 +242,46 @@ class DeviceEngine:
             # instead of erroring.
             live = faults[..., 0] >= 0
             ops = faults[..., 1]
-            nodes = faults[..., 2:4]
-            if np.any(live & ((ops < FAULT_KILL) | (ops > FAULT_UNCLOG_LINK))):
+            a, b = faults[..., 2], faults[..., 3]
+            node_op = (ops <= FAULT_UNCLOG_LINK) | (ops >= FAULT_PAUSE)
+            if np.any(live & ((ops < FAULT_KILL) | (ops > FAULT_RESUME))):
                 raise ValueError("fault op must be one of FAULT_KILL.."
-                                 "FAULT_UNCLOG_LINK")
-            if np.any(live[..., None]
-                      & ((nodes < 0) | (nodes >= self.cfg.n_nodes))):
+                                 "FAULT_RESUME")
+            node_params = np.stack([a, b], axis=-1)
+            if np.any((live & node_op)[..., None]
+                      & ((node_params < 0)
+                         | (node_params >= self.cfg.n_nodes))):
                 raise ValueError(
                     f"fault-row node ids must be in [0, {self.cfg.n_nodes})")
+            set_lat = live & (ops == FAULT_SET_LATENCY)
+            if np.any(set_lat & ((a < 0) | (b <= a))):
+                raise ValueError("FAULT_SET_LATENCY needs 0 <= min < max µs")
+            set_loss = live & (ops == FAULT_SET_LOSS)
+            if np.any(set_loss & ((a < 0) | (a > 1_000_000))):
+                raise ValueError("FAULT_SET_LOSS rate must be 0..1e6 ppm")
+            if np.any(set_lat | set_loss) and self.cfg.payload_words < 2:
+                raise ValueError("net-config fault rows carry their params "
+                                 "in the payload: payload_words must be >= 2")
+
+        if configs is None:
+            configs = np.array([self.cfg.latency_min_us,
+                                self.cfg.latency_max_us,
+                                self.cfg.loss_rate], np.float64)
+        configs = np.asarray(configs, np.float64)
+        configs = np.broadcast_to(configs, (w, 3))
+        lat_min = configs[:, 0].astype(np.int32)
+        lat_max = configs[:, 1].astype(np.int32)
+        loss = configs[:, 2].astype(np.float32)
+        if np.any(lat_min < 0) or np.any(lat_max <= lat_min):
+            raise ValueError("configs need 0 <= latency_min < latency_max µs")
+        if np.any((loss < 0.0) | (loss > 1.0)):
+            raise ValueError("configs loss_rate must be in [0, 1]")
 
         return self._init_batched(jnp.asarray(lo), jnp.asarray(hi),
-                                  jnp.asarray(faults))
+                                  jnp.asarray(faults), jnp.asarray(lat_min),
+                                  jnp.asarray(lat_max), jnp.asarray(loss))
 
-    def _init_one(self, seed_lo, seed_hi, fault_rows):
+    def _init_one(self, seed_lo, seed_hi, fault_rows, lat_min, lat_max, loss):
         cfg = self.cfg
         n_faults = fault_rows.shape[0]  # static under jit (shape-keyed cache)
         rng = make_rng(seed_lo, seed_hi, STREAM_DEVICE)
@@ -230,9 +293,18 @@ class DeviceEngine:
             overflow = overflow | ~ok
         for f in range(n_faults):  # static unroll
             row = fault_rows[f]
+            # Net-config params exceed the packed 8-bit src/dst fields, so
+            # they ride the (full-width int32) payload; node ops keep using
+            # src/dst, whose 8 bits the init-time validation guards.
+            is_net = (row[1] == FAULT_SET_LATENCY) | (row[1] == FAULT_SET_LOSS)
+            pay = jnp.zeros((cfg.payload_words,), jnp.int32)
+            pay = pay.at[0].set(jnp.where(is_net, row[2], 0))
+            pay = pay.at[1].set(jnp.where(is_net, row[3], 0))
+            zero = jnp.int32(0)
             fev = Event(time=row[0], kind=row[1], flags=jnp.int32(FLAG_FAULT),
-                        src=row[2], dst=row[3], gen=jnp.int32(0),
-                        payload=jnp.zeros((cfg.payload_words,), jnp.int32))
+                        src=jnp.where(is_net, zero, row[2]),
+                        dst=jnp.where(is_net, zero, row[3]),
+                        gen=jnp.int32(0), payload=pay)
             q, ok = push(q, fev, enable=row[0] >= 0)
             overflow = overflow | ~ok
         n = cfg.n_nodes
@@ -242,6 +314,7 @@ class DeviceEngine:
             rng=rng,
             alive=jnp.ones((n,), bool),
             gen=jnp.zeros((n,), jnp.int32),
+            paused=jnp.zeros((n,), bool),
             clog_node=jnp.zeros((n,), bool),
             clog_link=jnp.zeros((n, n), bool),
             astate=astate,
@@ -253,6 +326,9 @@ class DeviceEngine:
             qmax=queue_depth(q),
             bug=jnp.asarray(False),
             bug_time=INF_TIME,
+            lat_min=lat_min,
+            lat_max=lat_max,
+            loss=loss,
         )
 
     # ------------------------------------------------------------------
@@ -270,30 +346,49 @@ class DeviceEngine:
                 is_kill, False, jnp.where(is_restart, True, sel(ws.alive, a))))
             gen = upd(ws.gen, a,
                       sel(ws.gen, a) + (is_kill | is_restart).astype(jnp.int32))
+            # Pause buffers; resume releases. Kill/restart clear the pause
+            # (the reference swaps in a fresh NodeInfo, `task.rs:211-240`).
+            paused = upd(ws.paused, a, jnp.where(
+                op == FAULT_PAUSE, True,
+                jnp.where((op == FAULT_RESUME) | is_kill | is_restart,
+                          False, sel(ws.paused, a))))
             clog_node = upd(ws.clog_node, a, jnp.where(
                 op == FAULT_CLOG_NODE, True,
                 jnp.where(op == FAULT_UNCLOG_NODE, False, sel(ws.clog_node, a))))
             clog_link = upd2(ws.clog_link, a, b, jnp.where(
                 op == FAULT_CLOG_LINK, True,
                 jnp.where(op == FAULT_UNCLOG_LINK, False, sel2(ws.clog_link, a, b))))
+            # Hot net-config updates take effect at exactly this virtual
+            # instant: sends after this event sample the new model
+            # (update_config parity, `net/mod.rs:127-130`). Params arrive in
+            # the payload — src/dst are 8-bit packed and would truncate µs.
+            set_lat = op == FAULT_SET_LATENCY
+            set_loss = op == FAULT_SET_LOSS
+            pa, pb = ev.payload[0], ev.payload[1]
+            lat_min = jnp.where(set_lat, pa, ws.lat_min)
+            lat_max = jnp.where(set_lat, pb, ws.lat_max)
+            loss = jnp.where(set_loss,
+                             pa.astype(jnp.float32) * jnp.float32(1e-6),
+                             ws.loss)
             astate_r, ob_r, rng_r = actor.on_restart(cfg, ws.astate, a, ws.now, ws.rng)
             astate = tree_select(is_restart, astate_r, ws.astate)
             rng = tree_select(is_restart, rng_r, ws.rng)
             ob = tree_select(is_restart, ob_r, Outbox.empty(cfg))
-            return ws._replace(alive=alive, gen=gen, clog_node=clog_node,
-                               clog_link=clog_link, astate=astate, rng=rng), ob
+            return ws._replace(alive=alive, gen=gen, paused=paused,
+                               clog_node=clog_node, clog_link=clog_link,
+                               astate=astate, rng=rng, lat_min=lat_min,
+                               lat_max=lat_max, loss=loss), ob
 
         def push_outbox(ws: WorldState, src, ob: Outbox) -> WorldState:
             m = cfg.m
-            loss = jnp.float32(cfg.loss_rate)
+            loss = ws.loss  # per-world runtime data, not a jit constant
             # Two draws per slot regardless of validity, batched into one
             # Threefry block: the draw count per step is static, so RNG
             # counters depend only on step index — replayable and
             # backend-independent. Counters (and therefore values) are
             # bit-identical to the per-slot sequential draws.
             xs, rng = next_u32_vec(ws.rng, 2 * m)
-            lat = _u32_to_range(xs[0::2], cfg.latency_min_us,
-                                cfg.latency_max_us)                # (M,)
+            lat = _u32_to_range(xs[0::2], ws.lat_min, ws.lat_max)  # (M,)
             u = _u32_to_unit_f32(xs[1::2])                         # (M,)
             dst = jnp.clip(ob.dst, 0, cfg.n_nodes - 1)             # (M,)
             clogged = sel(ws.clog_node, src) \
@@ -323,7 +418,8 @@ class DeviceEngine:
             return ws._replace(queue=q, rng=rng, overflow=overflow, qmax=qmax)
 
         def step(ws: WorldState) -> WorldState:
-            q, ev, found = pop(ws.queue)
+            q, ev, found = pop(ws.queue,
+                               eligible_mask(ws.queue, ws.paused, cfg.n_nodes))
             now = jnp.where(found, jnp.maximum(ws.now, ev.time), ws.now)
             in_time = now < jnp.int32(cfg.t_limit_us)
             ws1 = ws._replace(queue=q, now=now, steps=ws.steps + 1)
@@ -416,7 +512,10 @@ class DeviceEngine:
                                        faults=faults))
 
         def body(s, _):
-            _q, ev, found = pop(s.queue)  # pure peek of what step will pop
+            # Pure peek of what step will pop, under the same pause-aware
+            # eligibility the step itself uses.
+            _q, ev, found = pop(
+                s.queue, eligible_mask(s.queue, s.paused, self.cfg.n_nodes))
             s2 = self._step_one(s)
             # Mirror the step's own gates exactly: an event popped at/past
             # t_limit_us was not processed, and a stale timer or a message
@@ -440,7 +539,10 @@ class DeviceEngine:
                        FAULT_CLOG_NODE: "clog_node",
                        FAULT_UNCLOG_NODE: "unclog_node",
                        FAULT_CLOG_LINK: "clog_link",
-                       FAULT_UNCLOG_LINK: "unclog_link"}
+                       FAULT_UNCLOG_LINK: "unclog_link",
+                       FAULT_SET_LATENCY: "set_latency",
+                       FAULT_SET_LOSS: "set_loss",
+                       FAULT_PAUSE: "pause", FAULT_RESUME: "resume"}
         out: List[Dict[str, Any]] = []
         bug_seen = False
         for i in range(max_steps):

@@ -33,7 +33,7 @@ from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
-from .lanes import onehot, sel
+from .lanes import onehot, sel, sel_many
 
 INF_TIME = jnp.int32(2**31 - 1)
 
@@ -141,18 +141,27 @@ def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray
     return q, ok
 
 
-def pop(q: EventQueue) -> Tuple[EventQueue, Event, jnp.ndarray]:
+def pop(q: EventQueue, eligible=None) -> Tuple[EventQueue, Event, jnp.ndarray]:
     """Remove and return the earliest valid event. Returns (queue, ev, found).
 
     When the queue is empty, ``found`` is False and the event contents are
     arbitrary (time INF_TIME) — callers must mask on ``found``.
 
+    ``eligible`` (optional (Q,) bool) masks slots out of *this* pop without
+    disturbing them: ineligible events stay queued at their original time.
+    This is how node pause buffers deliveries on the device — events to a
+    paused node are skipped until resume clears the mask, then flush in
+    (time, slot) order (`task.rs:243-261` park/unpark analog). With every
+    slot ineligible, ``found`` is False even for a non-empty queue.
+
     Scatter/gather-free: the min slot is read back via a one-hot masked
     reduction and cleared via an elementwise select.
     """
-    slot = jnp.argmin(q.time)
+    times = q.time if eligible is None else jnp.where(eligible, q.time,
+                                                      INF_TIME)
+    slot = jnp.argmin(times)
     mask = onehot(slot, q.time.shape[0])
-    tmin = jnp.min(q.time)
+    tmin = jnp.min(times)
     found = tmin < INF_TIME
     kind, flags, src, dst, gen = unpack_meta(sel(q.meta, slot))
     ev = Event(
@@ -166,3 +175,14 @@ def pop(q: EventQueue) -> Tuple[EventQueue, Event, jnp.ndarray]:
 def next_deadline(q: EventQueue) -> jnp.ndarray:
     """Earliest pending time, or INF_TIME when empty."""
     return jnp.min(q.time)
+
+
+def eligible_mask(q: EventQueue, paused, n_nodes: int) -> jnp.ndarray:
+    """(Q,) pop-eligibility under node pause: events to a paused node are
+    buffered (skipped in place); faults always fire — the matching resume
+    must be able to reach the paused node. Lives here, next to
+    pack_meta/unpack_meta, so the bit layout has exactly one home."""
+    flags_q = (q.meta >> 6) & 0x3
+    dst_q = jnp.clip((q.meta >> 16) & 0xFF, 0, n_nodes - 1)
+    is_fault_q = (flags_q & FLAG_FAULT) != 0
+    return is_fault_q | ~sel_many(paused, dst_q)

@@ -317,7 +317,7 @@ class Timeout:
     async def __aenter__(self):
         if self._when is not None:
             self._delay = max(0.0, self._when - _time.monotonic())
-        if self._delay is None:  # asyncio.timeout(None): no deadline
+        if self._delay is None:  # timeout(None) / reschedule(None): no deadline
             return self
         if self._when is None:
             # asyncio contract: when() is the absolute deadline once armed.
@@ -349,10 +349,13 @@ class Timeout:
 
     def reschedule(self, when: "float | None") -> None:
         # Supported only before __aenter__ arms the timer (the common
-        # library pattern: construct, adjust, then enter).
+        # library pattern: construct, adjust, then enter). ``when`` fully
+        # replaces the deadline: None disables it even for a scope
+        # constructed with a relative delay.
         if self._timer is not None:
             raise RuntimeError("cannot reschedule an armed sim timeout")
         self._when = when
+        self._delay = None
 
 
 def timeout(delay: "float | None"):

@@ -506,6 +506,7 @@ class SimEventLoop:
         sim stream to ``address``; the sock is the lookup token that
         ``create_connection(sock=...)`` and ``sock_sendall``/``sock_recv``
         use. This is the path aiohappyeyeballs-era clients take."""
+        self._sweep_closed_socks()
         self._sock_streams[sock] = await TcpStream.connect(address)
 
     async def sock_sendall(self, sock, data) -> None:
@@ -520,12 +521,27 @@ class SimEventLoop:
         return len(data)
 
     def _sim_sock(self, sock) -> TcpStream:
-        try:
-            return self._sock_streams[sock]
-        except KeyError:
+        stream = self._sock_streams.get(sock)
+        if stream is None:
             raise OSError(
                 "socket is not connected through the sim loop "
-                "(sock_connect was never called on it)") from None
+                "(sock_connect was never called on it)")
+        if sock.fileno() == -1:  # token fd closed: surface it like a dead fd
+            self._sock_streams.pop(sock, None)
+            stream.close()
+            raise OSError("socket is closed")
+        return stream
+
+    def _sweep_closed_socks(self) -> None:
+        """Close sim streams whose token fd was close()d by the caller.
+
+        A real close() sends FIN from the kernel with no loop involvement;
+        the sim analog cannot hook close(), so closed tokens are reaped at
+        deterministic points (each sock_connect, and any sock_* touch of
+        the closed sock) — the peer sees EOF then, not at GC time."""
+        dead = [s for s in self._sock_streams if s.fileno() == -1]
+        for s in dead:
+            self._sock_streams.pop(s).close()
 
     # -- connections --------------------------------------------------------
     async def create_connection(self, protocol_factory, host=None, port=None,

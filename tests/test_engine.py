@@ -7,7 +7,8 @@ import pytest
 from madsim_tpu.core.rng import GlobalRng
 from madsim_tpu.engine import (
     DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
-    FAULT_KILL, FAULT_RESTART, FAULT_CLOG_NODE, FAULT_UNCLOG_NODE, INF_TIME,
+    FAULT_KILL, FAULT_RESTART, FAULT_CLOG_NODE, FAULT_UNCLOG_NODE,
+    FAULT_SET_LATENCY, FAULT_SET_LOSS, FAULT_PAUSE, FAULT_RESUME, INF_TIME,
 )
 from madsim_tpu.engine.core import STREAM_DEVICE
 from madsim_tpu.engine.queue import Event, empty_queue, pop, push
@@ -324,7 +325,8 @@ def test_packed_width_guards(raft_engine):
                          faults=np.array([[1000, FAULT_KILL, 3, 0]], np.int32))
     with pytest.raises(ValueError, match="fault op"):
         raft_engine.init(np.arange(4),
-                         faults=np.array([[1000, 9, 0, 0]], np.int32))
+                         faults=np.array([[1000, FAULT_RESUME + 1, 0, 0]],
+                                         np.int32))
     # Disabled rows (time < 0) are exempt — ragged schedules pad with them.
     raft_engine.init(np.arange(4),
                      faults=np.array([[-1, 0, 99, 99]], np.int32))
@@ -334,3 +336,130 @@ def test_packed_width_guards(raft_engine):
 
     with pytest.raises(ValueError, match="num_kinds"):
         DeviceEngine(NoKinds(), ECFG)
+
+
+def test_per_world_config_grid_matches_per_config_compiles():
+    """One compiled sweep over a (seeds × loss × latency) grid is bitwise
+    identical to compiling one engine per config point (VERDICT r4 item 3:
+    net config is world data, not a jit constant)."""
+    rcfg = RaftDeviceConfig(n=3, n_proposals=1)
+    seeds = np.arange(8, dtype=np.uint64)
+    grid = [(1_000, 10_000, 0.0), (500, 2_000, 0.1), (2_000, 20_000, 0.3)]
+
+    base = DeviceEngine(RaftActor(rcfg),
+                        EngineConfig(n_nodes=3, outbox_cap=4,
+                                     t_limit_us=4_000_000))
+    all_seeds = np.tile(seeds, len(grid))
+    configs = np.repeat(np.asarray(grid, np.float64), len(seeds), axis=0)
+    obs_grid = base.observe(base.run(base.init(all_seeds, configs=configs),
+                                     12_000))
+
+    for gi, (lo, hi, p) in enumerate(grid):
+        eng = DeviceEngine(RaftActor(rcfg),
+                           EngineConfig(n_nodes=3, outbox_cap=4,
+                                        t_limit_us=4_000_000,
+                                        latency_min_us=lo, latency_max_us=hi,
+                                        loss_rate=p))
+        obs_one = eng.observe(eng.run(eng.init(seeds), 12_000))
+        sl = slice(gi * len(seeds), (gi + 1) * len(seeds))
+        for key, arr in obs_one.items():
+            np.testing.assert_array_equal(
+                np.asarray(obs_grid[key])[sl], np.asarray(arr),
+                err_msg=f"config {gi} field {key} diverged from "
+                        "the per-config compile")
+
+
+def test_hot_loss_update_takes_effect_mid_run():
+    """FAULT_SET_LOSS flips the network model at a virtual instant: total
+    loss from t=0 prevents election entirely; lifting it at 1.5 s lets the
+    same worlds elect afterwards (update_config parity, net/mod.rs:127-130)."""
+    rcfg = RaftDeviceConfig(n=3)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000,
+                       loss_rate=1.0)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(8)
+
+    obs_blocked = eng.observe(eng.run(eng.init(seeds), 12_000))
+    assert not obs_blocked["leader_elected"].any()
+
+    heal = np.array([[1_500_000, FAULT_SET_LOSS, 0, 0]], np.int32)
+    obs_healed = eng.observe(eng.run(eng.init(seeds, faults=heal), 12_000))
+    assert obs_healed["leader_elected"].all()
+    assert not obs_healed["bug"].any()
+
+
+def test_hot_latency_update_shifts_delivery_times():
+    """FAULT_SET_LATENCY changes sampling bounds mid-run without a
+    recompile: a world slowed to ~0.5 s per hop elects later than the
+    default 1-10 ms world, under one compiled step."""
+    rcfg = RaftDeviceConfig(n=3)
+    # Electing needs timeout (>=150 ms) + vote request + response (2 hops):
+    # at ~1 s per hop no world can elect inside 1.8 s; at the default
+    # 1-10 ms every world does. Same compiled engine either way.
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=1_800_000)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(8)
+
+    slow = np.array([[0, FAULT_SET_LATENCY, 900_000, 1_100_000]], np.int32)
+    obs_fast = eng.observe(eng.run(eng.init(seeds), 30_000))
+    obs_slow = eng.observe(eng.run(eng.init(seeds, faults=slow), 30_000))
+    assert obs_fast["leader_elected"].all()
+    assert not obs_slow["leader_elected"].any()
+    assert not obs_slow["bug"].any()
+
+
+def test_config_validation_rejects_bad_grid():
+    eng = DeviceEngine(RaftActor(RaftDeviceConfig(n=3)),
+                       EngineConfig(n_nodes=3, outbox_cap=4))
+    with pytest.raises(ValueError, match="latency_min"):
+        eng.init(np.arange(2), configs=np.array([10.0, 5.0, 0.0]))
+    with pytest.raises(ValueError, match="loss_rate"):
+        eng.init(np.arange(2), configs=np.array([1.0, 10.0, 1.5]))
+    with pytest.raises(ValueError, match="SET_LOSS"):
+        eng.init(np.arange(2),
+                 faults=np.array([[0, 7, 2_000_000, 0]], np.int32))
+
+
+def test_pause_buffers_deliveries_and_reelects():
+    """Device pause/resume (VERDICT r4 item 5): pausing node 0 past the
+    election timeout re-elects in worlds it led; deliveries during the
+    pause are BUFFERED and flush on resume (vs kill, which drops); the
+    resumed stale leader steps down (at most one leader everywhere)."""
+    from madsim_tpu.engine.raft_actor import LEADER
+
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000)
+    eng = DeviceEngine(RaftActor(RaftDeviceConfig(n=3)), cfg)
+    seeds = np.arange(64)
+
+    pause = np.array([[400_000, FAULT_PAUSE, 0, 0],
+                      [1_200_000, FAULT_RESUME, 0, 0]], np.int32)
+    st_p = eng.run(eng.init(seeds, faults=pause), 12_000)
+    obs_p = eng.observe(st_p)
+    assert obs_p["leader_elected"].all()
+    assert not obs_p["bug"].any()
+    assert (obs_p["elections_won"] >= 2).any()  # node 0 led somewhere: re-elect
+    roles = np.asarray(st_p.astate.role)
+    assert ((roles == LEADER).sum(axis=1) <= 1).all(), \
+        "a stale leader survived resume without stepping down"
+
+    # Same window as a kill: messages to the dead node are popped-and-
+    # dropped, while the pause defers them — so the pause run must drop
+    # strictly less on average.
+    kill = np.array([[400_000, FAULT_KILL, 0, 0],
+                     [1_200_000, FAULT_RESTART, 0, 0]], np.int32)
+    obs_k = eng.observe(eng.run(eng.init(seeds, faults=kill), 12_000))
+    assert obs_p["dropped"].mean() < obs_k["dropped"].mean()
+
+
+def test_pause_without_resume_freezes_world_cleanly():
+    """All remaining events ineligible (paused dst, no resume scheduled) is
+    the device's deadlock analog: the world freezes inactive, no bug."""
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=2_000_000)
+    eng = DeviceEngine(RaftActor(RaftDeviceConfig(n=3)), cfg)
+    faults = np.array([[0, FAULT_PAUSE, 0, 0],
+                       [0, FAULT_PAUSE, 1, 0],
+                       [0, FAULT_PAUSE, 2, 0]], np.int32)
+    obs = eng.observe(eng.run(eng.init(np.arange(4), faults=faults), 4_000))
+    assert not obs["active"].any()
+    assert not obs["bug"].any()
+    assert not obs["leader_elected"].any()  # nothing ever ran

@@ -207,7 +207,7 @@ def _aiohttp_world(requests=5, chaos=False, restart=False):
 
 def test_aiohttp_echo_roundtrips():
     value, _ = run_world(_aiohttp_world(requests=5), 11)
-    assert [i for i, _a in enumerate(v[0] for v in value)] == list(range(5))
+    assert [i for i, _a in value] == list(range(5))
     assert all(a >= 1 for _i, a in value)
 
 
