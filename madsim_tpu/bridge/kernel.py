@@ -184,6 +184,13 @@ def _step(state: BridgeState, net_k0, net_k1,
                               more_due=more_due)
 
 
+# One jitted step per (cap, k_events), shared by every kernel instance:
+# a fresh jax.jit object per sweep would re-trace and re-compile (~0.8 s
+# on CPU XLA for this unrolled kernel) on every sweep() call in a process.
+# The step is pure (all state is passed in), so sharing is sound.
+_STEP_CACHE: dict = {}
+
+
 class BridgeKernel:
     """Device-side half of the bridge: owns the batched decision state.
 
@@ -227,8 +234,12 @@ class BridgeKernel:
                 lane_seq=jnp.zeros((self.W, cap + 1), jnp.int64),
             )
             # One jitted step; XLA re-traces per padded batch shape.
-            self._fn = jax.jit(functools.partial(_step, cap=cap,
-                                                 k_events=k_events))
+            # Process-cached so repeated sweeps reuse the compilation.
+            self._fn = _STEP_CACHE.get((cap, k_events))
+            if self._fn is None:
+                self._fn = jax.jit(functools.partial(_step, cap=cap,
+                                                     k_events=k_events))
+                _STEP_CACHE[(cap, k_events)] = self._fn
 
     def step(self, batch: HostBatch) -> StepOut:
         import jax.numpy as jnp

@@ -353,8 +353,25 @@ def sweep_traced(world_fn, seeds, **kw) -> Tuple[List[Outcome], List[list]]:
     return _sweep_impl(world_fn, seeds, trace=True, **kw)
 
 
+def sweep_profiled(world_fn, seeds, **kw) -> Tuple[List[Outcome], dict]:
+    """sweep() + a per-phase wall-time breakdown of the lockstep loop.
+
+    The profile dict (all times in seconds) answers "where does a round
+    go": ``host_s`` (Python task bodies + root settling), ``pack_s``
+    (building the padded numpy batch), ``dispatch_s`` (the jitted kernel
+    step, including device sync), ``settle_s`` (send accounting, event
+    dispatch, drain rounds). ``rounds``/``drain_rounds`` count kernel
+    dispatches; ``events``/``sends``/``timers`` are totals across worlds.
+    This is the measured artifact behind docs/bridge.md.
+    """
+    profile: dict = {}
+    outs, _ = _sweep_impl(world_fn, seeds, profile=profile, **kw)
+    return outs, profile
+
+
 def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
-                k_events=4, time_limit=None, trace=False, device=None):
+                k_events=4, time_limit=None, trace=False, device=None,
+                profile=None):
     seeds = [int(s) for s in seeds]
     W = len(seeds)
     wants_seed = len(inspect.signature(world_fn).parameters) >= 1
@@ -401,12 +418,68 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             else:
                 finish(w, value=fut.result())
 
+    if profile is not None:
+        from time import perf_counter
+
+        profile.update(rounds=0, drain_rounds=0, host_s=0.0, pack_s=0.0,
+                       dispatch_s=0.0, settle_s=0.0, events=0, sends=0,
+                       timers=0, polls=0)
+
+        def _clk():
+            return perf_counter()
+    else:
+        def _clk():
+            return 0.0
+
+    t0 = _clk()
     for w in worlds:
         run_host(w)
+    if profile is not None:
+        profile["host_s"] += _clk() - t0
+
+    # Round buffers are preallocated per (T, C, S) bucket and reused:
+    # fresh np.zeros for 18 arrays per round was a measured ~6% of sweep
+    # wall time at W=512. Only the mask lanes (and the s_lat_w divisor)
+    # need clearing on reuse — every value lane sits behind a mask the
+    # kernel applies (stale values are jnp.where'd to the dump column).
+    # Mutating after step() returns is safe: StepOut is materialized to
+    # numpy before step returns, so the device is done with the inputs.
+    buffers: Dict[Tuple[int, int, int], list] = {}
+
+    def round_buffers(T, C, S):
+        buf = buffers.get((T, C, S))
+        if buf is None:
+            buf = [np.zeros((W, T), np.int32), np.zeros((W, T), np.int64),
+                   np.zeros((W, T), np.int64), np.zeros((W, T), np.bool_),
+                   np.zeros((W, C), np.int32), np.zeros((W, C), np.bool_),
+                   np.zeros((W, S), np.uint64), np.zeros((W, S), np.int64),
+                   np.zeros((W, S), np.int32), np.zeros((W, S), np.int64),
+                   np.zeros((W, S), np.uint64), np.zeros((W, S), np.bool_),
+                   np.zeros((W, S), np.int64), np.ones((W, S), np.int64),
+                   np.zeros((W, S), np.bool_), np.zeros((W, S), np.bool_),
+                   np.zeros((W,), np.int64), np.zeros((W,), np.bool_)]
+            buffers[(T, C, S)] = buf
+        else:
+            buf[3].fill(False)   # t_mask
+            buf[5].fill(False)   # c_mask
+            buf[13].fill(1)      # s_lat_w (divisor: must stay >= 1)
+            buf[14].fill(False)  # s_mask
+            buf[15].fill(False)  # s_live
+        return buf
 
     zero_i32 = np.zeros((W, 0), np.int32)
+    drain_batch_tail = (
+        zero_i32, np.zeros((W, 0), np.int64), np.zeros((W, 0), np.int64),
+        np.zeros((W, 0), np.bool_), zero_i32, np.zeros((W, 0), np.bool_),
+        np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.int64), zero_i32,
+        np.zeros((W, 0), np.int64), np.zeros((W, 0), np.uint64),
+        np.zeros((W, 0), np.bool_), np.zeros((W, 0), np.int64),
+        np.ones((W, 0), np.int64), np.zeros((W, 0), np.bool_),
+        np.zeros((W, 0), np.bool_))
+    no_advance = np.zeros((W,), np.bool_)
     while pending:
         # -- build the padded round batch ---------------------------------
+        t0 = _clk()
         rounds = []
         t_n = c_n = s_n = 0
         for w in worlds:
@@ -416,24 +489,10 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             c_n = max(c_n, len(cancels))
             s_n = max(s_n, len(sends))
         T, C, S = bucket(t_n), bucket(c_n), bucket(s_n)
-        t_slot = np.zeros((W, T), np.int32)
-        t_dl = np.zeros((W, T), np.int64)
-        t_seq = np.zeros((W, T), np.int64)
-        t_mask = np.zeros((W, T), np.bool_)
-        c_slot = np.zeros((W, C), np.int32)
-        c_mask = np.zeros((W, C), np.bool_)
-        s_ctr = np.zeros((W, S), np.uint64)
-        s_base = np.zeros((W, S), np.int64)
-        s_slot = np.zeros((W, S), np.int32)
-        s_seq = np.zeros((W, S), np.int64)
-        s_thr = np.zeros((W, S), np.uint64)
-        s_lossall = np.zeros((W, S), np.bool_)
-        s_lat_lo = np.zeros((W, S), np.int64)
-        s_lat_w = np.ones((W, S), np.int64)
-        s_mask = np.zeros((W, S), np.bool_)
-        s_live = np.zeros((W, S), np.bool_)
-        clock = np.zeros((W,), np.int64)
-        advance = np.zeros((W,), np.bool_)
+        (t_slot, t_dl, t_seq, t_mask, c_slot, c_mask,
+         s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
+         s_lat_lo, s_lat_w, s_mask, s_live, clock, advance) = \
+            round_buffers(T, C, S)
         for w, (adds, cancels, sends) in zip(worlds, rounds):
             i = w.idx
             clock[i] = w.rt.time.elapsed_ns
@@ -458,12 +517,21 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 s_mask[i, j] = True
                 s_live[i, j] = s.live
 
+        if profile is not None:
+            profile["pack_s"] += _clk() - t0
+            profile["rounds"] += 1
+            profile["timers"] += sum(len(r[0]) for r in rounds)
+            profile["sends"] += sum(len(r[2]) for r in rounds)
+        t0 = _clk()
         out = kernel.step(HostBatch(
             t_slot, t_dl, t_seq, t_mask, c_slot, c_mask,
             s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
             s_lat_lo, s_lat_w, s_mask, s_live, clock, advance))
+        if profile is not None:
+            profile["dispatch_s"] += _clk() - t0
 
         # -- settle sends, dispatch events, detect stops ------------------
+        t0 = _clk()
         woke: List[_World] = []
         for w, (adds, cancels, sends) in zip(worlds, rounds):
             i = w.idx
@@ -485,13 +553,15 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 finish(w, error=TimeLimitExceeded(
                     f"time limit ({lim / NANOS_PER_SEC}s) exceeded"))
                 continue
-            fired = False
+            fired = 0
             with context.enter_handle(w.rt.handle):
                 for k in range(out.event_valid.shape[1]):
                     if not out.event_valid[i, k]:
                         break
                     w.rt.time.fire(int(out.event_seq[i, k]))
-                    fired = True
+                    fired += 1
+            if profile is not None:
+                profile["events"] += fired
             if fired or out.more_due[i]:
                 woke.append(w)
 
@@ -508,16 +578,10 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 assert not (t.pending_add or t.sends or t.cancels), (
                     "bridge drain invariant violated: a fire() callback "
                     "recorded timers/sends during event dispatch")
+            if profile is not None:
+                profile["drain_rounds"] += 1
             drained = kernel.step(HostBatch(
-                zero_i32, np.zeros((W, 0), np.int64),
-                np.zeros((W, 0), np.int64), np.zeros((W, 0), np.bool_),
-                zero_i32, np.zeros((W, 0), np.bool_),
-                np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.int64),
-                zero_i32, np.zeros((W, 0), np.int64),
-                np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.bool_),
-                np.zeros((W, 0), np.int64), np.ones((W, 0), np.int64),
-                np.zeros((W, 0), np.bool_), np.zeros((W, 0), np.bool_),
-                np.asarray(out.clock), np.zeros((W,), np.bool_)))
+                *drain_batch_tail, np.asarray(out.clock), no_advance))
             for w in worlds:
                 i = w.idx
                 if w.done or not out.more_due[i]:
@@ -527,10 +591,18 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                         if not drained.event_valid[i, k]:
                             break
                         w.rt.time.fire(int(drained.event_seq[i, k]))
+                        if profile is not None:
+                            profile["events"] += 1
             out = drained
 
+        if profile is not None:
+            profile["settle_s"] += _clk() - t0
+        t0 = _clk()
         for w in woke:
             if not w.done:
                 run_host(w)
+        if profile is not None:
+            profile["host_s"] += _clk() - t0
+            profile["polls"] = sum(w.rt.task.poll_count for w in worlds)
 
     return [o for o in outcomes], traces

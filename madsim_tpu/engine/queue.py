@@ -182,7 +182,7 @@ def eligible_mask(q: EventQueue, paused, n_nodes: int) -> jnp.ndarray:
     buffered (skipped in place); faults always fire — the matching resume
     must be able to reach the paused node. Lives here, next to
     pack_meta/unpack_meta, so the bit layout has exactly one home."""
-    flags_q = (q.meta >> 6) & 0x3
-    dst_q = jnp.clip((q.meta >> 16) & 0xFF, 0, n_nodes - 1)
+    _kind, flags_q, _src, dst_q, _gen = unpack_meta(q.meta)
+    dst_q = jnp.clip(dst_q, 0, n_nodes - 1)
     is_fault_q = (flags_q & FLAG_FAULT) != 0
     return is_fault_q | ~sel_many(paused, dst_q)

@@ -12,6 +12,11 @@ Environment variables (same names as the reference):
 - ``MADSIM_TEST_CONFIG`` — path to a TOML config file
 - ``MADSIM_TEST_TIME_LIMIT``        — virtual-time limit per run, seconds
 - ``MADSIM_TEST_CHECK_DETERMINISM`` — run each seed twice with RNG log/replay
+- ``MADSIM_TEST_BACKEND`` — ``host`` (default) runs each seed on its own
+  Runtime; ``bridge`` routes the whole seed sweep through the lockstep
+  device kernel (:func:`madsim_tpu.bridge.sweep`) — same trajectories per
+  seed (the bit-identical contract, tests/test_bridge.py), one batched
+  decision kernel for all of them. See docs/bridge.md for when this wins.
 
 On failure the driver prints the repro banner with the failing seed and the
 config hash (`runtime/mod.rs:192-199`).
@@ -41,7 +46,8 @@ class Builder:
 
     def __init__(self, seed: Optional[int] = None, count: int = 1, jobs: int = 1,
                  config: Optional[Config] = None, config_path: Optional[str] = None,
-                 time_limit: Optional[float] = None, check_determinism: bool = False):
+                 time_limit: Optional[float] = None, check_determinism: bool = False,
+                 backend: str = "host"):
         self.seed = seed if seed is not None else int(_walltime.time())
         self.count = max(1, count)
         self.jobs = max(1, jobs)
@@ -49,6 +55,9 @@ class Builder:
         self.config_path = config_path
         self.time_limit = time_limit
         self.check_determinism = check_determinism
+        if backend not in ("host", "bridge"):
+            raise ValueError("backend must be 'host' or 'bridge'")
+        self.backend = backend
 
     @staticmethod
     def from_env() -> "Builder":
@@ -67,7 +76,8 @@ class Builder:
                 config = Config.from_toml(f.read())
         return Builder(seed=seed, count=count, jobs=jobs, config=config,
                        config_path=config_path, time_limit=time_limit,
-                       check_determinism=check)
+                       check_determinism=check,
+                       backend=env.get("MADSIM_TEST_BACKEND", "host"))
 
     def _run_one(self, seed: int, make_coro: Callable[[], Coroutine]) -> Any:
         config = copy.deepcopy(self.config) if self.config is not None else None
@@ -104,30 +114,14 @@ class Builder:
 
         result: Any = None
         seeds = range(self.seed, self.seed + self.count)
+        if self.backend == "bridge":
+            return self._run_bridge(make_coro, seeds)
 
         def run_seed(seed: int) -> Any:
             try:
                 return self._run_one(seed, make_coro)
             except BaseException:
-                config = self.config if self.config is not None else Config()
-                banner = (
-                    "note: run with environment variable "
-                    f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"
-                    f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}"
-                )
-                if sys.flags.hash_randomization:
-                    # The reference seeds std's RandomState so HashMap
-                    # iteration is part of the deterministic world
-                    # (`rand.rs:174-182`). Python dicts are insertion-
-                    # ordered (safe), but str/bytes SET iteration follows
-                    # the per-process randomized hash — flag it so a repro
-                    # in a fresh process can pin it.
-                    banner += (
-                        "\nnote: str-hash randomization is on; if this test"
-                        " iterates sets of str/bytes, reproduce with"
-                        " PYTHONHASHSEED pinned (e.g. PYTHONHASHSEED=0)"
-                    )
-                print(banner, file=sys.stderr)
+                self._print_banner(seed)
                 raise
 
         if self.jobs == 1:
@@ -140,6 +134,59 @@ class Builder:
                 futures = [pool.submit(run_seed, seed) for seed in seeds]
                 for fut in futures:
                     result = fut.result()
+        return result
+
+    def _print_banner(self, seed: int) -> None:
+        config = self.config if self.config is not None else Config()
+        banner = (
+            "note: run with environment variable "
+            f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"
+            f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}"
+        )
+        if sys.flags.hash_randomization:
+            # The reference seeds std's RandomState so HashMap
+            # iteration is part of the deterministic world
+            # (`rand.rs:174-182`). Python dicts are insertion-
+            # ordered (safe), but str/bytes SET iteration follows
+            # the per-process randomized hash — flag it so a repro
+            # in a fresh process can pin it.
+            banner += (
+                "\nnote: str-hash randomization is on; if this test"
+                " iterates sets of str/bytes, reproduce with"
+                " PYTHONHASHSEED pinned (e.g. PYTHONHASHSEED=0)"
+            )
+        print(banner, file=sys.stderr)
+
+    def _run_bridge(self, make_coro: Callable[[], Coroutine], seeds) -> Any:
+        """Route the whole seed sweep through the batched device kernel
+        (`builder.rs:118-136`, one lockstep batch instead of one thread per
+        seed). Per-seed trajectories are bit-identical to the host path."""
+        from .bridge import sweep, sweep_traced
+
+        kw = dict(config=copy.deepcopy(self.config)
+                  if self.config is not None else None,
+                  time_limit=self.time_limit)
+        if self.check_determinism:
+            outs_a, traces_a = sweep_traced(lambda s: make_coro(),
+                                            list(seeds), **kw)
+            outs_b, traces_b = sweep_traced(lambda s: make_coro(),
+                                            list(seeds), **kw)
+            for seed, ta, tb in zip(seeds, traces_a, traces_b):
+                if ta != tb:
+                    self._print_banner(seed)
+                    raise RuntimeError(
+                        f"non-deterministic execution detected (seed {seed}:"
+                        " two bridge runs diverged)")
+            outcomes = outs_a
+        else:
+            outcomes = sweep(lambda s: make_coro(), list(seeds),
+                             jobs=self.jobs, **kw)
+        result: Any = None
+        for outcome in outcomes:
+            if outcome.error is not None:
+                self._print_banner(outcome.seed)
+                raise outcome.error
+            result = outcome.value
         return result
 
 
@@ -162,7 +209,8 @@ def _run_on_thread(fn: Callable[[int], Any], seed: int) -> Any:
 
 def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Optional[int] = None,
          jobs: Optional[int] = None, config: Optional[Config] = None,
-         time_limit: Optional[float] = None, check_determinism: Optional[bool] = None):
+         time_limit: Optional[float] = None, check_determinism: Optional[bool] = None,
+         backend: Optional[str] = None):
     """Decorator: turn an async test fn into a multi-seed simulation test.
 
     ``@madsim_tpu.test`` / ``@madsim_tpu.test(count=10, time_limit=300)``.
@@ -189,6 +237,10 @@ def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Op
                 b.time_limit = time_limit
             if check_determinism is not None:
                 b.check_determinism = check_determinism
+            if backend is not None:
+                if backend not in ("host", "bridge"):
+                    raise ValueError("backend must be 'host' or 'bridge'")
+                b.backend = backend
             return b.run(lambda: async_fn(*args, **kwargs))
 
         return runner

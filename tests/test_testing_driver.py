@@ -125,3 +125,47 @@ def test_config_toml_round_trip():
     assert cfg.hash() == cfg2.hash()
     cfg2.net.packet_loss_rate = 0.2
     assert cfg.hash() != cfg2.hash()
+
+
+def test_bridge_backend_env_sweeps_through_device_kernel(monkeypatch):
+    """MADSIM_TEST_BACKEND=bridge routes the @test seed sweep through
+    bridge.sweep (VERDICT r4 item 1a): same seeds, same per-seed
+    trajectories, batched decision kernel."""
+    monkeypatch.setenv("MADSIM_TEST_BACKEND", "bridge")
+    monkeypatch.setenv("MADSIM_TEST_SEED", "50")
+    monkeypatch.setenv("MADSIM_TEST_NUM", "4")
+    seeds = []
+
+    @ms.test
+    async def my_test():
+        await time.sleep(rand.random())
+        seeds.append(ms.Handle.current().seed)
+        return ms.Handle.current().seed
+
+    assert my_test() == 53  # last seed's result, like the host path
+    assert seeds == [50, 51, 52, 53]
+
+
+def test_bridge_backend_failing_seed_banner(capsys, monkeypatch):
+    monkeypatch.setenv("MADSIM_TEST_BACKEND", "bridge")
+
+    @ms.test(seed=41, count=5)
+    async def my_test():
+        await time.sleep(0.1)
+        if ms.Handle.current().seed == 43:
+            raise AssertionError("bug found at seed 43")
+
+    with pytest.raises(AssertionError, match="bug found"):
+        my_test()
+    err = capsys.readouterr().err
+    assert "MADSIM_TEST_SEED=43" in err
+    assert "MADSIM_CONFIG_HASH=" in err
+
+
+def test_bridge_backend_kwarg_and_check_determinism():
+    @ms.test(seed=3, count=3, backend="bridge", check_determinism=True)
+    async def my_test():
+        await time.sleep(rand.random())
+        return ms.Handle.current().seed
+
+    assert my_test() == 5
