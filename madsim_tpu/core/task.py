@@ -154,6 +154,15 @@ class Executor:
         self.main_node = self.create_node(name="main", cores=1, init=None)
         # Hooks the Runtime installs so node lifecycle reaches simulators.
         self.on_reset_node: Optional[Callable[[int], None]] = None
+        # Native poll loop (run_all_ready in C, native/madsim_core.cpp):
+        # used when nothing needs the Python loop's observability hooks
+        # (trace, determinism log) — bit-identical either way.
+        from .. import native as _native
+        from .futures import _PENDING
+
+        lib = _native.get_lib()
+        self._native_ready = getattr(lib, "run_ready", None)
+        self._pending_sentinel = _PENDING
 
     # ------------------------------------------------------------------
     # Node management
@@ -279,6 +288,13 @@ class Executor:
                 )
 
     def run_all_ready(self) -> None:
+        if (self._native_ready is not None and self.trace is None
+                and self.rng._mode is None and self.rng._st is not None):
+            # The C twin of the loop below (same draws, same enqueue order,
+            # same exception routing — tests/test_native.py crosschecks).
+            self._native_ready(self, context._tls, SimFuture, Cancelled,
+                               self._pending_sentinel, self.rng._st)
+            return
         while (self.queue or self._yields) and self._uncaught is None:
             if not self.queue:
                 # Resolve parked yields only once the ready batch drains —
@@ -340,30 +356,35 @@ class Executor:
             self._uncaught = exc
         else:
             if not isinstance(yielded, SimFuture):
-                # Name the frame that suspended so drop-in gaps (a stdlib
-                # awaitable reaching the sim executor) are diagnosable.
-                frame = getattr(task.coro, "cr_frame", None)
-                inner = task.coro
-                while (aw := getattr(inner, "cr_await", None)) is not None:
-                    inner = aw
-                    frame = getattr(inner, "cr_frame", frame) or frame
-                at = (f" at {frame.f_code.co_filename}:{frame.f_lineno} "
-                      f"({frame.f_code.co_name})" if frame is not None else "")
-                err = TypeError(
-                    f"task awaited a foreign awaitable (yielded a "
-                    f"{type(yielded).__name__}){at}; only madsim_tpu futures "
-                    "(sleep, channels, endpoints, ...) can suspend a "
-                    "simulation task"
-                )
-                task._finished = True
-                task.node.tasks.pop(task, None)
-                task.join_future.set_exception(err)
-                self._uncaught = err
+                self._foreign_yield(task, yielded)
                 return
             epoch = task.wake_epoch
             yielded.add_done_callback(
                 lambda _fut, t=task, e=epoch:
                 self._wake(t) if t.wake_epoch == e else None)
+
+    def _foreign_yield(self, task: Task, yielded: Any) -> None:
+        """A non-SimFuture suspended the task (drop-in gap): fail the sim
+        with a diagnostic naming the frame. Shared by both poll loops."""
+        # Name the frame that suspended so drop-in gaps (a stdlib
+        # awaitable reaching the sim executor) are diagnosable.
+        frame = getattr(task.coro, "cr_frame", None)
+        inner = task.coro
+        while (aw := getattr(inner, "cr_await", None)) is not None:
+            inner = aw
+            frame = getattr(inner, "cr_frame", frame) or frame
+        at = (f" at {frame.f_code.co_filename}:{frame.f_lineno} "
+              f"({frame.f_code.co_name})" if frame is not None else "")
+        err = TypeError(
+            f"task awaited a foreign awaitable (yielded a "
+            f"{type(yielded).__name__}){at}; only madsim_tpu futures "
+            "(sleep, channels, endpoints, ...) can suspend a "
+            "simulation task"
+        )
+        task._finished = True
+        task.node.tasks.pop(task, None)
+        task.join_future.set_exception(err)
+        self._uncaught = err
 
 
 class Node:

@@ -359,7 +359,392 @@ static PyObject* py_rng_get_state(PyObject*, PyObject* args) {
   return Py_BuildValue("(KO)", (unsigned long long)st->counter, Py_None);
 }
 
+// ---------------------------------------------------------------------------
+// Native poll loop: Executor.run_all_ready in C (task.rs:121-180 hot loop).
+//
+// Bit-exactness contract with the Python loop (core/task.py run_all_ready +
+// _poll): same RNG draws in the same order (ready pick, per-poll jitter),
+// same enqueue order (TaskWaker objects are appended to the SAME
+// SimFuture._callbacks list, at the same position, as the Python closure
+// would be), same exception routing. The Python loop remains the fallback
+// for trace mode, determinism log/check mode, and builds without the
+// native core — cross-checked in tests/test_native.py.
+// ---------------------------------------------------------------------------
+
+// Interned attribute names (created in PyInit__core).
+static PyObject *s_queue, *s_yields, *s_uncaught, *s_scheduled, *s_finished,
+    *s_cancelled, *s_node, *s_killed, *s_paused, *s_paused_tasks, *s_task,
+    *s_pending_exc, *s_coro, *s_send, *s_throw, *s_drop, *s_set_result,
+    *s_set_exception, *s_wake_epoch, *s_result, *s_exception, *s_callbacks,
+    *s_join_future, *s_tasks, *s_elapsed_ns, *s_poll_count, *s_time,
+    *s_foreign_yield, *s_value;
+
+// TaskWaker: the C twin of the per-await closure
+//   lambda _fut, t=task, e=epoch: self._wake(t) if t.wake_epoch == e else None
+// Appended to SimFuture._callbacks so callback ORDER (part of the enqueue
+// order, and therefore of the seeded trajectory) matches the Python loop.
+typedef struct {
+  PyObject_HEAD
+  PyObject* executor;
+  PyObject* task;
+  long long epoch;
+} TaskWakerObject;
+
+static int enqueue_task(PyObject* executor, PyObject* task);
+
+static PyObject* TaskWaker_call(PyObject* self_obj, PyObject* args,
+                                PyObject* kwargs) {
+  TaskWakerObject* self = (TaskWakerObject*)self_obj;
+  PyObject* epoch_obj = PyObject_GetAttr(self->task, s_wake_epoch);
+  if (!epoch_obj) return nullptr;
+  long long epoch = PyLong_AsLongLong(epoch_obj);
+  Py_DECREF(epoch_obj);
+  if (epoch == -1 && PyErr_Occurred()) return nullptr;
+  if (epoch == self->epoch) {
+    if (enqueue_task(self->executor, self->task) < 0) return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// GC support is mandatory here: every pending await forms a cycle
+// (task.coro frame → future → _callbacks → waker → task), exactly like
+// the Python closure it replaces — which is GC-tracked. Without
+// traverse/clear a discarded Runtime with suspended tasks would leak its
+// whole executor graph.
+static int TaskWaker_traverse(PyObject* self_obj, visitproc visit,
+                              void* arg) {
+  TaskWakerObject* self = (TaskWakerObject*)self_obj;
+  Py_VISIT(self->executor);
+  Py_VISIT(self->task);
+  return 0;
+}
+
+static int TaskWaker_clear(PyObject* self_obj) {
+  TaskWakerObject* self = (TaskWakerObject*)self_obj;
+  Py_CLEAR(self->executor);
+  Py_CLEAR(self->task);
+  return 0;
+}
+
+static void TaskWaker_dealloc(PyObject* self_obj) {
+  PyObject_GC_UnTrack(self_obj);
+  TaskWaker_clear(self_obj);
+  PyObject_GC_Del(self_obj);
+}
+
+static PyTypeObject TaskWakerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "madsim_tpu.native.TaskWaker",
+    sizeof(TaskWakerObject),
+    0,                 // tp_itemsize
+    TaskWaker_dealloc, // tp_dealloc
+};
+
+// _enqueue parity: if task._scheduled or task._finished: return;
+// task._scheduled = True; queue.append(task). Returns -1 on error.
+static int enqueue_task(PyObject* executor, PyObject* task) {
+  PyObject* flag = PyObject_GetAttr(task, s_scheduled);
+  if (!flag) return -1;
+  int truthy = PyObject_IsTrue(flag);
+  Py_DECREF(flag);
+  if (truthy) return truthy < 0 ? -1 : 0;
+  flag = PyObject_GetAttr(task, s_finished);
+  if (!flag) return -1;
+  truthy = PyObject_IsTrue(flag);
+  Py_DECREF(flag);
+  if (truthy) return truthy < 0 ? -1 : 0;
+  if (PyObject_SetAttr(task, s_scheduled, Py_True) < 0) return -1;
+  PyObject* queue = PyObject_GetAttr(executor, s_queue);
+  if (!queue) return -1;
+  int rc = PyList_Append(queue, task);
+  Py_DECREF(queue);
+  return rc;
+}
+
+// Truthiness of an attribute; -1 on error.
+static int attr_true(PyObject* obj, PyObject* name) {
+  PyObject* v = PyObject_GetAttr(obj, name);
+  if (!v) return -1;
+  int t = PyObject_IsTrue(v);
+  Py_DECREF(v);
+  return t;
+}
+
+// task._finished = True; task.node.tasks.pop(task, None);
+// then join_future.set_result(value) / set_exception(exc).
+static int finish_task(PyObject* task, PyObject* method, PyObject* payload) {
+  if (PyObject_SetAttr(task, s_finished, Py_True) < 0) return -1;
+  PyObject* node = PyObject_GetAttr(task, s_node);
+  if (!node) return -1;
+  PyObject* tasks = PyObject_GetAttr(node, s_tasks);
+  Py_DECREF(node);
+  if (!tasks) return -1;
+  if (PyDict_Contains(tasks, task) > 0 && PyDict_DelItem(tasks, task) < 0) {
+    Py_DECREF(tasks);
+    return -1;
+  }
+  Py_DECREF(tasks);
+  PyObject* fut = PyObject_GetAttr(task, s_join_future);
+  if (!fut) return -1;
+  PyObject* r = PyObject_CallMethodObjArgs(fut, method, payload, nullptr);
+  Py_DECREF(fut);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// run_ready(executor, tls, SimFuture, Cancelled, PENDING, rng_capsule)
+static PyObject* py_run_ready(PyObject*, PyObject* args) {
+  PyObject *ex, *tls, *simfut_t, *cancelled_t, *pending, *rng_capsule;
+  if (!PyArg_ParseTuple(args, "OOOOOO", &ex, &tls, &simfut_t, &cancelled_t,
+                        &pending, &rng_capsule))
+    return nullptr;
+  RngState* st = rng_from(rng_capsule);
+  if (!st) return nullptr;
+  PyObject* queue = PyObject_GetAttr(ex, s_queue);  // one list for the run
+  if (!queue) return nullptr;
+  PyObject* time_obj = PyObject_GetAttr(ex, s_time);
+  if (!time_obj) {
+    Py_DECREF(queue);
+    return nullptr;
+  }
+  long long polls = 0;
+  int failed = 0;
+
+  for (;;) {
+    PyObject* unc = PyObject_GetAttr(ex, s_uncaught);
+    if (!unc) { failed = 1; break; }
+    int has_unc = unc != Py_None;
+    Py_DECREF(unc);
+    if (has_unc) break;
+
+    Py_ssize_t n = PyList_GET_SIZE(queue);
+    if (n == 0) {
+      // Resolve parked yields once the ready batch drains (yield_now
+      // keeps the timer path's ordering — see the Python loop).
+      PyObject* ylist = PyObject_GetAttr(ex, s_yields);
+      if (!ylist) { failed = 1; break; }
+      if (PyList_GET_SIZE(ylist) == 0) { Py_DECREF(ylist); break; }
+      PyObject* fresh = PyList_New(0);
+      if (!fresh || PyObject_SetAttr(ex, s_yields, fresh) < 0) {
+        Py_XDECREF(fresh); Py_DECREF(ylist); failed = 1; break;
+      }
+      Py_DECREF(fresh);
+      Py_ssize_t yn = PyList_GET_SIZE(ylist);
+      for (Py_ssize_t i = 0; i < yn && !failed; ++i) {
+        PyObject* fut = PyList_GET_ITEM(ylist, i);  // borrowed
+        PyObject* r =
+            PyObject_CallMethodObjArgs(fut, s_set_result, Py_None, nullptr);
+        if (!r) failed = 1; else Py_DECREF(r);
+      }
+      Py_DECREF(ylist);
+      if (failed) break;
+      continue;
+    }
+
+    // Seeded uniform pick + swap-remove (gen_range parity: u64 % width).
+    Py_ssize_t idx = (Py_ssize_t)(ms_rng_next_u64(st) % (uint64_t)n);
+    PyObject* task = PyList_GET_ITEM(queue, idx);  // borrowed
+    Py_INCREF(task);                               // our working ref
+    if (idx != n - 1) {
+      PyObject* last = PyList_GET_ITEM(queue, n - 1);
+      Py_INCREF(last);
+      // PyList_SetItem steals the new ref AND decrefs the displaced item.
+      PyList_SetItem(queue, idx, last);
+      Py_INCREF(task);
+      PyList_SetItem(queue, n - 1, task);
+    }
+    if (PyList_SetSlice(queue, n - 1, n, nullptr) < 0) {
+      Py_DECREF(task); failed = 1; break;
+    }
+    if (PyObject_SetAttr(task, s_scheduled, Py_False) < 0) {
+      Py_DECREF(task); failed = 1; break;
+    }
+    PyObject* info = PyObject_GetAttr(task, s_node);
+    if (!info) { Py_DECREF(task); failed = 1; break; }
+    int killed = attr_true(info, s_killed);
+    int cancelled = killed > 0 ? 0 : attr_true(task, s_cancelled);
+    int finished =
+        (killed > 0 || cancelled > 0) ? 0 : attr_true(task, s_finished);
+    if (killed < 0 || cancelled < 0 || finished < 0) {
+      Py_DECREF(info); Py_DECREF(task); failed = 1; break;
+    }
+    if (killed || cancelled || finished) {
+      Py_DECREF(info);
+      PyObject* r = PyObject_CallMethodObjArgs(task, s_drop, nullptr);
+      Py_DECREF(task);
+      if (!r) { failed = 1; break; }
+      Py_DECREF(r);
+      continue;
+    }
+    int paused = attr_true(info, s_paused);
+    if (paused < 0) { Py_DECREF(info); Py_DECREF(task); failed = 1; break; }
+    if (paused) {
+      PyObject* parked = PyObject_GetAttr(info, s_paused_tasks);
+      Py_DECREF(info);
+      if (!parked) { Py_DECREF(task); failed = 1; break; }
+      int rc = PyList_Append(parked, task);
+      Py_DECREF(parked);
+      Py_DECREF(task);
+      if (rc < 0) { failed = 1; break; }
+      continue;
+    }
+    Py_DECREF(info);
+
+    // tls.task push (getattr default None, like the Python loop).
+    PyObject* prev = PyObject_GetAttr(tls, s_task);
+    if (!prev) {
+      if (!PyErr_ExceptionMatches(PyExc_AttributeError)) {
+        Py_DECREF(task); failed = 1; break;
+      }
+      PyErr_Clear();
+      prev = Py_None;
+      Py_INCREF(prev);
+    }
+    if (PyObject_SetAttr(tls, s_task, task) < 0) {
+      Py_DECREF(prev); Py_DECREF(task); failed = 1; break;
+    }
+    polls += 1;
+
+    // ---- inlined _poll --------------------------------------------------
+    PyObject* coro = PyObject_GetAttr(task, s_coro);
+    PyObject* yielded = nullptr;
+    if (coro) {
+      PyObject* pend = PyObject_GetAttr(task, s_pending_exc);
+      if (pend && pend != Py_None) {
+        if (PyObject_SetAttr(task, s_pending_exc, Py_None) == 0)
+          yielded = PyObject_CallMethodObjArgs(coro, s_throw, pend, nullptr);
+        Py_DECREF(pend);
+      } else if (pend) {
+        Py_DECREF(pend);
+        yielded = PyObject_CallMethodObjArgs(coro, s_send, Py_None, nullptr);
+      }
+      Py_DECREF(coro);
+    }
+
+    if (!yielded) {
+      if (PyErr_ExceptionMatches(PyExc_StopIteration)) {
+        PyObject *etype, *evalue, *etb;
+        PyErr_Fetch(&etype, &evalue, &etb);
+        PyErr_NormalizeException(&etype, &evalue, &etb);
+        PyObject* value = evalue ? PyObject_GetAttr(evalue, s_value) : nullptr;
+        Py_XDECREF(etype); Py_XDECREF(evalue); Py_XDECREF(etb);
+        if (!value) failed = 1;
+        else {
+          if (finish_task(task, s_set_result, value) < 0) failed = 1;
+          Py_DECREF(value);
+        }
+      } else if (PyErr_ExceptionMatches(cancelled_t)) {
+        PyErr_Clear();
+        PyObject* r = PyObject_CallMethodObjArgs(task, s_drop, nullptr);
+        if (!r) failed = 1; else Py_DECREF(r);
+      } else if (PyErr_Occurred()) {
+        PyObject *etype, *evalue, *etb;
+        PyErr_Fetch(&etype, &evalue, &etb);
+        PyErr_NormalizeException(&etype, &evalue, &etb);
+        if (etb) PyException_SetTraceback(evalue, etb);
+        if (finish_task(task, s_set_exception, evalue) < 0 ||
+            PyObject_SetAttr(ex, s_uncaught, evalue) < 0)
+          failed = 1;
+        Py_XDECREF(etype); Py_XDECREF(evalue); Py_XDECREF(etb);
+      } else {
+        failed = 1;  // coro attr missing
+      }
+    } else if (PyObject_IsInstance(yielded, simfut_t) > 0) {
+      PyObject* epoch_obj = PyObject_GetAttr(task, s_wake_epoch);
+      long long epoch = epoch_obj ? PyLong_AsLongLong(epoch_obj) : -1;
+      Py_XDECREF(epoch_obj);
+      PyObject* res = PyObject_GetAttr(yielded, s_result);
+      PyObject* exc = res ? PyObject_GetAttr(yielded, s_exception) : nullptr;
+      if (!res || !exc) {
+        Py_XDECREF(res); Py_XDECREF(exc); failed = 1;
+      } else {
+        int done = (res != pending) || (exc != Py_None);
+        Py_DECREF(res); Py_DECREF(exc);
+        if (done) {
+          // add_done_callback on a done future fires synchronously; the
+          // epoch just captured always matches.
+          if (enqueue_task(ex, task) < 0) failed = 1;
+        } else {
+          TaskWakerObject* waker =
+              PyObject_GC_New(TaskWakerObject, &TaskWakerType);
+          if (!waker) failed = 1;
+          else {
+            Py_INCREF(ex); waker->executor = ex;
+            Py_INCREF(task); waker->task = task;
+            waker->epoch = epoch;
+            PyObject_GC_Track((PyObject*)waker);
+            PyObject* cbs = PyObject_GetAttr(yielded, s_callbacks);
+            if (!cbs || PyList_Append(cbs, (PyObject*)waker) < 0) failed = 1;
+            Py_XDECREF(cbs);
+            Py_DECREF(waker);
+          }
+        }
+      }
+      Py_DECREF(yielded);
+    } else if (PyErr_Occurred()) {
+      Py_DECREF(yielded);
+      failed = 1;  // IsInstance error
+    } else {
+      // Foreign awaitable: shared Python diagnostic path.
+      PyObject* r = PyObject_CallMethodObjArgs(ex, s_foreign_yield, task,
+                                               yielded, nullptr);
+      Py_DECREF(yielded);
+      if (!r) failed = 1; else Py_DECREF(r);
+    }
+
+    // tls.task pop (the Python loop's `finally`) — preserve any pending
+    // exception across the restore, exactly like a finally block.
+    {
+      PyObject *etype = nullptr, *evalue = nullptr, *etb = nullptr;
+      if (PyErr_Occurred()) PyErr_Fetch(&etype, &evalue, &etb);
+      if (PyObject_SetAttr(tls, s_task, prev) < 0) {
+        if (etype) PyErr_Clear();  // the original error wins
+        failed = 1;
+      }
+      if (etype) PyErr_Restore(etype, evalue, etb);
+    }
+    Py_DECREF(prev);
+    Py_DECREF(task);
+    if (failed) break;
+
+    // Per-poll 50-100 ns jitter (task.rs:176-178), same draw as gen_range.
+    long long delta = 50 + (long long)(ms_rng_next_u64(st) % 50);
+    PyObject* t_ns = PyObject_GetAttr(time_obj, s_elapsed_ns);
+    if (!t_ns) { failed = 1; break; }
+    PyObject* delta_obj = PyLong_FromLongLong(delta);
+    PyObject* new_t = delta_obj ? PyNumber_Add(t_ns, delta_obj) : nullptr;
+    Py_DECREF(t_ns);
+    Py_XDECREF(delta_obj);
+    if (!new_t || PyObject_SetAttr(time_obj, s_elapsed_ns, new_t) < 0)
+      failed = 1;
+    Py_XDECREF(new_t);
+    if (failed) break;
+  }
+
+  Py_DECREF(time_obj);
+  Py_DECREF(queue);
+  // Flush the poll counter even on the error path.
+  PyObject* pc = PyObject_GetAttr(ex, s_poll_count);
+  if (pc) {
+    PyObject* add = PyLong_FromLongLong(polls);
+    PyObject* total = add ? PyNumber_Add(pc, add) : nullptr;
+    Py_DECREF(pc);
+    Py_XDECREF(add);
+    if (total) {
+      PyObject_SetAttr(ex, s_poll_count, total);
+      Py_DECREF(total);
+    }
+  } else if (!failed) {
+    failed = 1;
+  }
+  if (failed) return nullptr;
+  Py_RETURN_NONE;
+}
+
 static PyMethodDef core_methods[] = {
+    {"run_ready", py_run_ready, METH_VARARGS,
+     "run_ready(executor, tls, SimFuture, Cancelled, PENDING, rng) — "
+     "Executor.run_all_ready in C, bit-identical to the Python loop"},
     {"rng_new", py_rng_new, METH_VARARGS,
      "rng_new(k0, k1, counter) -> RngState capsule"},
     {"rng_next_u64", py_rng_next_u64, METH_VARARGS, "fresh u64 block"},
@@ -387,4 +772,34 @@ static struct PyModuleDef core_module = {PyModuleDef_HEAD_INIT, "_core",
                                          "madsim_tpu native host core",
                                          -1, core_methods};
 
-PyMODINIT_FUNC PyInit__core(void) { return PyModule_Create(&core_module); }
+PyMODINIT_FUNC PyInit__core(void) {
+  struct {
+    PyObject** slot;
+    const char* name;
+  } names[] = {
+      {&s_queue, "queue"}, {&s_yields, "_yields"},
+      {&s_uncaught, "_uncaught"}, {&s_scheduled, "_scheduled"},
+      {&s_finished, "_finished"}, {&s_cancelled, "cancelled"},
+      {&s_node, "node"}, {&s_killed, "killed"}, {&s_paused, "paused"},
+      {&s_paused_tasks, "paused_tasks"}, {&s_task, "task"},
+      {&s_pending_exc, "_pending_exc"}, {&s_coro, "coro"},
+      {&s_send, "send"}, {&s_throw, "throw"}, {&s_drop, "drop"},
+      {&s_set_result, "set_result"}, {&s_set_exception, "set_exception"},
+      {&s_wake_epoch, "wake_epoch"}, {&s_result, "_result"},
+      {&s_exception, "_exception"}, {&s_callbacks, "_callbacks"},
+      {&s_join_future, "join_future"}, {&s_tasks, "tasks"},
+      {&s_elapsed_ns, "elapsed_ns"}, {&s_poll_count, "poll_count"},
+      {&s_time, "time"}, {&s_foreign_yield, "_foreign_yield"},
+      {&s_value, "value"},
+  };
+  for (auto& e : names) {
+    *e.slot = PyUnicode_InternFromString(e.name);
+    if (!*e.slot) return nullptr;
+  }
+  TaskWakerType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  TaskWakerType.tp_call = TaskWaker_call;
+  TaskWakerType.tp_traverse = TaskWaker_traverse;
+  TaskWakerType.tp_clear = TaskWaker_clear;
+  if (PyType_Ready(&TaskWakerType) < 0) return nullptr;
+  return PyModule_Create(&core_module);
+}
