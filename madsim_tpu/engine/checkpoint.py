@@ -57,20 +57,28 @@ def save(engine, state, path: Union[str, Path],
                 "state is sharded across processes: single-file "
                 "checkpointing needs all shards addressable from this "
                 "host (gather first, or checkpoint per-process)")
+    # jax.device_get, not np.asarray: the __array__ protocol path copies
+    # at single-digit MB/s on jax CPU arrays (measured 46 s for a 205 MB
+    # leaf), while device_get takes the zero-copy/bulk-transfer path.
+    host_leaves, now = jax.device_get((leaves, state.now))
     arrays = {f"leaf_{i:05d}": np.asarray(leaf)
-              for i, leaf in enumerate(leaves)}
+              for i, leaf in enumerate(host_leaves)}
     meta = {
         "version": FORMAT_VERSION,
         "n_leaves": len(leaves),
-        "n_worlds": int(np.asarray(state.now).shape[0])
-        if np.asarray(state.now).ndim else 0,
+        "n_worlds": int(now.shape[0]) if now.ndim else 0,
         "config": _config_fingerprint(engine),
         "extra": dict(extra_meta or {}),
     }
     path = Path(path)
     tmp = path.with_name(path.name + ".tmp")
     with open(tmp, "wb") as f:
-        np.savez_compressed(f, meta=np.frombuffer(
+        # Uncompressed: zlib over a few hundred MB of state costs ~15 s per
+        # snapshot (measured — it made per-chunk checkpointing 15x slower
+        # than the sweep itself), while the raw write is disk-speed and
+        # overlaps the next chunk under the async writer. np.load reads
+        # both formats, so old compressed checkpoints keep resuming.
+        np.savez(f, meta=np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8), **arrays)
     os.replace(tmp, path)
 

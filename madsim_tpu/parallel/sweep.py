@@ -67,6 +67,95 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
     return runner
 
 
+class _AsyncCheckpointer:
+    """Background checkpoint writer: overlaps the device→host pull and the
+    npz write with the next chunk's device work (VERDICT r4 item 7 — the
+    synchronous save used to block the chunk loop for its full duration).
+
+    Latest-wins coalescing: if the writer is still busy when the next
+    snapshot arrives, the queued-but-unstarted one is replaced — for
+    preemption survival only the newest durable state matters, and write
+    cadence must not backpressure the sweep. Reading completed jax arrays
+    from this thread is safe (the runner does not donate its inputs), and
+    the on-disk write stays atomic (engine/checkpoint.py tmp+rename).
+    """
+
+    def __init__(self, eng, path, extra_meta):
+        import threading
+
+        self._eng = eng
+        self._path = path
+        self._meta = extra_meta
+        self._cond = threading.Condition()
+        self._pending = None
+        self._busy = False
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="madsim-checkpointer", daemon=True)
+        self._thread.start()
+
+    def submit(self, state) -> None:
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            self._pending = state
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        import jax as _jax
+
+        from ..engine import checkpoint as ckpt
+
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None:
+                    return
+                state, self._pending = self._pending, None
+                self._busy = True
+            try:
+                # Pull to host FIRST and drop the device reference: holding
+                # the device pytree through the disk write would pin up to
+                # a full extra state of HBM while the sweep runs ahead.
+                host_state = _jax.device_get(state)
+                state = None
+                ckpt.save(self._eng, host_state, self._path,
+                          extra_meta=self._meta)
+                exc = None
+            except BaseException as e:  # noqa: BLE001 — surfaced at submit/flush
+                exc = e
+            with self._cond:
+                self._busy = False
+                if exc is not None:
+                    self._error = exc
+                self._cond.notify_all()
+
+    def flush_and_close(self, suppress_errors: bool = False) -> None:
+        """Wait until every submitted snapshot is durable, then stop.
+
+        ``suppress_errors`` logs a deferred writer failure instead of
+        raising — for finally blocks where an in-flight exception must not
+        be masked by a checkpoint-write error."""
+        with self._cond:
+            while self._pending is not None or self._busy:
+                self._cond.wait()
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
+        if self._error is not None:
+            if suppress_errors:
+                import logging
+
+                logging.getLogger("madsim_tpu.sweep").warning(
+                    "checkpoint write failed during sweep teardown: %r",
+                    self._error)
+            else:
+                raise self._error
+
+
 @dataclasses.dataclass
 class SweepResult:
     """Outcome of a sharded seed sweep."""
@@ -148,23 +237,34 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         state = shard_worlds(eng.init(seeds_p, faults=faults_p), mesh)
     runner = sharded_engine(eng, mesh, chunk_steps)
 
+    writer = (_AsyncCheckpointer(eng, checkpoint_path, seeds_meta)
+              if checkpoint_path else None)
     steps = 0
     chunks = 0
-    saved_at_chunk = -1
-    while steps < max_steps:
-        state, any_bug, n_active = runner(state)
-        steps += chunk_steps
-        chunks += 1
-        if checkpoint_path and checkpoint_every_chunks and \
-                chunks % checkpoint_every_chunks == 0:
-            ckpt.save(eng, state, checkpoint_path, extra_meta=seeds_meta)
-            saved_at_chunk = chunks
-        if int(n_active) == 0:
-            break
-        if stop_on_first_bug and bool(any_bug):
-            break
-    if checkpoint_path and saved_at_chunk != chunks:
-        ckpt.save(eng, state, checkpoint_path, extra_meta=seeds_meta)
+    last_submitted = None
+    try:
+        while steps < max_steps:
+            state, any_bug, n_active = runner(state)
+            steps += chunk_steps
+            chunks += 1
+            if writer is not None and checkpoint_every_chunks and \
+                    chunks % checkpoint_every_chunks == 0:
+                # Async: the pull + write overlap the next chunk's device
+                # work; the loop never blocks on the filesystem.
+                writer.submit(state)
+                last_submitted = state
+            if int(n_active) == 0:
+                break
+            if stop_on_first_bug and bool(any_bug):
+                break
+        if writer is not None and state is not last_submitted:
+            writer.submit(state)  # the final state is always durable
+        if writer is not None:
+            writer.flush_and_close()
+            writer = None
+    finally:
+        if writer is not None:  # exception path: don't mask it
+            writer.flush_and_close(suppress_errors=True)
 
     obs = eng.observe(state)
     obs = {k: v[:n] for k, v in obs.items()}
