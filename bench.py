@@ -181,13 +181,22 @@ def bench_rpc_real(n_rounds: int) -> dict:
             dt = ms.run(world(b"\xab" * size, data_rounds))
             rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
         out["payload_mb_per_sec"] = rates
-        # The alternative wire transport (Unix sockets) on the same world:
-        # same frames, kernel UDS path instead of loopback TCP.
+        # The alternative wire transports on the same world: kernel UDS
+        # instead of loopback TCP, and the shm bulk leg (UDS control +
+        # shared-memory rings for >=32 KiB payloads — docs/transports.md).
         os.environ["MADSIM_REAL_TRANSPORT"] = "uds"
         dt = ms.run(world(b"", n_rounds))
         out["uds_empty_rpc_roundtrips_per_sec"] = round(n_rounds / dt, 2)
         out["uds_empty_rpc_latency_us"] = round(dt / n_rounds * 1e6, 1)
-        log(f"rpc_real (production backend, tcp + uds): {out}")
+        os.environ["MADSIM_REAL_TRANSPORT"] = "shm"
+        dt = ms.run(world(b"", n_rounds))
+        out["shm_empty_rpc_latency_us"] = round(dt / n_rounds * 1e6, 1)
+        shm_rates = {}
+        for size in PAYLOAD_SIZES:
+            dt = ms.run(world(b"\xab" * size, data_rounds))
+            shm_rates[f"{size}B"] = round(data_rounds * size / dt / 1e6, 2)
+        out["shm_payload_mb_per_sec"] = shm_rates
+        log(f"rpc_real (production backend, tcp + uds + shm): {out}")
         return out
     finally:
         if prior_backend is None:

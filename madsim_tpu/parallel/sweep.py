@@ -241,7 +241,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
               if checkpoint_path else None)
     steps = 0
     chunks = 0
-    last_submitted = None
+    submitted_at = -1  # chunk counter, not an object ref: a pytree ref
+    # here would pin a full extra device state between checkpoints.
     try:
         while steps < max_steps:
             state, any_bug, n_active = runner(state)
@@ -252,12 +253,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 # Async: the pull + write overlap the next chunk's device
                 # work; the loop never blocks on the filesystem.
                 writer.submit(state)
-                last_submitted = state
+                submitted_at = chunks
             if int(n_active) == 0:
                 break
             if stop_on_first_bug and bool(any_bug):
                 break
-        if writer is not None and state is not last_submitted:
+        if writer is not None and submitted_at != chunks:
             writer.submit(state)  # the final state is always durable
         if writer is not None:
             writer.flush_and_close()

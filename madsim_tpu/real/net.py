@@ -71,6 +71,17 @@ _OOB_HEAD = struct.Struct(">II")  # buffer count + pickle stream length
 FMT_BYTES = 0
 FMT_PICKLE = 1
 FMT_PICKLE_OOB = 2                # pickle-5 stream + out-of-band buffer table
+# Shared-memory bulk leg (MADSIM_REAL_TRANSPORT=shm): control frames ride
+# the ordered socket stream; bulk payload bytes live in a per-connection
+# ring arena. The analog of the reference's zero-copy transports behind
+# the same Endpoint API (`std/net/ucx.rs`, `std/net/erpc.rs`); see
+# docs/transports.md for the measured envelope and design limits.
+FMT_SHM_HELLO = 3                 # body: the sender's arena segment name
+FMT_SHM_ACK = 4                   # body: u64 cumulative consumed cursor
+FMT_SHM_REF = 5                   # body: [logical off u64][len u64][fmt u8]
+_SHM_REF = struct.Struct(">QQB")
+_SHM_ACK = struct.Struct(">Q")
+_SHM_MIN = 1 << 15                # payloads >= 32 KiB take the arena path
 _MAX_FRAME = 1 << 30
 _FRAME_HEAD = _HDR.size + _TAGFMT.size
 # Frames whose raw payload (or any hoisted bytes inside a pickled
@@ -187,6 +198,81 @@ def _encode_frames(tag: int, data: Any) -> List[Any]:
             *raws]
 
 
+def _encode_frames_for(proto: Optional["_FrameProtocol"], tag: int,
+                       data: Any) -> List[Any]:
+    """Per-connection encoder: on shm-enabled connections, payloads >=
+    _SHM_MIN are copied once into the connection's ring arena and the wire
+    carries a tiny (offset, length, fmt) reference; everything else (and
+    any arena-full condition) takes the plain inline path — the fallback
+    keeps the stream correct under any backpressure."""
+    if proto is None or not proto.shm_enabled:
+        return _encode_frames(tag, data)
+
+    # The one-time HELLO (arena name + logical ring size) must precede
+    # whatever this call emits — INCLUDING an inline fallback, or a later
+    # in-range bulk send would emit a REF the receiver cannot resolve.
+    hello: List[Any] = []
+
+    def arena():
+        if proto.shm_tx is None:
+            proto.shm_tx = _ShmArena(_shm_arena_size())
+            text = f"{proto.shm_tx.name}:{proto.shm_tx.size}".encode()
+            hello.append(_HDR.pack(_TAGFMT.size + len(text))
+                         + _TAGFMT.pack(0, FMT_SHM_HELLO) + text)
+        return proto.shm_tx
+
+    def ref_frame(off: int, n: int, ofmt: int) -> List[Any]:
+        body = _SHM_REF.pack(off, n, ofmt)
+        return hello + [_HDR.pack(_TAGFMT.size + len(body))
+                        + _TAGFMT.pack(tag, FMT_SHM_REF) + body]
+
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        raw = data if isinstance(data, (bytes, bytearray)) else bytes(data)
+        if len(raw) >= _SHM_MIN:
+            slot = arena().alloc(len(raw))
+            if slot is not None:
+                off, dst = slot
+                dst[:] = raw
+                del dst
+                return ref_frame(off, len(raw), FMT_BYTES)
+        return hello + _encode_frames(tag, data)
+
+    sink: list = []
+    hoisted = _hoist(data, sink)
+    if not sink:
+        blob = pickle.dumps(data)
+        if len(blob) >= _SHM_MIN:
+            slot = arena().alloc(len(blob))
+            if slot is not None:
+                off, dst = slot
+                dst[:] = blob
+                del dst
+                return ref_frame(off, len(blob), FMT_PICKLE)
+        return hello + _encode_frames(tag, data)
+    bufs: List[pickle.PickleBuffer] = []
+    stream = pickle.dumps(hoisted, protocol=5, buffer_callback=bufs.append)
+    raws = [b.raw() for b in bufs]
+    table = struct.pack(f">II{len(raws)}I", len(raws), len(stream),
+                        *[r.nbytes for r in raws])
+    total = len(table) + len(stream) + sum(r.nbytes for r in raws)
+    if total >= _SHM_MIN:
+        slot = arena().alloc(total)
+        if slot is not None:
+            off, dst = slot
+            pos = 0
+            for part in (table, stream, *raws):
+                n = len(part) if not isinstance(part, memoryview) \
+                    else part.nbytes
+                dst[pos:pos + n] = part
+                pos += n
+            del dst
+            return ref_frame(off, total, FMT_PICKLE_OOB)
+    n = _TAGFMT.size + total
+    return hello + [
+        _HDR.pack(n) + _TAGFMT.pack(tag, FMT_PICKLE_OOB) + table + stream,
+        *raws]
+
+
 def _write_frames(transport: asyncio.Transport, frames: List[Any]) -> None:
     if len(frames) == 1:
         transport.write(frames[0])
@@ -220,6 +306,78 @@ _PH_OOB_BUF = 7
 _BULK_PHASES = (_PH_BODY, _PH_OOB_BUF, _PH_OOB_STREAM)
 
 _EOFMARK = object()   # parsed-stream terminator (EOF / connection lost)
+
+
+def _shm_arena_size() -> int:
+    return int(os.environ.get("MADSIM_SHM_ARENA", str(32 << 20)))
+
+
+class _ShmArena:
+    """Sender-side bulk ring: one shared-memory segment per connection
+    direction, bump-allocated with logical (monotone u64) cursors. The
+    receiver acks the logical end of each consumed block over the socket
+    stream; blocks are never overwritten before their ack. A full arena
+    is not an error — the caller falls back to the inline socket path."""
+
+    __slots__ = ("size", "seg", "head", "tail")
+
+    def __init__(self, size: int):
+        from multiprocessing import shared_memory
+
+        self.size = size
+        self.seg = shared_memory.SharedMemory(create=True, size=size)
+        self.head = 0  # logical write cursor
+        self.tail = 0  # logical acked cursor
+
+    @property
+    def name(self) -> str:
+        return self.seg.name
+
+    def alloc(self, n: int):
+        """Reserve n contiguous bytes → (logical_off, memoryview) or None.
+
+        Blocks never wrap: if the physical tail fragment is too small the
+        cursor pads past it (the pad is freed by any later ack)."""
+        if n > self.size:
+            return None
+        head = self.head
+        phys = head % self.size
+        if phys + n > self.size:
+            head += self.size - phys  # pad to the segment start
+            phys = 0
+        if head + n - self.tail > self.size:
+            return None  # would overwrite un-acked bytes
+        self.head = head + n
+        return head, self.seg.buf[phys:phys + n]
+
+    def ack(self, cursor: int) -> None:
+        if cursor > self.tail:
+            self.tail = cursor
+
+    def close(self) -> None:
+        try:
+            self.seg.close()
+        except (OSError, BufferError):
+            pass
+        try:
+            self.seg.unlink()
+        except (OSError, FileNotFoundError):
+            pass
+
+
+def _decode_oob_body(mv) -> Any:
+    """Decode a contiguous FMT_PICKLE_OOB body ([table][stream][buffers])
+    — the arena path's one-shot twin of the incremental wire parser."""
+    nbufs, slen = _OOB_HEAD.unpack_from(mv)
+    lens = struct.unpack_from(f">{nbufs}I", mv, _OOB_HEAD.size)
+    off = _OOB_HEAD.size + 4 * nbufs
+    stream = bytes(mv[off:off + slen])
+    off += slen
+    bufs = []
+    for n in lens:
+        bufs.append(bytes(mv[off:off + n]))
+        off += n
+    return pickle.loads(stream, buffers=bufs)
 
 
 class _FrameProtocol(asyncio.BufferedProtocol):
@@ -259,6 +417,12 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._lens: Tuple[int, ...] = ()
         self._stream: Optional[bytearray] = None
         self._bufs: List[bytearray] = []
+        # -- shared-memory bulk leg (ShmEndpoint connections) --
+        self.shm_enabled = False
+        self.shm_tx: Optional[_ShmArena] = None   # our outgoing arena
+        self.shm_rx = None                        # peer's attached segment
+        self._shm_rx_size = 0                     # peer's LOGICAL ring size
+        self._write_shut = False                  # write_eof sent (half-close)
 
     # -- transport callbacks ----------------------------------------------
     def connection_made(self, transport) -> None:
@@ -278,6 +442,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
 
     def connection_lost(self, exc) -> None:
         self._closed = True
+        if self.shm_tx is not None:
+            self.shm_tx.close()
+            self.shm_tx = None
+        if self.shm_rx is not None:
+            try:
+                self.shm_rx.close()
+            except (OSError, BufferError):
+                pass
+            self.shm_rx = None
         self._emit_eof()
         for w in self._drain_waiters:
             if not w.done():
@@ -406,6 +579,21 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             target = self._target
             if self._fmt == FMT_PICKLE:
                 self._emit(self._tag, pickle.loads(target))
+            elif self._fmt == FMT_SHM_HELLO:
+                from multiprocessing import shared_memory
+
+                name, _, size = bytes(target).decode().rpartition(":")
+                # The LOGICAL ring size travels in the hello: the mapped
+                # segment may be page-rounded, and both sides must wrap
+                # cursors at the same modulus.
+                self.shm_rx = shared_memory.SharedMemory(name=name)
+                self._shm_rx_size = int(size)
+            elif self._fmt == FMT_SHM_ACK:
+                (cursor,) = _SHM_ACK.unpack_from(target)
+                if self.shm_tx is not None:
+                    self.shm_tx.ack(cursor)
+            elif self._fmt == FMT_SHM_REF:
+                self._emit_shm_ref(target)
             else:
                 self._emit(self._tag, bytes(target))
             self._begin(_PH_HEAD, _FRAME_HEAD)
@@ -449,6 +637,41 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._phase = phase
         self._target = bytearray(size)
         self._fill = 0
+
+    def _emit_shm_ref(self, body) -> None:
+        """A bulk message whose bytes live in the peer's arena: copy out,
+        decode by the original fmt, ack the logical cursor so the sender
+        can reuse the space."""
+        off, n, ofmt = _SHM_REF.unpack_from(body)
+        if self.shm_rx is None:
+            raise _FrameError("shm ref before hello")
+        size = self._shm_rx_size
+        phys = off % size
+        if n > size or phys + n > size:
+            raise _FrameError("shm ref out of bounds")
+        view = self.shm_rx.buf[phys:phys + n]
+        if ofmt == FMT_BYTES:
+            data = bytes(view)
+        elif ofmt == FMT_PICKLE:
+            data = pickle.loads(view)
+        elif ofmt == FMT_PICKLE_OOB:
+            data = _decode_oob_body(view)
+        else:
+            raise _FrameError(f"bad shm inner fmt {ofmt}")
+        del view
+        # Ack AFTER the copy-out: the sender may reuse the block the
+        # moment this cursor lands. Written directly on the transport —
+        # frames are written without awaits in between, so an ack can
+        # never interleave mid-frame. A half-closed write side
+        # (_write_shut: write_eof sent) cannot ack; the peer's ring then
+        # fills and degrades to the inline path, which stays correct.
+        if self.transport is not None and not self._closed \
+                and not self._write_shut:
+            ack = _SHM_ACK.pack(off + n)
+            self.transport.write(
+                _HDR.pack(_TAGFMT.size + len(ack))
+                + _TAGFMT.pack(0, FMT_SHM_ACK) + ack)
+        self._emit(self._tag, data)
 
     # -- frame consumers ---------------------------------------------------
     def _emit(self, tag: int, data: Any) -> None:
@@ -546,7 +769,8 @@ class RealChannelSender:
                 # semantics (ConnectionReset).
                 if self._proto._closed or self._transport.is_closing():
                     raise ConnectionReset("connection reset")
-                _write_frames(self._transport, _encode_frames(0, payload))
+                _write_frames(self._transport,
+                              _encode_frames_for(self._proto, 0, payload))
                 await self._proto.drain()
         except (ConnectionError, OSError, RuntimeError):
             # RuntimeError: write after write_eof/close — the sim raises
@@ -556,6 +780,7 @@ class RealChannelSender:
     def close(self) -> None:
         try:
             if self._transport.can_write_eof():
+                self._proto._write_shut = True
                 self._transport.write_eof()
             else:
                 self._transport.close()
@@ -779,7 +1004,6 @@ class RealEndpoint:
     async def send_to_raw(self, dst: Addr, tag: int, data: Any) -> None:
         if self._closed:
             raise BrokenPipe("endpoint closed")
-        frames = _encode_frames(tag, data)
         conn = await self._get_or_connect(dst)
         async with conn.lock:
             # Checked under the lock: a sender queued behind an in-flight
@@ -788,7 +1012,12 @@ class RealEndpoint:
             # where writes are silently discarded while _closed is False.
             if conn.proto._closed or conn.transport.is_closing():
                 raise ConnectionReset("connection reset")
-            _write_frames(conn.transport, frames)
+            # Encoded under the lock: the shm leg's encoder allocates from
+            # the connection's arena and may prepend its one-time HELLO
+            # frame, which must hit the wire before any REF that uses it
+            # (a no-op for tcp/uds connections).
+            _write_frames(conn.transport,
+                          _encode_frames_for(conn.proto, tag, data))
             await conn.proto.drain()
 
     async def recv_from(self, tag: int) -> Tuple[Any, Addr]:
@@ -989,17 +1218,49 @@ class UdsEndpoint(RealEndpoint):
             self._lock_fd = None
 
 
+class ShmEndpoint(UdsEndpoint):
+    """Shared-memory bulk transport: UDS control plane + per-connection
+    ring arenas for payloads >= 32 KiB.
+
+    The third real-transport leg (the stand-in for the reference's
+    UCX/eRPC features, `std/net/ucx.rs` / `std/net/erpc.rs`): message
+    framing, ordering, connection lifecycle, and small messages ride the
+    battle-tested UDS stream unchanged; bulk payload bytes are written
+    once into a sender-owned shared-memory ring and the wire carries a
+    17-byte (offset, length, fmt) reference, eliminating both kernel
+    socket copies and send-buffer chunking for large frames. Receivers
+    ack consumed cursors on the reverse stream; a full ring falls back to
+    the inline path, so throughput degrades instead of deadlocking.
+
+    Measured envelope and the latency rationale (why small-message RPC
+    keeps the socket path) live in docs/transports.md.
+    """
+
+    def _server_proto(self) -> _FrameProtocol:
+        proto = super()._server_proto()
+        proto.shm_enabled = True
+        return proto
+
+    async def _dial(self, dst: Addr, peer: Optional[Addr] = None):
+        transport, proto = await super()._dial(dst, peer)
+        proto.shm_enabled = True
+        return transport, proto
+
+
 def real_endpoint_class() -> type:
     """The Endpoint implementation selected by ``MADSIM_REAL_TRANSPORT``
-    (``tcp`` default; ``uds``/``unix`` for same-host Unix sockets) — the
-    env-var analog of the reference's transport feature flags."""
+    (``tcp`` default; ``uds``/``unix`` for same-host Unix sockets;
+    ``shm`` for UDS control + shared-memory bulk rings) — the env-var
+    analog of the reference's transport feature flags."""
     t = os.environ.get("MADSIM_REAL_TRANSPORT", "tcp").lower()
     if t == "tcp":
         return RealEndpoint
     if t in ("uds", "unix"):
         return UdsEndpoint
+    if t == "shm":
+        return ShmEndpoint
     raise ValueError(f"unknown MADSIM_REAL_TRANSPORT {t!r} "
-                     "(expected 'tcp' or 'uds')")
+                     "(expected 'tcp', 'uds', or 'shm')")
 
 
 # The backend-generic RPC layer rides on the endpoint surface

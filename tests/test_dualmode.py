@@ -30,17 +30,19 @@ class Unhandled:
     x: int = 0
 
 
-@pytest.fixture(params=["sim", "real", "real-uds"])
+@pytest.fixture(params=["sim", "real", "real-uds", "real-shm"])
 def mode(request, monkeypatch, tmp_path):
     if request.param.startswith("real"):
         monkeypatch.setenv("MADSIM_BACKEND", "real")
     else:
         monkeypatch.delenv("MADSIM_BACKEND", raising=False)
-    if request.param == "real-uds":
-        # Third leg of the matrix: the alternative real wire transport
-        # (Unix sockets) behind the same Endpoint API — the reference's
-        # ucx/erpc feature-flag analog.
-        monkeypatch.setenv("MADSIM_REAL_TRANSPORT", "uds")
+    if request.param in ("real-uds", "real-shm"):
+        # Alternative real wire transports behind the same Endpoint API —
+        # the reference's ucx/erpc feature-flag analogs: Unix sockets, and
+        # the shm bulk leg (UDS control + shared-memory rings for large
+        # payloads, docs/transports.md).
+        monkeypatch.setenv("MADSIM_REAL_TRANSPORT",
+                           request.param.removeprefix("real-"))
         monkeypatch.setenv("MADSIM_UDS_DIR", str(tmp_path / "uds"))
     else:
         monkeypatch.delenv("MADSIM_REAL_TRANSPORT", raising=False)
@@ -444,3 +446,63 @@ def test_sim_wins_inside_runtime(monkeypatch):
 
     rt = ms.Runtime(seed=5)
     assert rt.block_on(world()) >= 10.0
+
+
+def test_shm_bulk_payloads_ring_wrap_and_fallback(monkeypatch, tmp_path):
+    """The shm leg's bulk path: >=32 KiB payloads ride the ring (including
+    wrap-around and pickled containers with hoisted buffers); an
+    arena too small for the payload falls back to the inline socket path
+    instead of failing."""
+    monkeypatch.setenv("MADSIM_BACKEND", "real")
+    monkeypatch.setenv("MADSIM_REAL_TRANSPORT", "shm")
+    monkeypatch.setenv("MADSIM_UDS_DIR", str(tmp_path / "uds"))
+    monkeypatch.setenv("MADSIM_SHM_ARENA", str(1 << 20))  # tiny: force wraps
+
+    async def world():
+        a = await Endpoint.bind("127.0.0.1:0")
+        b = await Endpoint.bind("127.0.0.1:0")
+        big = bytes(range(256)) * 1024            # 256 KiB, ring-sized
+        huge = b"\xcd" * (2 << 20)                # 2 MiB > arena: fallback
+        for i in range(12):                       # 3 MiB through a 1 MiB ring
+            await a.send_to(b.local_addr(), 1, big)
+            data, _ = await b.recv_from(1)
+            assert data == big
+        await a.send_to(b.local_addr(), 2, {"blob": big, "i": 7})
+        data, _ = await b.recv_from(2)
+        assert data["blob"] == big and data["i"] == 7
+        await a.send_to(b.local_addr(), 3, huge)  # inline fallback
+        data, _ = await b.recv_from(3)
+        assert data == huge
+        a.close()
+        b.close()
+        return True
+
+    assert ms.run(world())
+
+
+def test_shm_hello_survives_first_alloc_failure(monkeypatch, tmp_path):
+    """If the connection's FIRST bulk payload exceeds the arena, the
+    one-time HELLO must still reach the peer (on the inline fallback) or
+    every later in-range REF would be fatal."""
+    monkeypatch.setenv("MADSIM_BACKEND", "real")
+    monkeypatch.setenv("MADSIM_REAL_TRANSPORT", "shm")
+    monkeypatch.setenv("MADSIM_UDS_DIR", str(tmp_path / "uds"))
+    monkeypatch.setenv("MADSIM_SHM_ARENA", str(256 << 10))
+
+    async def world():
+        a = await Endpoint.bind("127.0.0.1:0")
+        b = await Endpoint.bind("127.0.0.1:0")
+        huge = b"\xee" * (1 << 20)   # > arena: inline fallback, carries HELLO
+        mid = b"\xaf" * (128 << 10)  # fits: must ride the ring fine
+        await a.send_to(b.local_addr(), 1, huge)
+        data, _ = await b.recv_from(1)
+        assert data == huge
+        for _ in range(6):
+            await a.send_to(b.local_addr(), 2, mid)
+            data, _ = await b.recv_from(2)
+            assert data == mid
+        a.close()
+        b.close()
+        return True
+
+    assert ms.run(world())
