@@ -69,6 +69,12 @@ def sleep(delay: float, result: Any = None):
 class Task:
     """asyncio.Task-flavored wrapper over a simulation JoinHandle."""
 
+    # Stdlib-Task internals some libraries reach into (anyio checks both
+    # before delivering cancellation). Sim interrupts deliver at the next
+    # poll — there is never a deferred cancel or a tracked waiter future.
+    _must_cancel = False
+    _fut_waiter = None
+
     def __init__(self, handle: _task.JoinHandle, fut: SimFuture,
                  coro: Coroutine = None):
         self._handle = handle
@@ -76,11 +82,12 @@ class Task:
         self._coro = coro
         self._done_callbacks: List[tuple] = []  # (user cb, installed wrapper)
 
-    def cancel(self) -> bool:
+    def cancel(self, msg: "str | None" = None) -> bool:
         """Request cancellation (asyncio semantics): CancelledError is
         THROWN INTO the task at its current await, so the task can catch
         it, run cleanup, and even raise a different error — completion is
-        observed by awaiting the task, not by cancel() returning."""
+        observed by awaiting the task, not by cancel() returning. ``msg``
+        is accepted for stdlib signature parity (anyio passes one)."""
         if self._fut.done():
             return False
         import inspect as _inspect
@@ -713,12 +720,39 @@ def install() -> None:
     patch(_aio, "current_task", passthrough(_aio.current_task, current_task))
     patch(_aio, "all_tasks", passthrough(_aio.all_tasks, all_tasks))
     # Stdlib-internal call sites resolve these through asyncio.events
-    # (``events.get_running_loop()``), not the package namespace — patch
-    # both so library code reaches the sim loop either way.
+    # (``events.get_running_loop()``) and asyncio.tasks, not the package
+    # namespace — patch those module attrs too. With both in place even
+    # the STDLIB Timeout class (reached by libraries that bound
+    # ``from asyncio import timeout`` before patching, e.g. websockets)
+    # runs over the sim loop: it gets the SimEventLoop from
+    # events.get_running_loop(), a TaskView (with the 3.11
+    # cancel/uncancel counting) from tasks.current_task(), and arms its
+    # deadline via loop.call_at on virtual time.
     patch(_aio.events, "get_running_loop",
           passthrough(_aio.events.get_running_loop, get_running_loop))
     patch(_aio.events, "get_event_loop",
           passthrough(_aio.events.get_event_loop, get_event_loop))
+    patch(_aio.tasks, "current_task",
+          passthrough(_aio.tasks.current_task, current_task))
+
+    # anyio's asyncio backend binds these via `from asyncio import ...` at
+    # module import; if it loaded BEFORE install(), its references bypass
+    # the asyncio-module patches. Re-point the already-bound names — the
+    # analog of the reference shipping patched ecosystem crates
+    # (quanta/getrandom, reference README.md:36-52). A backend imported
+    # later binds the patched names by itself.
+    import sys as _sys
+
+    anyio_backend = _sys.modules.get("anyio._backends._asyncio")
+    if anyio_backend is not None:
+        for name, sim_fn in [("current_task", current_task),
+                             ("all_tasks", all_tasks),
+                             ("get_running_loop", get_running_loop),
+                             ("create_task", _sim_create_task),
+                             ("sleep", sleep)]:
+            orig = getattr(anyio_backend, name, None)
+            if orig is not None:
+                patch(anyio_backend, name, passthrough(orig, sim_fn))
     for name, cls in [("Event", Event), ("Lock", Lock),
                       ("Semaphore", Semaphore), ("Queue", Queue),
                       ("Condition", Condition), ("TaskGroup", TaskGroup)]:

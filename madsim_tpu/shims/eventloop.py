@@ -85,6 +85,22 @@ class AsyncioFuture(SimFuture):
         return get_sim_loop()
 
 
+class _DeadTimerHandle:
+    """Returned by loop timer calls after the world ended (GC-time
+    cleanup); there is no timer to cancel."""
+
+    __slots__ = ()
+
+    def cancel(self) -> None:
+        pass
+
+    def cancelled(self) -> bool:
+        return True
+
+    def when(self) -> float:
+        return 0.0
+
+
 class SimTimerHandle:
     """``loop.call_later``/``call_at`` handle (asyncio.TimerHandle shape)."""
 
@@ -118,7 +134,16 @@ class TaskView:
     every cancel-safe path in the framework already handles via
     CANCELLED_TYPES."""
 
-    __slots__ = ("_task", "_executor", "_cancelling")
+    # __weakref__: libraries key WeakKeyDictionaries by the current task
+    # (anyio's task-state registry, reached through httpx).
+    __slots__ = ("_task", "_executor", "_cancelling", "__weakref__")
+
+    # Stdlib-Task internals some libraries reach into (anyio reads
+    # _must_cancel and _fut_waiter before delivering cancellation):
+    # interrupts deliver at the next poll here, so there is never a
+    # deferred cancel or a tracked waiter future.
+    _must_cancel = False
+    _fut_waiter = None
 
     def __init__(self, task, executor):
         self._task = task
@@ -347,19 +372,27 @@ class SimTransport:
 
 
 class _FakeServerSocket:
-    """Stand-in for ``Server.sockets`` entries: consumers only inspect the
-    bound address (aiohttp's runner reads ``getsockname()``)."""
+    """Stand-in for ``Server.sockets`` entries and for a connection's
+    ``get_extra_info("socket")``: consumers inspect addresses (aiohttp's
+    runner reads ``getsockname()``; anyio, reached through httpx, calls
+    ``getpeername()``) or apply socket options, which are no-ops in-sim."""
 
-    __slots__ = ("_addr",)
+    __slots__ = ("_addr", "_peer")
     family = _socket.AF_INET
     type = _socket.SOCK_STREAM
     proto = _socket.IPPROTO_TCP
 
-    def __init__(self, addr: Tuple[str, int]):
+    def __init__(self, addr: Tuple[str, int], peer: Tuple[str, int] = None):
         self._addr = addr
+        self._peer = peer
 
     def getsockname(self):
         return self._addr
+
+    def getpeername(self):
+        if self._peer is None:
+            raise OSError("not connected")
+        return self._peer
 
     def fileno(self) -> int:
         return -1
@@ -369,6 +402,9 @@ class _FakeServerSocket:
 
     def getsockopt(self, *a, **kw) -> int:
         return 0
+
+    def close(self) -> None:
+        pass
 
 
 class SimServer:
@@ -392,7 +428,8 @@ class SimServer:
                 protocol = self._factory()
                 transport = SimTransport(
                     self._loop, stream, protocol,
-                    {"peername": peer, "sockname": stream.local_addr()})
+                    {"peername": peer, "sockname": stream.local_addr(),
+                     "socket": _FakeServerSocket(stream.local_addr(), peer)})
                 try:
                     protocol.connection_made(transport)
                 except Exception:  # noqa: BLE001 — drop the conn, not the server
@@ -409,6 +446,8 @@ class SimServer:
         self._listener.close()
 
     async def wait_closed(self) -> None:
+        if self._loop._world_gone():
+            return  # GC-time cleanup: nothing left to wait for
         await self._closed
 
     def is_serving(self) -> bool:
@@ -454,17 +493,40 @@ class SimEventLoop:
     def time(self) -> float:
         return self._handle.time.now_ns() / 1e9
 
+    def _world_gone(self) -> bool:
+        """True when called after the loop's world ended (typically
+        GC-time cleanup: a library's __del__/__aexit__ closing servers
+        once block_on returned). Real asyncio raises 'Event loop is
+        closed' there and the interpreter prints 'Exception ignored';
+        the sim degrades silently instead — the world's state is gone,
+        so the cleanup has nothing left to act on."""
+        return _context.try_current_handle() is not self._handle
+
     def call_soon(self, callback, *args, context=None):
         return self.call_later(0, callback, *args)
 
-    call_soon_threadsafe = call_soon
+    def call_soon_threadsafe(self, callback, *args, context=None):
+        # Cross-thread by contract: must NOT consult the thread-local
+        # context (_world_gone would misread a foreign thread as a dead
+        # world and silently drop the callback). Schedule directly on the
+        # world's own timer state; a genuinely dead world's timer simply
+        # never fires.
+        try:
+            entry = self._handle.time.add_timer(0, lambda: callback(*args))
+        except Exception:  # noqa: BLE001 — interpreter-teardown safety
+            return _DeadTimerHandle()
+        return SimTimerHandle(entry, 0.0)
 
     def call_later(self, delay: float, callback, *args, context=None):
+        if self._world_gone():
+            return _DeadTimerHandle()
         entry = self._handle.time.add_timer(
             to_ns(max(0.0, delay)), lambda: callback(*args))
         return SimTimerHandle(entry, self.time() + delay)
 
     def call_at(self, when: float, callback, *args, context=None):
+        if self._world_gone():
+            return _DeadTimerHandle()
         entry = self._handle.time.add_timer_at(
             round(when * 1e9), lambda: callback(*args))
         return SimTimerHandle(entry, when)
@@ -476,6 +538,11 @@ class SimEventLoop:
     def create_task(self, coro, *, name: str = None, context=None):
         from . import aio
 
+        if self._world_gone():
+            coro.close()
+            dead = AsyncioFuture()
+            dead.cancel()
+            return aio.Task(None, dead)
         return aio.create_task(coro)
 
     def run_in_executor(self, executor, fn, *args):
@@ -564,6 +631,11 @@ class SimEventLoop:
                  "sockname": stream.local_addr()}
         if sock is not None:
             extra["socket"] = sock  # live fd for tcp_nodelay-style tuning
+        else:
+            # Libraries (anyio/httpx) read addresses off the socket object
+            # itself; hand them an address-faithful stand-in.
+            extra["socket"] = _FakeServerSocket(stream.local_addr(),
+                                                stream.peer_addr())
         transport = SimTransport(self, stream, protocol, extra)
         protocol.connection_made(transport)
         transport.start_pumps()

@@ -267,3 +267,88 @@ def test_patched_asyncio_task_remains_a_type():
 
         rt = ms.Runtime(seed=5)
         assert rt.block_on(world()) == 1
+
+
+def test_unmodified_websockets_library_in_sim():
+    """pip `websockets` (Sans-I/O core + asyncio integration, stdlib
+    asyncio.timeout bound at import time, keepalive ping timers): client
+    and server run unmodified over the sim network, deterministically."""
+    websockets = pytest.importorskip("websockets")
+    from websockets.asyncio.client import connect
+    from websockets.asyncio.server import serve
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def server_init():
+            async def echo(ws):
+                async for msg in ws:
+                    await ws.send(f"echo:{msg}")
+
+            # No `async with`: the world outlives the test body, and the
+            # context manager's GC-time __aexit__ would suspend (awaiting
+            # websockets' close machinery) — abandoned servers are simply
+            # dropped with their world, like every other sim resource.
+            await serve(echo, "10.0.0.1", 8765)
+            await vtime.sleep(1e6)
+
+        h.create_node(name="srv", ip="10.0.0.1", init=server_init)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+
+        async def client():
+            await vtime.sleep(0.3)
+            out = []
+            async with connect("ws://10.0.0.1:8765") as ws:
+                for i in range(5):
+                    await ws.send(f"m{i}")
+                    out.append(await ws.recv())
+            return out
+
+        return await cli.spawn(client())
+
+    v1, t1 = run_world(world, 21)
+    v2, t2 = run_world(world, 21)
+    assert v1 == [f"echo:m{i}" for i in range(5)]
+    assert (v1, t1) == (v2, t2)
+
+
+def test_unmodified_httpx_client_in_sim():
+    """pip `httpx` (anyio structured concurrency, task-state registries
+    keyed by weakref'd current task, socket extras introspection) talks to
+    an unmodified aiohttp server in-sim, deterministically."""
+    httpx = pytest.importorskip("httpx")
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def server_init():
+            app = web.Application()
+
+            async def hello(request):
+                return web.json_response({"n": int(request.query["n"])})
+
+            app.router.add_get("/hello", hello)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "10.0.0.1", 80).start()
+            await vtime.sleep(1e6)
+
+        h.create_node(name="srv", ip="10.0.0.1", init=server_init)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+
+        async def client():
+            await vtime.sleep(0.3)
+            out = []
+            async with httpx.AsyncClient() as c:
+                for i in range(4):
+                    r = await c.get(f"http://10.0.0.1/hello?n={i}")
+                    assert r.status_code == 200
+                    out.append(r.json()["n"])
+            return out
+
+        return await cli.spawn(client())
+
+    v1, t1 = run_world(world, 31)
+    v2, t2 = run_world(world, 31)
+    assert v1 == [0, 1, 2, 3]
+    assert (v1, t1) == (v2, t2)
