@@ -185,7 +185,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           engine: Optional[DeviceEngine] = None,
           checkpoint_path: Optional[str] = None,
           checkpoint_every_chunks: int = 0,
-          resume: bool = False) -> SweepResult:
+          resume: bool = False,
+          compact: bool = False) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     Preemption survival: with ``checkpoint_path`` set, the (padded) world
@@ -194,6 +195,22 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     re-initializing, and the sweep continues bit-exactly where it stopped —
     resumed trajectories equal an unbroken run's (the state carries every
     RNG cursor and queue). ``max_steps`` counts steps issued by THIS call.
+
+    ``compact``: straggler compaction (docs/perf.md "the straggler
+    tail"). A chunked batch runs until its SLOWEST world finishes, so
+    once most worlds are done the chip mostly advances frozen state.
+    When the active count drops below half the batch, the sweep gathers
+    the active worlds to the front (one on-device permutation), retires
+    the frozen ones (their observations are pulled exactly once, as the
+    final observe would have), and continues on a power-of-two-smaller
+    batch — worlds' trajectories are position-independent, so results
+    are bitwise identical to the uncompacted run (tested). Off by
+    default: each compaction adds host↔device round trips, which on a
+    co-located chip cost microseconds but on a TUNNELED device (this
+    repo's bench machine) cost more than the masked straggler steps they
+    save — measured in docs/perf.md. Enable on co-located hardware with
+    long tails. Disabled automatically when checkpointing (a shrunken
+    state cannot resume into the full-shape contract).
     """
     from ..engine import checkpoint as ckpt
 
@@ -239,10 +256,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
 
     writer = (_AsyncCheckpointer(eng, checkpoint_path, seeds_meta)
               if checkpoint_path else None)
+    compact = compact and writer is None  # shrunken state cannot resume
     steps = 0
     chunks = 0
     submitted_at = -1  # chunk counter, not an object ref: a pytree ref
     # here would pin a full extra device state between checkpoints.
+    w_cur = seeds_p.shape[0]           # current (compacted) batch width
+    orig_idx = np.arange(w_cur)        # row i of state ↔ seeds_p[orig_idx[i]]
+    retired: Dict[str, list] = {}      # field → retired observation batches
+    retired_rows: List[np.ndarray] = []
+
+    def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray) -> None:
+        retired_rows.append(rows)
+        for k, v in obs_slice.items():
+            retired.setdefault(k, []).append(v)
+
     try:
         while steps < max_steps:
             state, any_bug, n_active = runner(state)
@@ -254,10 +282,25 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 # work; the loop never blocks on the filesystem.
                 writer.submit(state)
                 submitted_at = chunks
-            if int(n_active) == 0:
+            n_act = int(n_active)
+            if n_act == 0:
                 break
             if stop_on_first_bug and bool(any_bug):
                 break
+            new_w = _compact_bucket(n_act, w_cur, n_dev)
+            if compact and new_w < w_cur:
+                active = np.asarray(jax.device_get(state.active))
+                # Stable partition: active worlds first, original order
+                # preserved either side of the split.
+                perm = np.argsort(~active, kind="stable")
+                permuted = _permute_worlds(state, jnp.asarray(perm))
+                frozen = jax.tree.map(lambda x: x[new_w:], permuted)
+                obs_f = eng.observe(frozen)
+                retire(obs_f, orig_idx[perm[new_w:]])
+                state = shard_worlds(
+                    jax.tree.map(lambda x: x[:new_w], permuted), mesh)
+                orig_idx = orig_idx[perm[:new_w]]
+                w_cur = new_w
         if writer is not None and submitted_at != chunks:
             writer.submit(state)  # the final state is always durable
         if writer is not None:
@@ -267,7 +310,35 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         if writer is not None:  # exception path: don't mask it
             writer.flush_and_close(suppress_errors=True)
 
-    obs = eng.observe(state)
+    obs_live = eng.observe(state)
+    if retired_rows:
+        rows = np.concatenate(retired_rows + [orig_idx])
+        obs = {}
+        for k, v_live in obs_live.items():
+            merged = np.concatenate(retired[k] + [np.asarray(v_live)], axis=0)
+            out = np.empty_like(merged)
+            out[rows] = merged
+            obs[k] = out
+    else:
+        obs = obs_live
     obs = {k: v[:n] for k, v in obs.items()}
     return SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
                        steps_run=steps, n_devices=n_dev)
+
+
+def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:
+    """Largest power-of-two shrink of ``w_cur`` that still holds every
+    active world and stays a multiple of the mesh; ``w_cur`` when no
+    halving is possible (compaction triggers only below half-occupancy)."""
+    w = w_cur
+    # w//2 % n_dev == 0 already implies the w//2 >= n_dev floor (any
+    # positive value below n_dev fails the modulus test).
+    while w % 2 == 0 and w // 2 >= max(n_active, 1) and w // 2 % n_dev == 0:
+        w //= 2
+    return w
+
+
+@jax.jit
+def _permute_worlds(state, perm):
+    """Reorder the world axis of a whole state pytree on device."""
+    return jax.tree.map(lambda x: x[perm], state)

@@ -483,7 +483,10 @@ class SimEventLoop:
     itself is cached per Handle so library identity checks hold."""
 
     def __init__(self, handle):
+        import threading
+
         self._handle = handle
+        self._thread_ident = threading.get_ident()  # the world's one thread
         # Real socket objects used as connect tokens → their sim streams.
         self._sock_streams: Dict[Any, TcpStream] = {}
         self._exception_handler: Optional[Callable] = None
@@ -506,16 +509,22 @@ class SimEventLoop:
         return self.call_later(0, callback, *args)
 
     def call_soon_threadsafe(self, callback, *args, context=None):
-        # Cross-thread by contract: must NOT consult the thread-local
-        # context (_world_gone would misread a foreign thread as a dead
-        # world and silently drop the callback). Schedule directly on the
-        # world's own timer state; a genuinely dead world's timer simply
-        # never fires.
-        try:
-            entry = self._handle.time.add_timer(0, lambda: callback(*args))
-        except Exception:  # noqa: BLE001 — interpreter-teardown safety
-            return _DeadTimerHandle()
-        return SimTimerHandle(entry, 0.0)
+        # The simulation executes on ONE thread, and in-sim "threads"
+        # (asyncio.to_thread / run_in_executor under patched()) are
+        # deterministic tasks on that same thread — so the common caller
+        # is same-thread defensive library code: behave as call_soon.
+        # A genuinely foreign OS thread is outside the deterministic
+        # world and cannot safely mutate the timer heap — refuse loudly
+        # instead of corrupting it.
+        import threading
+
+        if threading.get_ident() != self._thread_ident:
+            raise RuntimeError(
+                "call_soon_threadsafe from a foreign OS thread is not "
+                "supported in-sim: real threads are outside the "
+                "deterministic world (use asyncio.to_thread, which the "
+                "sim runs as a deterministic task)")
+        return self.call_soon(callback, *args)
 
     def call_later(self, delay: float, callback, *args, context=None):
         if self._world_gone():

@@ -79,3 +79,26 @@ def test_multihost_mesh_matches_flat_mesh():
     for k in flat.observations:
         assert np.array_equal(flat.observations[k], hier.observations[k]), k
     assert not hier.bug.any()
+
+
+def test_compacted_sweep_bitwise_equals_plain():
+    """Straggler compaction (docs/perf.md) reorders and shrinks the world
+    batch mid-sweep; per-world trajectories are position-independent, so
+    every observation must come back bitwise identical, in the original
+    seed order."""
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=1_500_000, stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(256)
+    # Small chunks so buggy worlds freeze early and occupancy actually
+    # drops across chunk boundaries (the compaction trigger).
+    plain = sweep(None, cfg, seeds, engine=eng, chunk_steps=64,
+                  max_steps=10_000, compact=False)
+    compacted = sweep(None, cfg, seeds, engine=eng, chunk_steps=64,
+                      max_steps=10_000, compact=True)
+    for key in plain.observations:
+        np.testing.assert_array_equal(plain.observations[key],
+                                      compacted.observations[key],
+                                      err_msg=key)
+    assert compacted.failing_seeds == plain.failing_seeds
