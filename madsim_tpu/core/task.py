@@ -163,6 +163,7 @@ class Executor:
         lib = _native.get_lib()
         self._native_ready = getattr(lib, "run_ready", None)
         self._pending_sentinel = _PENDING
+        self.running_thread: Optional[int] = None  # set for block_on's span
 
     # ------------------------------------------------------------------
     # Node management
@@ -269,7 +270,20 @@ class Executor:
         return root
 
     def block_on(self, coro: Coroutine) -> Any:
-        root = self.start_root(coro)
+        import threading
+
+        # Which OS thread is executing this world right now (None when
+        # idle). The sim event loop's call_soon_threadsafe consults it:
+        # arming a timer is safe from the running thread or while the
+        # world is idle, and must be refused from a thread racing a live
+        # run.
+        self.running_thread = threading.get_ident()
+        try:
+            return self._block_on(self.start_root(coro))
+        finally:
+            self.running_thread = None
+
+    def _block_on(self, root: Task) -> Any:
         while True:
             self.run_all_ready()
             if self._uncaught is not None:

@@ -483,10 +483,7 @@ class SimEventLoop:
     itself is cached per Handle so library identity checks hold."""
 
     def __init__(self, handle):
-        import threading
-
         self._handle = handle
-        self._thread_ident = threading.get_ident()  # the world's one thread
         # Real socket objects used as connect tokens → their sim streams.
         self._sock_streams: Dict[Any, TcpStream] = {}
         self._exception_handler: Optional[Callable] = None
@@ -509,22 +506,32 @@ class SimEventLoop:
         return self.call_later(0, callback, *args)
 
     def call_soon_threadsafe(self, callback, *args, context=None):
-        # The simulation executes on ONE thread, and in-sim "threads"
-        # (asyncio.to_thread / run_in_executor under patched()) are
-        # deterministic tasks on that same thread — so the common caller
-        # is same-thread defensive library code: behave as call_soon.
-        # A genuinely foreign OS thread is outside the deterministic
-        # world and cannot safely mutate the timer heap — refuse loudly
-        # instead of corrupting it.
         import threading
 
-        if threading.get_ident() != self._thread_ident:
+        # In-world (the executing thread, whichever OS thread that is —
+        # each world runs on exactly one at a time): behaves as
+        # call_soon. This is the common caller — defensive library code,
+        # and in-sim "threads" (asyncio.to_thread / run_in_executor) are
+        # deterministic tasks on the same thread.
+        if _context.try_current_handle() is self._handle:
+            return self.call_soon(callback, *args)
+        running = self._handle.task.running_thread
+        if running is not None and running != threading.get_ident():
+            # A foreign OS thread racing a LIVE run cannot safely mutate
+            # the timer heap — refuse loudly instead of corrupting it.
             raise RuntimeError(
-                "call_soon_threadsafe from a foreign OS thread is not "
-                "supported in-sim: real threads are outside the "
-                "deterministic world (use asyncio.to_thread, which the "
-                "sim runs as a deterministic task)")
-        return self.call_soon(callback, *args)
+                "call_soon_threadsafe from a foreign OS thread during a "
+                "live simulation is not supported: real threads are "
+                "outside the deterministic world (use asyncio.to_thread, "
+                "which the sim runs as a deterministic task)")
+        # Idle world (between block_on runs) or teardown: arm the timer
+        # directly on the world's own heap — it fires when (and if) the
+        # world next advances, like the pre-round-5 behavior.
+        try:
+            entry = self._handle.time.add_timer(0, lambda: callback(*args))
+        except Exception:  # noqa: BLE001 — interpreter-teardown safety
+            return _DeadTimerHandle()
+        return SimTimerHandle(entry, self._handle.time.now_ns() / 1e9)
 
     def call_later(self, delay: float, callback, *args, context=None):
         if self._world_gone():
