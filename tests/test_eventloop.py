@@ -352,3 +352,58 @@ def test_unmodified_httpx_client_in_sim():
     v2, t2 = run_world(world, 31)
     assert v1 == [0, 1, 2, 3]
     assert (v1, t1) == (v2, t2)
+
+
+def test_aiohttp_world_sweeps_through_bridge_bit_identically():
+    """Feature composition: an event-loop drop-in world (unmodified
+    aiohttp) swept through the DEVICE BRIDGE walks the host engine's
+    bit-identical trajectory per seed — the loop's timers and transports
+    ride BridgeTime's device-resident lanes with no special casing."""
+    from madsim_tpu.bridge import sweep_traced
+
+    def make_world():
+        async def world():
+            h = ms.Handle.current()
+
+            async def server_init():
+                app = web.Application()
+
+                async def echo(request):
+                    return web.Response(body=await request.read())
+
+                app.router.add_post("/e", echo)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                await web.TCPSite(runner, "10.0.0.1", 80).start()
+                await vtime.sleep(1e6)
+
+            h.create_node(name="s", ip="10.0.0.1", init=server_init)
+            cli = h.create_node(name="c", ip="10.0.0.2")
+
+            async def client():
+                await vtime.sleep(0.2)
+                n = 0
+                async with aiohttp.ClientSession() as sess:
+                    for i in range(3):
+                        async with sess.post("http://10.0.0.1/e",
+                                             data=b"x" * i) as r:
+                            assert r.status == 200
+                            n += len(await r.read())
+                return n
+
+            return await cli.spawn(client())
+
+        return world
+
+    with aio.patched():
+        host = []
+        for seed in (3, 4):
+            rt = ms.Runtime(seed=seed)
+            tr = []
+            rt.task.trace = tr
+            host.append((rt.block_on(make_world()()), tr))
+        outs, trs = sweep_traced(make_world(), [3, 4])
+    for i in range(2):
+        assert outs[i].error is None, outs[i].error
+        assert outs[i].value == host[i][0] == 3
+        assert trs[i] == host[i][1], f"world {i} diverged from host"
