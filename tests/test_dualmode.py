@@ -506,3 +506,79 @@ def test_shm_hello_survives_first_alloc_failure(monkeypatch, tmp_path):
         return True
 
     assert ms.run(world())
+
+
+def test_shm_cross_process_bulk_rpc(monkeypatch, tmp_path):
+    """The shm leg's reason to exist: server and client in SEPARATE OS
+    processes, bulk payloads riding the shared-memory ring (UDS control
+    plane), acks releasing ring space across process boundaries."""
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    uds_dir = str(tmp_path / "uds")
+    server_src = textwrap.dedent("""
+        import dataclasses, os, sys
+        sys.path.insert(0, %r)
+        os.environ["MADSIM_BACKEND"] = "real"
+        os.environ["MADSIM_REAL_TRANSPORT"] = "shm"
+        os.environ["MADSIM_UDS_DIR"] = %r
+        import madsim_tpu as ms
+        from madsim_tpu.net import Endpoint, rpc
+
+        @dataclasses.dataclass
+        class Blob:
+            data: bytes
+        Blob.__module__ = "__main__"; Blob.__qualname__ = "Blob"
+
+        async def main():
+            ep = await Endpoint.bind("127.0.0.1:0")
+            async def rev(req):
+                return Blob(req.data[::-1])
+            rpc.add_rpc_handler(ep, Blob, rev)
+            print(f"PORT {ep.local_addr()[1]}", flush=True)
+            await ms.time.sleep(60)
+
+        ms.run(main())
+    """) % (str(Path(__file__).resolve().parent.parent), uds_dir)
+
+    proc = subprocess.Popen([_sys.executable, "-c", server_src],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), f"server failed: {line!r}"
+        port = int(line.split()[1])
+        monkeypatch.setenv("MADSIM_BACKEND", "real")
+        monkeypatch.setenv("MADSIM_REAL_TRANSPORT", "shm")
+        monkeypatch.setenv("MADSIM_UDS_DIR", uds_dir)
+
+        import __main__ as main_mod
+
+        @dataclasses.dataclass
+        class Blob:
+            data: bytes
+
+        Blob.__module__ = "__main__"
+        Blob.__qualname__ = "Blob"
+        had = getattr(main_mod, "Blob", None)
+        main_mod.Blob = Blob
+        try:
+            async def client():
+                ep = await Endpoint.bind("127.0.0.1:0")
+                payload = bytes(range(256)) * 512  # 128 KiB: the ring path
+                for _ in range(12):                # > one ring's worth
+                    r = await rpc.call(ep, f"127.0.0.1:{port}",
+                                       Blob(payload), timeout=10.0)
+                    assert r.data == payload[::-1]
+                ep.close()
+                return True
+
+            assert ms.run(client())
+        finally:
+            if had is None:
+                delattr(main_mod, "Blob")
+            else:
+                main_mod.Blob = had
+    finally:
+        proc.kill()
+        proc.wait()
