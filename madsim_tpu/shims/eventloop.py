@@ -371,20 +371,122 @@ class SimTransport:
             pass
 
 
+class SimDatagramTransport:
+    """asyncio.DatagramTransport over a sim UdpSocket: sync ``sendto``
+    through a sender pump, inbound datagrams pumped into
+    ``protocol.datagram_received``."""
+
+    def __init__(self, loop: "SimEventLoop", usock, protocol, peer):
+        self._loop = loop
+        self._usock = usock
+        self._protocol = protocol
+        self._peer = peer  # remote_addr-connected endpoints omit the dst
+        self._sq = Channel()
+        self._closing = False
+        self._extra = {"sockname": usock.local_addr(),
+                       "socket": _FakeServerSocket(usock.local_addr(), peer,
+                                                   datagram=True)}
+        if peer is not None:
+            self._extra["peername"] = peer
+        self._reader = None
+        self._writer = None
+
+    def start_pump(self) -> None:
+        self._reader = _task.spawn(self._read_pump())
+        self._writer = _task.spawn(self._write_pump())
+
+    def get_extra_info(self, name: str, default: Any = None) -> Any:
+        return self._extra.get(name, default)
+
+    def is_closing(self) -> bool:
+        return self._closing
+
+    def set_protocol(self, protocol) -> None:
+        self._protocol = protocol
+
+    def get_protocol(self):
+        return self._protocol
+
+    def sendto(self, data, addr=None) -> None:
+        # asyncio's contracts, enforced eagerly so errors surface at the
+        # call site (not as a pump-task failure that would abort the sim):
+        # a connected endpoint takes None (or its own peer); an
+        # unconnected endpoint requires an address; the address must
+        # parse.
+        if addr is None:
+            if self._peer is None:
+                raise ValueError(
+                    "sendto needs an address on an unconnected endpoint")
+            dst = self._peer
+        else:
+            dst = parse_addr((str(addr[0]), int(addr[1])))
+            if self._peer is not None and dst != self._peer:
+                raise ValueError(
+                    f"Invalid address: must be None or {self._peer}")
+        if self._closing:
+            return
+        try:
+            self._sq.send((bytes(data), dst))
+        except ChannelClosed:
+            pass
+
+    def abort(self) -> None:
+        self.close()
+
+    def close(self) -> None:
+        if self._closing:
+            return
+        self._closing = True
+        self._sq.close()
+        if self._reader is not None:
+            self._reader.abort()
+        self._usock.close()
+        try:
+            self._protocol.connection_lost(None)
+        except Exception:  # noqa: BLE001 — protocol bugs stay contained
+            pass
+
+    async def _read_pump(self) -> None:
+        try:
+            while not self._closing:
+                data, addr = await self._usock.recv_from()
+                if self._peer is not None and addr != self._peer:
+                    continue  # connected-UDP filter, like the kernel's
+                self._protocol.datagram_received(data, addr)
+        except (ConnectionReset, ChannelClosed, Cancelled):
+            pass
+
+    async def _write_pump(self) -> None:
+        try:
+            while True:
+                data, dst = await self._sq.recv()
+                try:
+                    await self._usock.send_to(dst, data)
+                except (ConnectionReset, OSError) as exc:
+                    try:
+                        self._protocol.error_received(exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+        except (ChannelClosed, Cancelled):
+            pass
+
+
 class _FakeServerSocket:
     """Stand-in for ``Server.sockets`` entries and for a connection's
     ``get_extra_info("socket")``: consumers inspect addresses (aiohttp's
     runner reads ``getsockname()``; anyio, reached through httpx, calls
     ``getpeername()``) or apply socket options, which are no-ops in-sim."""
 
-    __slots__ = ("_addr", "_peer")
+    __slots__ = ("_addr", "_peer", "type", "proto")
     family = _socket.AF_INET
-    type = _socket.SOCK_STREAM
-    proto = _socket.IPPROTO_TCP
 
-    def __init__(self, addr: Tuple[str, int], peer: Tuple[str, int] = None):
+    def __init__(self, addr: Tuple[str, int], peer: Tuple[str, int] = None,
+                 *, datagram: bool = False):
         self._addr = addr
         self._peer = peer
+        self.type = _socket.SOCK_DGRAM if datagram else _socket.SOCK_STREAM
+        self.proto = (_socket.IPPROTO_UDP if datagram
+                      else _socket.IPPROTO_TCP)
 
     def getsockname(self):
         return self._addr
@@ -674,6 +776,32 @@ class SimEventLoop:
             host = host[0] if host else "0.0.0.0"
         listener = await TcpListener.bind((host, port or 0))
         return SimServer(self, listener, protocol_factory)
+
+    async def create_datagram_endpoint(self, protocol_factory,
+                                       local_addr=None, remote_addr=None,
+                                       *, family=0, proto=0, flags=0,
+                                       sock=None, reuse_port=None,
+                                       allow_broadcast=None):
+        """asyncio.DatagramProtocol over the sim UDP facade: the loop
+        surface DNS resolvers and UDP-protocol libraries use."""
+        if sock is not None:
+            raise NotImplementedError(
+                "create_datagram_endpoint(sock=...) is not supported "
+                "in-sim; pass local_addr/remote_addr")
+        from ..net.udp import UdpSocket
+
+        if local_addr is not None:
+            usock = await UdpSocket.bind(local_addr)
+        else:
+            usock = await UdpSocket.bind("0.0.0.0:0")
+        peer = None
+        if remote_addr is not None:
+            peer = parse_addr((str(remote_addr[0]), int(remote_addr[1])))
+        protocol = protocol_factory()
+        transport = SimDatagramTransport(self, usock, protocol, peer)
+        protocol.connection_made(transport)
+        transport.start_pump()
+        return transport, protocol
 
     async def start_tls(self, *a, **kw):
         raise NotImplementedError("TLS is not simulated")

@@ -407,3 +407,62 @@ def test_aiohttp_world_sweeps_through_bridge_bit_identically():
         assert outs[i].error is None, outs[i].error
         assert outs[i].value == host[i][0] == 3
         assert trs[i] == host[i][1], f"world {i} diverged from host"
+
+
+def test_create_datagram_endpoint_udp_roundtrip():
+    """The datagram loop surface (DNS-resolver/UDP-library shape):
+    DatagramProtocol server + connected client over sim UDP,
+    deterministic across same-seed runs."""
+
+    class EchoUdp(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            self.transport.sendto(b"ack:" + data, addr)
+
+    class ClientUdp(asyncio.DatagramProtocol):
+        def __init__(self, fut, want):
+            self.fut = fut
+            self.want = want
+            self.got = []
+
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            self.got.append(data)
+            if len(self.got) == self.want:
+                self.fut.set_result(self.got)
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def server_init():
+            loop = asyncio.get_running_loop()
+            await loop.create_datagram_endpoint(
+                EchoUdp, local_addr=("10.0.0.1", 5353))
+            await vtime.sleep(1e6)
+
+        h.create_node(name="srv", ip="10.0.0.1", init=server_init)
+        cli = h.create_node(name="cli", ip="10.0.0.2")
+
+        async def client():
+            await vtime.sleep(0.2)
+            loop = asyncio.get_running_loop()
+            fut = SimFuture()
+            tr, _proto = await loop.create_datagram_endpoint(
+                lambda: ClientUdp(fut, 3),
+                remote_addr=("10.0.0.1", 5353))
+            for i in range(3):
+                tr.sendto(f"d{i}".encode())
+            got = await fut
+            tr.close()
+            return got
+
+        return await cli.spawn(client())
+
+    v1, t1 = run_world(world, 17)
+    v2, t2 = run_world(world, 17)
+    assert v1 == [b"ack:d0", b"ack:d1", b"ack:d2"]
+    assert (v1, t1) == (v2, t2)
