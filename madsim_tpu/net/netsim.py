@@ -54,6 +54,9 @@ class NetSim(Simulator):
         self.rand = GlobalRng(handle.seed, stream=STREAM_NET)
         self.network = Network(self.rand, handle.config.net)
         self.time = handle.time
+        # The executor, cached at construction: rand_delay suspends once
+        # per message, and the context-TLS lookup chain it replaces was a
+        # measurable slice of RPC-heavy worlds.
         self.executor = handle.task
 
     # -- Simulator hooks ---------------------------------------------------
@@ -105,7 +108,7 @@ class NetSim(Simulator):
         gone. The scheduling point and the RNG draw are unchanged."""
         delay_us = self.rand.gen_range(0, 5)
         self.time.advance(delay_us * 1000)
-        await context.current_handle().task.yield_now()
+        await self.executor.yield_now()
 
     async def send(self, node_id: int, port: int, dst: Addr, protocol: IpProtocol, msg) -> None:
         await self.rand_delay()

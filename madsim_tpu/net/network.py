@@ -15,7 +15,8 @@ from typing import Dict, List, Optional, Set, Tuple
 from ..core.config import NetConfig
 from ..core.rng import GlobalRng, loss_threshold
 from ..core.timewheel import to_ns
-from .addr import Addr, format_addr, ip_is_loopback, ip_is_unspecified
+from .addr import (Addr, format_addr, ip_is_loopback,
+                   ip_is_unspecified, unspecified_for)
 
 logger = logging.getLogger("madsim_tpu.net")
 
@@ -221,8 +222,6 @@ class Network:
         if latency is None:
             return None
         sockets = self.nodes[dst_node].sockets
-        from .addr import unspecified_for
-
         socket = sockets.get((dst, protocol))
         if socket is None:
             socket = sockets.get(((unspecified_for(dst[0]), dst[1]), protocol))
