@@ -466,3 +466,51 @@ def test_create_datagram_endpoint_udp_roundtrip():
     v2, t2 = run_world(world, 17)
     assert v1 == [b"ack:d0", b"ack:d1", b"ack:d2"]
     assert (v1, t1) == (v2, t2)
+
+
+def test_stdlib_asyncio_streams_over_sim_loop():
+    """`asyncio.open_connection` / `start_server` — the StreamReader/
+    StreamWriter API most libraries reach for — runs over the sim loop
+    with no special casing: the stdlib's StreamReaderProtocol machinery
+    sits on create_connection/create_server + create_future/call_soon,
+    all of which the SimEventLoop provides. Deterministic across runs."""
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def srv():
+            async def on_client(reader, writer):
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    writer.write(b"echo:" + line)
+                    await writer.drain()
+                writer.close()
+
+            await asyncio.start_server(on_client, "10.0.0.1", 7000)
+            await vtime.sleep(1e6)
+
+        h.create_node(name="s", ip="10.0.0.1", init=srv)
+        c = h.create_node(name="c", ip="10.0.0.2")
+
+        async def client():
+            await vtime.sleep(0.2)
+            reader, writer = await asyncio.open_connection("10.0.0.1", 7000)
+            out = []
+            for i in range(3):
+                writer.write(f"m{i}\n".encode())
+                await writer.drain()
+                out.append(await reader.readline())
+            writer.close()
+            # Half-close from our side: the server loop reads EOF, echoes
+            # nothing more, and closes; our reader then sees EOF too.
+            assert await reader.read() == b""
+            return out
+
+        return await c.spawn(client())
+
+    v1, t1 = run_world(world, 23)
+    v2, t2 = run_world(world, 23)
+    assert v1 == [b"echo:m0\n", b"echo:m1\n", b"echo:m2\n"]
+    assert (v1, t1) == (v2, t2)
