@@ -164,6 +164,7 @@ class Executor:
         self._native_ready = getattr(lib, "run_ready", None)
         self._pending_sentinel = _PENDING
         self.running_thread: Optional[int] = None  # set for block_on's span
+        self._noop_waiting = False  # a bare-None yield is parked
 
     # ------------------------------------------------------------------
     # Node management
@@ -258,6 +259,28 @@ class Executor:
         self._yields.append(fut)
         return fut
 
+    def noop_yield(self) -> SimFuture:
+        """yield_now for a bare-None yield from third-party code. Marked so
+        the drain path also fires due timers and enforces the time limit:
+        a loop spin-waiting on bare yields for a timer-driven event would
+        otherwise keep run_all_ready alive forever and starve the timer
+        heap. Framework yield_now users never spin-wait, so their
+        trajectories are untouched."""
+        self._noop_waiting = True
+        return self.yield_now()
+
+    def _after_noop_drain(self) -> None:
+        """Run when parked bare-None yields were just resolved: deliver any
+        timers the spinning polls advanced past (BridgeTime's heap is
+        device-resident and empty here — a safe no-op), and enforce the
+        time limit, which _block_on alone could never reach mid-spin."""
+        self._noop_waiting = False
+        self.time._fire_due()
+        if self.time_limit_ns is not None and \
+                self.time.elapsed_ns >= self.time_limit_ns:
+            self._uncaught = TimeLimitExceeded(
+                f"time limit ({self.time_limit_ns / 1e9}s) exceeded")
+
     # ------------------------------------------------------------------
     # The hot loop (`task.rs:121-180`)
     # ------------------------------------------------------------------
@@ -319,6 +342,8 @@ class Executor:
                 yields, self._yields = self._yields, []
                 for fut in yields:
                     fut.set_result(None)
+                if self._noop_waiting:
+                    self._after_noop_drain()
                 continue
             # Seeded uniform pick + swap-remove: the randomized interleaving.
             idx = self.rng.gen_range(0, len(self.queue))
@@ -370,8 +395,17 @@ class Executor:
             self._uncaught = exc
         else:
             if not isinstance(yielded, SimFuture):
-                self._foreign_yield(task, yielded)
-                return
+                if yielded is None:
+                    # Stdlib Task semantics: a bare None yield means
+                    # "resume me on the next loop iteration" (asyncio
+                    # reschedules via call_soon). The sim analog is
+                    # yield_now's scheduling point — this is how
+                    # hand-rolled awaitables like aiohttp's helpers.noop
+                    # suspend.
+                    yielded = self.noop_yield()
+                else:
+                    self._foreign_yield(task, yielded)
+                    return
             epoch = task.wake_epoch
             yielded.add_done_callback(
                 lambda _fut, t=task, e=epoch:

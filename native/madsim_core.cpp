@@ -377,7 +377,8 @@ static PyObject *s_queue, *s_yields, *s_uncaught, *s_scheduled, *s_finished,
     *s_pending_exc, *s_coro, *s_send, *s_throw, *s_drop, *s_set_result,
     *s_set_exception, *s_wake_epoch, *s_result, *s_exception, *s_callbacks,
     *s_join_future, *s_tasks, *s_elapsed_ns, *s_poll_count, *s_time,
-    *s_foreign_yield, *s_value;
+    *s_foreign_yield, *s_value, *s_yield_now, *s_noop_waiting,
+    *s_after_noop;
 
 // TaskWaker: the C twin of the per-await closure
 //   lambda _fut, t=task, e=epoch: self._wake(t) if t.wake_epoch == e else None
@@ -537,6 +538,15 @@ static PyObject* py_run_ready(PyObject*, PyObject* args) {
         if (!r) failed = 1; else Py_DECREF(r);
       }
       Py_DECREF(ylist);
+      if (!failed) {
+        int noop = attr_true(ex, s_noop_waiting);
+        if (noop < 0) failed = 1;
+        else if (noop) {
+          PyObject* r =
+              PyObject_CallMethodObjArgs(ex, s_after_noop, nullptr);
+          if (!r) failed = 1; else Py_DECREF(r);
+        }
+      }
       if (failed) break;
       continue;
     }
@@ -619,6 +629,15 @@ static PyObject* py_run_ready(PyObject*, PyObject* args) {
         yielded = PyObject_CallMethodObjArgs(coro, s_send, Py_None, nullptr);
       }
       Py_DECREF(coro);
+    }
+
+    if (yielded == Py_None) {
+      // Stdlib Task semantics: a bare None yield = "resume next loop
+      // iteration" (aiohttp's helpers.noop and friends). Swap in the
+      // executor's yield_now future and fall through to the normal
+      // SimFuture attach below.
+      Py_DECREF(yielded);
+      yielded = PyObject_CallMethodObjArgs(ex, s_yield_now, nullptr);
     }
 
     if (!yielded) {
@@ -790,7 +809,8 @@ PyMODINIT_FUNC PyInit__core(void) {
       {&s_join_future, "join_future"}, {&s_tasks, "tasks"},
       {&s_elapsed_ns, "elapsed_ns"}, {&s_poll_count, "poll_count"},
       {&s_time, "time"}, {&s_foreign_yield, "_foreign_yield"},
-      {&s_value, "value"},
+      {&s_value, "value"}, {&s_yield_now, "noop_yield"},
+      {&s_noop_waiting, "_noop_waiting"}, {&s_after_noop, "_after_noop_drain"},
   };
   for (auto& e : names) {
     *e.slot = PyUnicode_InternFromString(e.name);

@@ -514,3 +514,130 @@ def test_stdlib_asyncio_streams_over_sim_loop():
     v2, t2 = run_world(world, 23)
     assert v1 == [b"echo:m0\n", b"echo:m1\n", b"echo:m2\n"]
     assert (v1, t1) == (v2, t2)
+
+
+def test_bare_none_yield_reschedules_like_stdlib_task():
+    """Hand-rolled awaitables that do a bare ``yield`` (aiohttp's
+    helpers.noop, stdlib __sleep0-style) mean "resume me next loop turn"
+    under asyncio's Task; the sim maps that to the yield_now scheduling
+    point — on both the native and Python poll loops."""
+
+    class BareYield:
+        def __await__(self):
+            yield
+
+    async def world():
+        order = []
+
+        async def other():
+            order.append("other")
+
+        from madsim_tpu import task as mtask
+
+        mtask.spawn(other())
+        await BareYield()  # suspends exactly one scheduling turn
+        order.append("me")
+        return order
+
+    for force_python in (False, True):
+        rt = ms.Runtime(seed=2)
+        if force_python:
+            rt.task._native_ready = None
+        assert rt.block_on(world()) == ["other", "me"]
+
+
+def test_aiohttp_websocket_heartbeats_on_virtual_time():
+    """aiohttp's own websocket layer with 1 s heartbeats: pings, pongs,
+    and the pong-timeout timers all ride virtual time across a 5 s quiet
+    window (both peers idling in their receive loops, the realistic ws
+    shape — pong processing lives in receive(), same as real asyncio)."""
+
+    async def world():
+        h = ms.Handle.current()
+
+        async def srv():
+            async def ws_handler(request):
+                ws = web.WebSocketResponse(heartbeat=1.0)
+                await ws.prepare(request)
+
+                async def pusher():
+                    await ws.send_str("hello")
+                    await vtime.sleep(5.0)
+                    await ws.send_str("still-here")
+
+                task = asyncio.create_task(pusher())
+                async for _msg in ws:
+                    pass
+                task.cancel()
+                return ws
+
+            app = web.Application()
+            app.router.add_get("/ws", ws_handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.TCPSite(runner, "10.0.0.1", 80).start()
+            await vtime.sleep(1e6)
+
+        h.create_node(name="s", ip="10.0.0.1", init=srv)
+        c = h.create_node(name="c", ip="10.0.0.2")
+
+        async def client():
+            await vtime.sleep(0.2)
+            out = []
+            async with aiohttp.ClientSession() as sess:
+                async with sess.ws_connect("http://10.0.0.1/ws",
+                                           heartbeat=1.0) as ws:
+                    out.append((await ws.receive()).data)
+                    out.append((await ws.receive()).data)
+            return out
+
+        return await c.spawn(client())
+
+    v1, t1 = run_world(world, 13)
+    v2, t2 = run_world(world, 13)
+    assert v1 == ["hello", "still-here"]
+    assert (v1, t1) == (v2, t2)
+
+
+def test_bare_yield_spinner_cannot_starve_timers_or_time_limit():
+    """A loop spin-waiting on bare yields for a timer-driven event must see
+    the timer fire (the drain path delivers due timers), and a spinner with
+    no timers must still hit the time limit instead of hanging — on both
+    poll loops."""
+
+    class BareYield:
+        def __await__(self):
+            yield
+
+    async def timer_world():
+        from madsim_tpu import task as mtask
+
+        fired = []
+        ms.Handle.current().time.add_timer(1_000_000,  # 1 ms
+                                           lambda: fired.append(True))
+        spins = 0
+        while not fired:
+            await BareYield()
+            spins += 1
+            assert spins < 200_000, "timer starved by yield spinning"
+        return spins
+
+    async def endless_spinner():
+        while True:
+            await BareYield()
+
+    for force_python in (False, True):
+        rt = ms.Runtime(seed=3)
+        if force_python:
+            rt.task._native_ready = None
+        spins = rt.block_on(timer_world())
+        assert spins > 1000  # virtual time advanced by poll jitter to 1 ms
+
+        rt = ms.Runtime(seed=3)
+        if force_python:
+            rt.task._native_ready = None
+        rt.set_time_limit(0.01)
+        from madsim_tpu.core.task import TimeLimitExceeded
+
+        with pytest.raises(TimeLimitExceeded):
+            rt.block_on(endless_spinner())
