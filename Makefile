@@ -8,10 +8,17 @@ PY ?= python
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check test smoke dryrun determinism dualmode native clean
+.PHONY: check lint test smoke dryrun determinism dualmode native clean
 
-check: test smoke dryrun determinism
+check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
+
+# detlint static gate: nondeterminism escapes (DET*) + sim/real API parity
+# (PAR*). Zero findings required; intentional sites are covered by
+# detlint-allow.txt and inline `detlint: allow[RULE]` pragmas. See
+# docs/detlint.md for the rule catalog.
+lint:
+	$(PY) -m madsim_tpu.analysis madsim_tpu tools
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -29,6 +36,10 @@ smoke:
 	     and ({'error', 'dev_error', 'host_error'} & set(v))}; \
 	assert not bad, f'configs failed: {bad}'; \
 	print('smoke ok:', d['value'], d['unit'])"
+	@$(PY) -c "import json; d=json.load(open('bench_results.json')); \
+	missing={'metric','value','unit','vs_baseline','configs'}-set(d); \
+	assert not missing, f'bench_results.json missing {missing}'; \
+	print('bench_results.json ok:', d['metric'])"
 
 dryrun:
 	$(PY) -c "from __graft_entry__ import dryrun_multichip, entry; \

@@ -1030,7 +1030,7 @@ def main() -> None:
         else:
             configs[key] = _run_config_subprocess(args, short, key)
 
-    print(json.dumps({
+    result = {
         "metric": "madraft_3node_1s_seeds_per_sec",
         "value": round(dev_rate, 2) if dev_rate else None,
         "unit": "seeds/s",
@@ -1048,7 +1048,22 @@ def main() -> None:
                          "native C++ core), single-seed; see "
                          "configs.host_engine for events/s and us/event",
         "configs": configs,
-    }), flush=True)
+    }
+    # The durable record FIRST (VERDICT r5: two rounds lost their headline
+    # numbers to truncated stdout tails) — `make smoke` asserts this file
+    # parses and carries the headline keys. Written atomically so a killed
+    # run can't leave a half-written JSON shadowing the previous record.
+    import os
+    import tempfile
+
+    out_path = os.environ.get("MADSIM_BENCH_RESULTS", "bench_results.json")
+    fd, tmp_path = tempfile.mkstemp(
+        dir=os.path.dirname(out_path) or ".", suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    os.replace(tmp_path, out_path)
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,30 @@
+"""detlint — static determinism analysis for madsim_tpu programs.
+
+Two passes (docs/detlint.md):
+
+1. **Nondeterminism-escape detection** (:mod:`.escape`): AST scan for
+   calls that bypass the sim's interception layer — wall clock, ambient
+   entropy, real threads, host introspection, raw sockets, identity-keyed
+   ordering. The static twin of the dynamic RNG log/replay checker
+   (tools/determinism_sweep.py): the sweep proves the seeds it ran were
+   deterministic; the lint proves the code *cannot* escape, including
+   paths no seed exercised.
+2. **Sim/real API parity** (:mod:`.parity`): the dual-tree convention
+   (``net``/``fs`` vs ``real/``, inline ``is_real()`` dispatch in
+   ``time``) enforced as signatures, so one program keeps compiling
+   against both backends — the reference's ``--cfg madsim`` contract.
+
+CLI: ``python -m madsim_tpu.analysis`` (or ``tools/detlint.py``);
+``make lint`` is the repo gate. Suppression: ``# detlint: allow[RULE]``
+pragmas (stale ones are errors) + the checked-in ``detlint-allow.txt``.
+"""
+from .cli import main, run_lint
+from .escape import run_escape_pass, scan_source
+from .parity import run_parity_pass
+from .pragmas import Allowlist, Finding
+from .rules import RULES, Rule
+
+__all__ = [
+    "main", "run_lint", "run_escape_pass", "run_parity_pass", "scan_source",
+    "Allowlist", "Finding", "RULES", "Rule",
+]

@@ -1,0 +1,99 @@
+"""detlint CLI: ``python -m madsim_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/config error — the Makefile/CI
+gate is just the exit code.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .escape import run_escape_pass
+from .parity import run_parity_pass
+from .pragmas import Allowlist, Finding
+from .rules import RULES
+
+DEFAULT_ALLOWLIST = "detlint-allow.txt"
+DEFAULT_PATHS = ["madsim_tpu", "tools"]
+
+
+def run_lint(root: str, paths: List[str],
+             allowlist: Optional[Allowlist] = None,
+             escape: bool = True, parity: bool = True) -> List[Finding]:
+    """Both passes over ``paths`` under ``root``; the library entry tests
+    and embedders use (the CLI is a thin shell over this)."""
+    allowlist = allowlist or Allowlist.empty()
+    findings: List[Finding] = []
+    if escape:
+        findings.extend(run_escape_pass(root, paths, allowlist))
+    if parity:
+        findings.extend(allowlist.filter(run_parity_pass(root)))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="detlint",
+        description="madsim_tpu static analyzer: nondeterminism escapes "
+                    "(pass 1) + sim/real API parity (pass 2)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=".",
+                    help="tree root paths are relative to (default: cwd)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist file (default: <root>/detlint-allow.txt "
+                         "when present)")
+    ap.add_argument("--no-parity", action="store_true",
+                    help="skip pass 2 (sim/real parity)")
+    ap.add_argument("--no-escape", action="store_true",
+                    help="skip pass 1 (nondeterminism escapes)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.code}  {rule.title}\n        fix: {rule.suggestion}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"detlint: root {args.root!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    paths = args.paths or [p for p in DEFAULT_PATHS
+                           if os.path.exists(os.path.join(root, p))]
+    if not paths:
+        print(f"detlint: nothing to scan under {root!r} "
+              f"(no paths given and none of {DEFAULT_PATHS} exist)",
+              file=sys.stderr)
+        return 2
+    for p in paths:
+        if not os.path.exists(os.path.join(root, p)):
+            print(f"detlint: no such path under root: {p}", file=sys.stderr)
+            return 2
+
+    allowlist = Allowlist.empty()
+    allow_path = args.allowlist or os.path.join(root, DEFAULT_ALLOWLIST)
+    if os.path.isfile(allow_path):
+        allowlist = Allowlist.load(allow_path)
+    elif args.allowlist is not None:
+        print(f"detlint: allowlist not found: {args.allowlist}",
+              file=sys.stderr)
+        return 2
+
+    findings = run_lint(root, paths, allowlist,
+                        escape=not args.no_escape, parity=not args.no_parity)
+    if args.json:
+        print(json.dumps([f._asdict() for f in findings]))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"detlint: {n} finding{'s' if n != 1 else ''}"
+              if n else "detlint: clean", file=sys.stderr)
+    return 1 if findings else 0

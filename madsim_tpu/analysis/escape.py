@@ -1,0 +1,183 @@
+"""Pass 1 — nondeterminism-escape detection.
+
+An AST walk over every module in the target tree, flagging calls that
+bypass the sim's interception layer (rules.py tables). The walk resolves
+import aliases first (``import time as _walltime`` must not hide
+``_walltime.time()``), then matches call sites:
+
+- fully-qualified names against ``EXACT_CALLS`` / ``PREFIX_CALLS``
+  (``time.time``, ``os.urandom``, ``secrets.*``, ...),
+- bare method names against ``ATTR_CALLS`` for receivers with no static
+  type (``loop.run_in_executor``),
+- ``sorted``/``min``/``max``/``.sort`` whose key is ``id``/``hash`` —
+  identity-keyed ordering of task or node collections varies with the
+  process's allocation history, not the seed (DET006).
+
+Scanning whole files over-approximates "reachable from @ms.test/@ms.main
+bodies": it is sound (no reachable escape is missed) at the price of also
+linting never-imported code, which is what a framework lint wants — users'
+sim code that CI never executes is exactly the code dynamic checking
+(tools/determinism_sweep.py) cannot protect.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional
+
+from .pragmas import Allowlist, Finding, apply_pragmas, extract_pragmas
+from .rules import ATTR_CALLS, EXACT_CALLS, PREFIX_CALLS, RULES
+
+_SORT_BUILTINS = {"sorted", "min", "max"}
+
+
+class _ImportTable(ast.NodeVisitor):
+    """alias -> fully-qualified dotted target, collected module-wide."""
+
+    def __init__(self):
+        self.names: Dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            # `import a.b` binds `a`; `import a.b as c` binds c -> a.b.
+            self.names[bound] = alias.name if alias.asname else alias.name.split(".")[0]
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level or node.module is None:
+            return  # relative imports stay in-package: never an stdlib escape
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name
+            self.names[bound] = f"{node.module}.{alias.name}"
+
+
+def _dotted(node: ast.expr) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _is_identity_key(expr: ast.expr) -> bool:
+    """key=id / key=hash, or a lambda whose body is id(...)/hash(...)."""
+    if isinstance(expr, ast.Name) and expr.id in ("id", "hash"):
+        return True
+    if isinstance(expr, ast.Lambda):
+        body = expr.body
+        return (isinstance(body, ast.Call) and isinstance(body.func, ast.Name)
+                and body.func.id in ("id", "hash"))
+    return False
+
+
+class _CallScanner(ast.NodeVisitor):
+    def __init__(self, path: str, imports: Dict[str, str]):
+        self.path = path
+        self.imports = imports
+        self.findings: List[Finding] = []
+
+    def _flag(self, node: ast.AST, rule: str, what: str) -> None:
+        r = RULES[rule]
+        self.findings.append(Finding(
+            self.path, node.lineno, rule,
+            f"{r.title}: `{what}` — {r.suggestion}"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_call(node)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        # Identity-keyed ordering (DET006).
+        func = node.func
+        is_sort_method = isinstance(func, ast.Attribute) and func.attr == "sort"
+        is_sort_builtin = isinstance(func, ast.Name) and func.id in _SORT_BUILTINS
+        if is_sort_method or is_sort_builtin:
+            for kw in node.keywords:
+                if kw.arg == "key" and _is_identity_key(kw.value):
+                    name = func.attr if is_sort_method else func.id
+                    self._flag(node, "DET006", f"{name}(key=id/hash)")
+                    return
+
+        parts = _dotted(func)
+        if parts is None:
+            return
+        head = parts[0]
+        resolved = self.imports.get(head)
+        if resolved is not None:
+            full = ".".join([resolved] + parts[1:])
+        elif len(parts) > 1:
+            full = ".".join(parts)
+        else:
+            full = None
+        if full is not None:
+            rule = EXACT_CALLS.get(full)
+            if rule is None:
+                for prefix, prule in PREFIX_CALLS.items():
+                    if full.startswith(prefix) or full == prefix[:-1]:
+                        rule = prule
+                        break
+            if rule is not None and (resolved is not None or _looks_stdlib(parts[0])):
+                self._flag(node, rule, f"{full}()")
+                return
+        # Method-name-only table: receivers with no static type.
+        if isinstance(func, ast.Attribute) and func.attr in ATTR_CALLS:
+            self._flag(node, ATTR_CALLS[func.attr], f".{func.attr}()")
+
+
+def _looks_stdlib(head: str) -> bool:
+    """Unimported dotted heads still worth matching: the modules our call
+    tables cover (handles the common `import x` collected at module top —
+    already in the table — and guards against flagging `self.time()` etc.,
+    whose head is a local object, not a module)."""
+    return head in ("time", "os", "random", "uuid", "secrets", "socket",
+                    "threading", "multiprocessing", "datetime", "concurrent")
+
+
+def scan_source(source: str, path: str) -> List[Finding]:
+    """Lint one module's source; returns post-pragma findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 1, "DET000",
+                        f"syntax error: {exc.msg}")]
+    table = _ImportTable()
+    table.visit(tree)
+    scanner = _CallScanner(path, table.names)
+    scanner.visit(tree)
+    return apply_pragmas(scanner.findings, extract_pragmas(source), path)
+
+
+def iter_py_files(root: str, paths: List[str]) -> List[str]:
+    """Expand files/directories under ``root`` into a sorted .py file list
+    of root-relative paths."""
+    out: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__" and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def run_escape_pass(root: str, paths: List[str],
+                    allowlist: Optional[Allowlist] = None) -> List[Finding]:
+    allowlist = allowlist or Allowlist.empty()
+    findings: List[Finding] = []
+    for rel in iter_py_files(root, paths):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(scan_source(source, rel))
+    return allowlist.filter(findings)

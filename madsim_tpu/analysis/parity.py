@@ -1,0 +1,255 @@
+"""Pass 2 — sim/real API-parity check.
+
+The reference's contract is that one program compiles against both trees
+(``--cfg madsim`` swaps the whole crate surface, `madsim-tokio/src/lib.rs`).
+This repo's twin convention is ``madsim_tpu/{net,fs}`` vs
+``madsim_tpu/real/``, plus modules whose real backend is an inline
+``is_real()`` branch (``time.py``). Both are conventions until something
+enforces them; this pass turns them into invariants:
+
+- ``TWIN_CLASSES`` / ``TWIN_FUNCTIONS``: the public signatures (member
+  names, parameter names, defaults, async-ness) of each sim type must
+  equal its real twin's, both directions — a method added to one tree
+  only is drift (PAR001), because code written against it deadlocks or
+  AttributeErrors on the other backend.
+- ``DISPATCH_MODULES``: every public module function must reach an
+  ``is_real()`` dispatch (directly or through calls to module-local
+  helpers/classes), so it *has* a real behavior at all (PAR002).
+
+Everything is pure AST — the check needs no imports, so it runs against a
+copied/patched tree (the drift-injection test) as easily as the repo.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+from .pragmas import Finding
+
+# (sim file, sim class, real file, real class) — root-relative paths.
+TWIN_CLASSES: Sequence[Tuple[str, str, str, str]] = (
+    ("madsim_tpu/net/endpoint.py", "Endpoint",
+     "madsim_tpu/real/net.py", "RealEndpoint"),
+    ("madsim_tpu/net/tcp.py", "TcpListener",
+     "madsim_tpu/real/tcp.py", "RealTcpListener"),
+    ("madsim_tpu/net/tcp.py", "TcpStream",
+     "madsim_tpu/real/tcp.py", "RealTcpStream"),
+    ("madsim_tpu/net/netsim.py", "ChannelSender",
+     "madsim_tpu/real/net.py", "RealChannelSender"),
+    ("madsim_tpu/net/netsim.py", "ChannelReceiver",
+     "madsim_tpu/real/net.py", "RealChannelReceiver"),
+    ("madsim_tpu/fs.py", "File", "madsim_tpu/real/fs.py", "RealFile"),
+    ("madsim_tpu/fs.py", "Metadata", "madsim_tpu/real/fs.py", "Metadata"),
+)
+
+# (sim file, function names, real file) — module-level twins.
+TWIN_FUNCTIONS: Sequence[Tuple[str, Sequence[str], str]] = (
+    ("madsim_tpu/fs.py", ("read", "write", "metadata", "remove_file"),
+     "madsim_tpu/real/fs.py"),
+)
+
+# Modules whose real backend is inline: every __all__ function must reach
+# is_real() through the module-local call graph.
+DISPATCH_MODULES: Sequence[str] = ("madsim_tpu/time.py",)
+
+# Context-manager dunders are part of the usable surface; other dunders
+# (__del__, __init__, __await__) are implementation detail.
+_SURFACE_DUNDERS = {"__enter__", "__exit__", "__aenter__", "__aexit__"}
+
+
+class Signature(NamedTuple):
+    is_async: bool
+    params: Tuple[str, ...]     # positional + kw-only names, self/cls stripped
+    n_defaults: int
+    has_vararg: bool
+    has_kwarg: bool
+    line: int
+
+    def describe(self) -> str:
+        kind = "async def" if self.is_async else "def"
+        return f"{kind}({', '.join(self.params)})"
+
+
+def _signature(fn) -> Signature:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    names += [p.arg for p in a.kwonlyargs]
+    n_defaults = len(a.defaults) + sum(d is not None for d in a.kw_defaults)
+    return Signature(isinstance(fn, ast.AsyncFunctionDef), tuple(names),
+                     n_defaults, a.vararg is not None, a.kwarg is not None,
+                     fn.lineno)
+
+
+def _is_public(name: str) -> bool:
+    return not name.startswith("_") or name in _SURFACE_DUNDERS
+
+
+def _parse(root: str, rel: str) -> Optional[ast.Module]:
+    full = os.path.join(root, rel)
+    if not os.path.isfile(full):
+        return None
+    with open(full, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=rel)
+
+
+def _class_api(tree: ast.Module, cls_name: str) -> Optional[Dict[str, Signature]]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            return {
+                item.name: _signature(item)
+                for item in node.body
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and _is_public(item.name)
+            }
+    return None
+
+
+def _module_api(tree: ast.Module, names: Sequence[str]) -> Dict[str, Signature]:
+    return {
+        node.name: _signature(node)
+        for node in tree.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in names
+    }
+
+
+def _diff_member(findings: List[Finding], path: str, owner: str, name: str,
+                 sim: Signature, real: Signature, real_path: str) -> None:
+    if sim.is_async != real.is_async:
+        findings.append(Finding(
+            path, sim.line, "PAR001",
+            f"{owner}.{name} async-ness differs: sim is "
+            f"{sim.describe()}, real ({real_path}) is {real.describe()}"))
+        return
+    if (sim.params != real.params or sim.n_defaults != real.n_defaults
+            or sim.has_vararg != real.has_vararg
+            or sim.has_kwarg != real.has_kwarg):
+        findings.append(Finding(
+            path, sim.line, "PAR001",
+            f"{owner}.{name} signature differs: sim {sim.describe()} vs "
+            f"real {real.describe()} ({real_path})"))
+
+
+def _diff_apis(findings: List[Finding], owner: str,
+               sim_path: str, sim_api: Dict[str, Signature],
+               real_path: str, real_api: Dict[str, Signature]) -> None:
+    for name, sim_sig in sorted(sim_api.items()):
+        real_sig = real_api.get(name)
+        if real_sig is None:
+            findings.append(Finding(
+                sim_path, sim_sig.line, "PAR001",
+                f"{owner}.{name} exists in sim but not in the real twin "
+                f"({real_path}) — real-backend code would AttributeError"))
+        else:
+            _diff_member(findings, sim_path, owner, name, sim_sig, real_sig,
+                         real_path)
+    for name, real_sig in sorted(real_api.items()):
+        if name not in sim_api:
+            findings.append(Finding(
+                real_path, real_sig.line, "PAR001",
+                f"{owner}.{name} exists in the real twin but not in sim "
+                f"({sim_path}) — sim-tested code cannot cover it"))
+
+
+def _all_names(tree: ast.Module) -> List[str]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        return [e.value for e in node.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)]
+    return []
+
+
+def _check_dispatch(findings: List[Finding], path: str,
+                    tree: ast.Module) -> None:
+    """PAR002: each __all__ function must reach an is_real() branch via the
+    module-local call graph (classes count through their methods)."""
+    funcs: Dict[str, ast.AST] = {}
+    classes: Dict[str, List[ast.AST]] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = [
+                item for item in node.body
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def direct(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == "is_real"
+                   for n in ast.walk(node))
+
+    def callees(node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                out.add(n.func.id)
+        return out
+
+    aware: Set[str] = set()
+    for name, node in funcs.items():
+        if direct(node):
+            aware.add(name)
+    for name, methods in classes.items():
+        if any(direct(m) for m in methods):
+            aware.add(name)
+    changed = True
+    while changed:
+        changed = False
+        for name, node in list(funcs.items()):
+            if name not in aware and callees(node) & aware:
+                aware.add(name)
+                changed = True
+        for name, methods in classes.items():
+            if name not in aware and any(callees(m) & aware for m in methods):
+                aware.add(name)
+                changed = True
+
+    for name in _all_names(tree):
+        node = funcs.get(name)
+        if node is not None and name not in aware:
+            findings.append(Finding(
+                path, node.lineno, "PAR002",
+                f"public function {name}() never reaches an is_real() "
+                f"dispatch — it has no real-backend behavior"))
+
+
+def run_parity_pass(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sim_path, sim_cls, real_path, real_cls in TWIN_CLASSES:
+        sim_tree = _parse(root, sim_path)
+        if sim_tree is None:
+            continue  # target tree doesn't carry this module (fixture scans)
+        real_tree = _parse(root, real_path)
+        sim_api = _class_api(sim_tree, sim_cls)
+        if sim_api is None:
+            findings.append(Finding(sim_path, 1, "PAR001",
+                                    f"class {sim_cls} not found"))
+            continue
+        real_api = _class_api(real_tree, real_cls) if real_tree else None
+        if real_api is None:
+            findings.append(Finding(
+                sim_path, 1, "PAR001",
+                f"{sim_cls}: real twin class {real_cls} missing from "
+                f"{real_path}"))
+            continue
+        _diff_apis(findings, sim_cls, sim_path, sim_api, real_path, real_api)
+    for sim_path, names, real_path in TWIN_FUNCTIONS:
+        sim_tree = _parse(root, sim_path)
+        real_tree = _parse(root, real_path)
+        if sim_tree is None or real_tree is None:
+            continue
+        _diff_apis(findings, os.path.basename(sim_path)[:-3], sim_path,
+                   _module_api(sim_tree, names), real_path,
+                   _module_api(real_tree, names))
+    for path in DISPATCH_MODULES:
+        tree = _parse(root, path)
+        if tree is not None:
+            _check_dispatch(findings, path, tree)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

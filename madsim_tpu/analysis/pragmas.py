@@ -1,0 +1,123 @@
+"""Finding suppression: inline pragmas + the checked-in allowlist.
+
+Two escape hatches, for two shapes of intent:
+
+- ``# detlint: allow[DET001]`` on (or immediately above) the offending line
+  — for a *single deliberate site* (e.g. ``testing.py``'s wall-clock default
+  seed). A pragma that suppresses nothing is itself an error (DET900), so
+  allow-comments cannot rot in place after the code they excused changes.
+- an allowlist file (default ``detlint-allow.txt`` at the scan root) with
+  ``path-prefix[:RULE]`` lines — for *whole intentional trees* (all of
+  ``madsim_tpu/real/`` IS the nondeterministic backend; flagging it would
+  be flagging the design).
+"""
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+
+class Finding(NamedTuple):
+    path: str       # scan-root-relative, '/' separators
+    line: int       # 1-based
+    rule: str       # e.g. "DET001"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_PRAGMA_RE = re.compile(r"#\s*detlint:\s*allow\[([A-Za-z0-9_,\s]+)\]")
+
+
+def extract_pragmas(source: str) -> Dict[int, Tuple[int, Set[str]]]:
+    """Map *effective* line -> (pragma line, allowed rule codes).
+
+    Tokenized, not line-grepped: only real COMMENT tokens count, so a
+    pragma example quoted inside a docstring is documentation, not a
+    suppression. A pragma trailing code covers its own line; a pragma on
+    a comment-only line covers the next line (the decorator-friendly
+    form).
+    """
+    out: Dict[int, Tuple[int, Set[str]]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m is None:
+                continue
+            codes = {c.strip().upper()
+                     for c in m.group(1).split(",") if c.strip()}
+            line = tok.start[0]
+            comment_only = tok.line[:tok.start[1]].strip() == ""
+            target = line + 1 if comment_only else line
+            prev_line, prev_codes = out.get(target, (line, set()))
+            out[target] = (prev_line, prev_codes | codes)
+    except (tokenize.TokenError, IndentationError):
+        pass  # unparseable source surfaces as DET000 from the AST pass
+    return out
+
+
+def apply_pragmas(findings: List[Finding],
+                  pragmas: Dict[int, Tuple[int, Set[str]]],
+                  path: str) -> List[Finding]:
+    """Drop findings covered by a pragma; emit DET900 for unused codes."""
+    used: Dict[Tuple[int, str], bool] = {}
+    for line, (_pline, codes) in pragmas.items():
+        for code in codes:
+            used[(line, code)] = False
+    kept: List[Finding] = []
+    for f in findings:
+        entry = pragmas.get(f.line)
+        if entry is not None and f.rule in entry[1]:
+            used[(f.line, f.rule)] = True
+            continue
+        kept.append(f)
+    for line, (pline, codes) in sorted(pragmas.items()):
+        for code in sorted(codes):
+            if not used.get((line, code), False):
+                kept.append(Finding(
+                    path, pline, "DET900",
+                    f"pragma allows {code} but line {line} has no {code} "
+                    f"finding — delete the stale pragma"))
+    kept.sort(key=lambda f: (f.line, f.rule))
+    return kept
+
+
+class Allowlist:
+    """``path-prefix[:RULE]`` entries; '#' starts a comment."""
+
+    def __init__(self, entries: List[Tuple[str, Optional[str]]]):
+        self._entries = entries
+
+    @classmethod
+    def parse(cls, text: str) -> "Allowlist":
+        entries: List[Tuple[str, Optional[str]]] = []
+        for raw in text.splitlines():
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            prefix, _, rule = line.partition(":")
+            entries.append((prefix.strip(), rule.strip().upper() or None))
+        return cls(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        with open(path, encoding="utf-8") as f:
+            return cls.parse(f.read())
+
+    @classmethod
+    def empty(cls) -> "Allowlist":
+        return cls([])
+
+    def allows(self, finding: Finding) -> bool:
+        return any(
+            finding.path.startswith(prefix)
+            and (rule is None or rule == finding.rule)
+            for prefix, rule in self._entries)
+
+    def filter(self, findings: List[Finding]) -> List[Finding]:
+        return [f for f in findings if not self.allows(f)]

@@ -1,0 +1,109 @@
+"""detlint rule catalog.
+
+Every rule names a class of *determinism escape*: a call that reaches the
+host OS (clock, entropy, scheduler, NIC) without going through the sim's
+interception layer, so the same seed can produce different trajectories.
+The catalog is the static twin of the dynamic interception table in
+:mod:`madsim_tpu.shims.aio` (``install()``'s patch list) — anything that
+table patches at runtime, this table flags at lint time, because code paths
+the sweep never executes are exactly where escapes hide (the ahead-of-time
+argument of PRISM-style modeling vs observed-run sampling, PAPERS.md).
+
+``PAR`` rules belong to pass 2 (sim/real API parity); ``DET9xx`` codes are
+lint-hygiene errors (stale pragmas), so an allow-comment can never silently
+rot into a blanket waiver.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+
+class Rule(NamedTuple):
+    code: str
+    title: str
+    suggestion: str
+
+
+RULES: Dict[str, Rule] = {r.code: r for r in [
+    Rule("DET001", "wall-clock read escapes virtual time",
+         "use madsim_tpu.time (system_time/monotonic/sleep) — virtual, seeded"),
+    Rule("DET002", "ambient entropy escapes the seeded RNG",
+         "use madsim_tpu.rand.thread_rng() (per-world, derived from the seed)"),
+    Rule("DET003", "real concurrency inside the single-threaded simulation",
+         "use madsim_tpu.task.spawn / spawn_blocking (deterministic tasks)"),
+    Rule("DET004", "host introspection used for sizing",
+         "use madsim_tpu.task.available_parallelism() (the node's cores)"),
+    Rule("DET005", "raw socket bypasses the simulated network",
+         "use madsim_tpu.net (Endpoint/TcpStream) or the eventloop shim"),
+    Rule("DET006", "id()/hash()-keyed ordering depends on allocation history",
+         "sort by a stable field (node id, tag, name), never object identity"),
+    Rule("DET900", "stale pragma: allow[...] names a rule with no finding",
+         "delete the pragma (or the code that made it necessary came back)"),
+    Rule("PAR001", "sim/real API parity drift",
+         "mirror the signature in both trees — the same program must compile "
+         "against either backend"),
+    Rule("PAR002", "public sim API without a real-backend dispatch",
+         "branch on core.backend.is_real() (directly or via a helper) so the "
+         "function works outside the simulation too"),
+]}
+
+
+# -- pass-1 call tables ------------------------------------------------------
+# Fully-qualified call name (after import-alias resolution) -> rule code.
+
+_RANDOM_GLOBALS = (
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "uniform", "getrandbits", "sample", "randbytes", "gauss", "betavariate",
+    "expovariate", "normalvariate", "triangular", "vonmisesvariate", "seed",
+)
+
+EXACT_CALLS: Dict[str, str] = {
+    # DET001 — wall clock
+    "time.time": "DET001",
+    "time.time_ns": "DET001",
+    "time.monotonic": "DET001",
+    "time.monotonic_ns": "DET001",
+    "time.perf_counter": "DET001",
+    "time.perf_counter_ns": "DET001",
+    "time.process_time": "DET001",
+    "time.sleep": "DET001",
+    "datetime.datetime.now": "DET001",
+    "datetime.datetime.utcnow": "DET001",
+    "datetime.datetime.today": "DET001",
+    "datetime.date.today": "DET001",
+    # DET002 — ambient entropy
+    "os.urandom": "DET002",
+    "os.getrandom": "DET002",
+    "uuid.uuid1": "DET002",
+    "uuid.uuid4": "DET002",
+    # DET003 — real concurrency
+    "threading.Thread": "DET003",
+    "threading.Timer": "DET003",
+    "concurrent.futures.ThreadPoolExecutor": "DET003",
+    "concurrent.futures.ProcessPoolExecutor": "DET003",
+    "multiprocessing.Process": "DET003",
+    "multiprocessing.Pool": "DET003",
+    # DET004 — host introspection used for sizing
+    "os.cpu_count": "DET004",
+    "os.process_cpu_count": "DET004",
+    "os.sched_getaffinity": "DET004",
+    "multiprocessing.cpu_count": "DET004",
+    # DET005 — raw sockets
+    "socket.socket": "DET005",
+    "socket.create_connection": "DET005",
+    "socket.socketpair": "DET005",
+    "socket.create_server": "DET005",
+}
+EXACT_CALLS.update({f"random.{fn}": "DET002" for fn in _RANDOM_GLOBALS})
+
+# Dotted-prefix matches (any call under the module escapes).
+PREFIX_CALLS: Dict[str, str] = {
+    "secrets.": "DET002",
+}
+
+# Attribute-name matches on an unresolvable receiver: `loop` in
+# `loop.run_in_executor(...)` has no static type, but the method name alone
+# identifies the escape (real threads behind the event loop).
+ATTR_CALLS: Dict[str, str] = {
+    "run_in_executor": "DET003",
+}

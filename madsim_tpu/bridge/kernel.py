@@ -210,6 +210,13 @@ class BridgeKernel:
         from ..ops.threefry import derive_stream_np
 
         self._jax = jax
+        # jax.enable_x64 moved to the top level after 0.4.x; reach the
+        # experimental home on older installs so the bridge runs on both.
+        self._enable_x64 = getattr(jax, "enable_x64", None)
+        if self._enable_x64 is None:
+            from jax.experimental import enable_x64 as _x64
+
+            self._enable_x64 = _x64
         self.W = len(seeds)
         self.cap = cap
         self.k_events = k_events
@@ -225,7 +232,7 @@ class BridgeKernel:
         k0 = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         k1 = (seeds >> np.uint64(32)).astype(np.uint32)
         nk0, nk1 = derive_stream_np(k0, k1, STREAM_NET)
-        with jax.default_device(self.device), jax.enable_x64():
+        with jax.default_device(self.device), self._enable_x64():
             self._net_k0 = jnp.asarray(np.atleast_1d(nk0))
             self._net_k1 = jnp.asarray(np.atleast_1d(nk1))
             self.state = BridgeState(
@@ -244,7 +251,7 @@ class BridgeKernel:
     def step(self, batch: HostBatch) -> StepOut:
         import jax.numpy as jnp
 
-        with self._jax.default_device(self.device), self._jax.enable_x64():
+        with self._jax.default_device(self.device), self._enable_x64():
             state, out = self._fn(
                 self.state, self._net_k0, self._net_k1,
                 jnp.asarray(batch.t_slot), jnp.asarray(batch.t_dl),

@@ -272,7 +272,8 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     so jobs only helps up to the machine's core count; jobs=0 picks
     ``os.cpu_count()``."""
     if jobs == 0:
-        jobs = os.cpu_count() or 1
+        # Host driver sizing its own fork pool — no simulation is live here.
+        jobs = os.cpu_count() or 1  # detlint: allow[DET004]
     if jobs > 1 and len(seeds) > 1 and not _jax_initialized():
         # fork is only safe before this process touches a jax backend
         # (forked XLA clients deadlock); with jax already live, fall back
@@ -426,7 +427,8 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                        timers=0, polls=0)
 
         def _clk():
-            return perf_counter()
+            # Wall-clock profiling of the sweep driver itself (host side).
+            return perf_counter()  # detlint: allow[DET001]
     else:
         def _clk():
             return 0.0

@@ -60,7 +60,10 @@ class Config:
 
     @staticmethod
     def from_toml(text: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # 3.10: the identical backport
+            import tomli as tomllib
 
         return Config.from_dict(tomllib.loads(text))
 

@@ -202,6 +202,7 @@ class Runtime:
         # Fresh threads for thread-local isolation, like the reference's
         # per-simulation thread spawn (`builder.rs:123`).
         for which in (0, 1):
+            # detlint: allow[DET003] — the driver wrapping simulations, not code inside one
             t = threading.Thread(target=run, args=(which,), daemon=True)
             t.start()
             t.join()

@@ -170,6 +170,11 @@ class File:
     async def metadata(self) -> Metadata:
         return Metadata(len(self._inode.data))
 
+    def close(self) -> None:
+        """Sim/real parity with :meth:`RealFile.close` (detlint PAR001):
+        the sim inode holds no OS fd, so there is nothing to release, but
+        programs that close their files must run on both backends."""
+
 
 async def read(path: str) -> bytes:
     """Read a whole file (`fs.rs:232-238`)."""

@@ -91,6 +91,7 @@ class _AsyncCheckpointer:
         self._busy = False
         self._stop = False
         self._error: Optional[BaseException] = None
+        # detlint: allow[DET003] — host-side checkpoint writer beside the device sweep
         self._thread = threading.Thread(
             target=self._run, name="madsim-checkpointer", daemon=True)
         self._thread.start()

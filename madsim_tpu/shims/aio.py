@@ -51,6 +51,15 @@ import asyncio as _stdlib_asyncio_early  # noqa: E402
 
 CANCELLED_TYPES = (Cancelled, _stdlib_asyncio_early.CancelledError)
 
+try:  # 3.11+: alias the builtin, so `aio.ExceptionGroup` works everywhere
+    ExceptionGroup = ExceptionGroup
+except NameError:  # 3.10: minimal stand-in so sim TaskGroups still report
+    class ExceptionGroup(Exception):  # noqa: A001 — deliberate shadow
+        def __init__(self, message, exceptions):
+            super().__init__(message, exceptions)
+            self.message = message
+            self.exceptions = list(exceptions)
+
 
 # ---------------------------------------------------------------------------
 # Coroutine / task API
@@ -652,7 +661,9 @@ def install() -> None:
     saved = {}
 
     def patch(mod, name, fn):
-        saved[(mod, name)] = getattr(mod, name)
+        # _MISSING: the stdlib lacks this name (3.11+ API on 3.10) and the
+        # shim backfills it in-sim; uninstall() removes it again.
+        saved[(mod, name)] = getattr(mod, name, _MISSING)
         setattr(mod, name, fn)
 
     def passthrough(orig, sim_fn):
@@ -715,8 +726,11 @@ def install() -> None:
     patch(_aio, "to_thread", passthrough(_aio.to_thread, _sim_to_thread))
     patch(_aio, "wait", passthrough(_aio.wait, wait))
     patch(_aio, "as_completed", passthrough(_aio.as_completed, as_completed))
-    patch(_aio, "timeout", passthrough(_aio.timeout, timeout))
-    patch(_aio, "timeout_at", passthrough(_aio.timeout_at, timeout_at))
+    for name, sim_fn in (("timeout", timeout), ("timeout_at", timeout_at)):
+        if hasattr(_aio, name):
+            patch(_aio, name, passthrough(getattr(_aio, name), sim_fn))
+        else:  # 3.10: no stdlib scope API — backfill it in-sim only
+            patch(_aio, name, _sim_only(name, sim_fn))
     patch(_aio, "current_task", passthrough(_aio.current_task, current_task))
     patch(_aio, "all_tasks", passthrough(_aio.all_tasks, all_tasks))
     # Stdlib-internal call sites resolve these through asyncio.events
@@ -756,8 +770,11 @@ def install() -> None:
     for name, cls in [("Event", Event), ("Lock", Lock),
                       ("Semaphore", Semaphore), ("Queue", Queue),
                       ("Condition", Condition), ("TaskGroup", TaskGroup)]:
-        orig_cls = getattr(_aio, name)
-        patch(_aio, name, _class_passthrough(orig_cls, cls))
+        orig_cls = getattr(_aio, name, None)
+        if orig_cls is not None:
+            patch(_aio, name, _class_passthrough(orig_cls, cls))
+        else:  # TaskGroup on 3.10: backfill the sim class in-sim only
+            patch(_aio, name, _sim_only(name, cls))
 
     # -- time ---------------------------------------------------------------
     patch(_walltime, "time", passthrough(_walltime.time, _time.system_time))
@@ -772,6 +789,23 @@ def install() -> None:
         _context.current_handle().time.advance(int(seconds * 1e9))
 
     patch(_walltime, "sleep", passthrough(_walltime.sleep, _sim_blocking_sleep))
+
+    # -- host introspection (sched_getaffinity/sysconf interception analog,
+    # `madsim/src/sim/task.rs:508-560`) -------------------------------------
+    # Unmodified third-party code sizing thread pools (ThreadPoolExecutor's
+    # default max_workers, loky, numexpr) must observe the NODE's configured
+    # cores, same as madsim_tpu.task.available_parallelism(), not the host's.
+    def _sim_cpu_count():
+        return _context.current_task().node.cores
+
+    patch(_os, "cpu_count", passthrough(_os.cpu_count, _sim_cpu_count))
+    if hasattr(_os, "process_cpu_count"):  # 3.13+
+        patch(_os, "process_cpu_count",
+              passthrough(_os.process_cpu_count, _sim_cpu_count))
+    if hasattr(_os, "sched_getaffinity"):  # POSIX
+        patch(_os, "sched_getaffinity",
+              passthrough(_os.sched_getaffinity,
+                          lambda pid=0: set(range(_sim_cpu_count()))))
 
     # -- randomness (getrandom/getentropy interception analog) --------------
     patch(_os, "urandom", passthrough(_os.urandom, lambda n: _sim_rng().gen_bytes(n)))
@@ -800,6 +834,26 @@ def install() -> None:
     _PATCHES = saved
 
 
+_MISSING = object()   # patch() marker: the name did not exist pre-install
+
+
+def _sim_only(name, sim_obj):
+    """Backfill a 3.11+ asyncio name absent from this interpreter: the sim
+    implementation serves in-sim; outside a simulation the name keeps not
+    existing (AttributeError), mirroring the unpatched interpreter."""
+
+    def wrapper(*a, **kw):
+        if _in_sim():
+            return sim_obj(*a, **kw)
+        raise AttributeError(
+            f"module 'asyncio' has no attribute {name!r} on this Python "
+            f"(3.11+ API; the madsim shim provides it inside a simulation "
+            f"only)")
+
+    wrapper.__name__ = name
+    return wrapper
+
+
 def _class_passthrough(orig_cls, sim_cls):
     """A callable standing in for a class: constructs the sim variant inside
     a simulation, the original outside."""
@@ -816,7 +870,10 @@ def uninstall() -> None:
     if _PATCHES is None:
         return
     for (mod, name), orig in _PATCHES.items():
-        setattr(mod, name, orig)
+        if orig is _MISSING:
+            delattr(mod, name)  # backfilled 3.11+ name: remove again
+        else:
+            setattr(mod, name, orig)
     _PATCHES = None
 
 

@@ -48,7 +48,11 @@ class Builder:
                  config: Optional[Config] = None, config_path: Optional[str] = None,
                  time_limit: Optional[float] = None, check_determinism: bool = False,
                  backend: str = "host"):
-        self.seed = seed if seed is not None else int(_walltime.time())
+        # Wall-clock default seed (the reference's builder does the same):
+        # deliberate nondeterminism, made reproducible by the up-front
+        # banner in run() that logs the chosen seed.
+        self.seed = seed if seed is not None else int(_walltime.time())  # detlint: allow[DET001]
+        self.seed_from_walltime = seed is None
         self.count = max(1, count)
         self.jobs = max(1, jobs)
         self.config = config
@@ -112,6 +116,12 @@ class Builder:
                 return asyncio.run(_limited())
             return asyncio.run(coro)
 
+        if self.seed_from_walltime:
+            # The seed came from the wall clock: log it BEFORE running, so
+            # even a hang/SIGKILL (no failure banner) leaves a repro line.
+            print(f"note: MADSIM_TEST_SEED not set; using wall-clock seed "
+                  f"{self.seed} (run with MADSIM_TEST_SEED={self.seed} to "
+                  f"reproduce)", file=sys.stderr)
         result: Any = None
         seeds = range(self.seed, self.seed + self.count)
         if self.backend == "bridge":
@@ -130,6 +140,7 @@ class Builder:
                 # context exactly like the reference (`builder.rs:123`).
                 result = _run_on_thread(run_seed, seed)
         else:
+            # detlint: allow[DET003] — the seed-sweep driver runs outside any simulation
             with ThreadPoolExecutor(max_workers=self.jobs) as pool:
                 futures = [pool.submit(run_seed, seed) for seed in seeds]
                 for fut in futures:
@@ -199,6 +210,7 @@ def _run_on_thread(fn: Callable[[int], Any], seed: int) -> Any:
         except BaseException as exc:  # noqa: BLE001
             box[1] = exc
 
+    # detlint: allow[DET003] — per-simulation isolation thread (`builder.rs:123`)
     t = threading.Thread(target=target, daemon=True)
     t.start()
     t.join()
@@ -227,6 +239,7 @@ def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Op
             b = Builder.from_env()
             if seed is not None:
                 b.seed = seed
+                b.seed_from_walltime = False
             if count is not None:
                 b.count = max(1, count)
             if jobs is not None:

@@ -41,7 +41,7 @@ def monotonic_ns() -> int:
     """Virtual monotonic nanoseconds since simulation start (real backend:
     the OS monotonic clock)."""
     if is_real():
-        return _ostime.monotonic_ns()
+        return _ostime.monotonic_ns()  # detlint: allow[DET001] — real backend
     return _time().now_ns()
 
 
@@ -55,7 +55,7 @@ def system_time_ns() -> int:
     the node's injected clock skew applied (``Handle.set_clock_skew``).
     Real backend: the OS wall clock."""
     if is_real():
-        return _ostime.time_ns()
+        return _ostime.time_ns()  # detlint: allow[DET001] — real backend
     return _time().system_time_ns(context.current_node_id())
 
 
@@ -125,6 +125,7 @@ def sleep_until_ns(deadline_ns: int) -> Awaitable[None]:
         # remaining delta is computed at await time so awaiting late does
         # not extend the sleep.
         async def _sleep():
+            # detlint: allow[DET001] — real backend
             delta = (deadline_ns - _ostime.monotonic_ns()) / NANOS_PER_SEC
             if delta > 0:
                 await asyncio.sleep(delta)

@@ -1,0 +1,12 @@
+"""DET002 golden fixture: ambient entropy bypassing the seeded RNG."""
+import os
+import random
+import secrets
+import uuid
+
+
+def draw():
+    return (os.urandom(8),
+            random.random(),
+            uuid.uuid4(),
+            secrets.token_bytes(4))

@@ -1,0 +1,7 @@
+"""DET004 golden fixture: host introspection used for sizing."""
+import os
+
+
+def pool_size():
+    workers = min(32, (os.cpu_count() or 1) + 4)
+    return workers, len(os.sched_getaffinity(0))

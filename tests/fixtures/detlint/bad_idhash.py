@@ -1,0 +1,7 @@
+"""DET006 golden fixture: identity-keyed ordering (allocation-dependent)."""
+
+
+def order(nodes, tasks):
+    ranked = sorted(nodes, key=id)
+    tasks.sort(key=lambda t: hash(t))
+    return ranked, tasks

@@ -1,0 +1,8 @@
+"""DET005 golden fixture: raw sockets bypassing the simulated network."""
+import socket
+
+
+def dial(host, port):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect((host, port))
+    return socket.create_connection((host, port))

@@ -1,0 +1,5 @@
+"""DET900 golden fixture: a pragma with nothing left to suppress."""
+
+
+def quiet():
+    return 1 + 1  # detlint: allow[DET001]

@@ -1,0 +1,10 @@
+"""DET003 golden fixture: real concurrency inside a simulated world."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def fan_out(loop, work):
+    t = threading.Thread(target=work)
+    t.start()
+    pool = ThreadPoolExecutor()
+    return loop.run_in_executor(pool, work)

@@ -1,0 +1,13 @@
+"""DET001 golden fixture: wall-clock reads escaping virtual time.
+
+Never imported by tests — detlint parses it, so the aliased import must
+not hide the escape.
+"""
+import time as _walltime
+from datetime import datetime
+
+
+def stamp():
+    t0 = _walltime.time()
+    _walltime.sleep(0.1)
+    return t0, datetime.now()

@@ -1,0 +1,22 @@
+"""Clean golden fixture: the sim-safe twins of everything the bad
+fixtures do — virtual time, seeded RNG, deterministic tasks, node-scoped
+parallelism, the simulated network."""
+from madsim_tpu import rand, task, time
+from madsim_tpu.net import Endpoint, TcpStream
+
+
+async def workload():
+    rng = rand.thread_rng()
+    await time.sleep(rng.gen_range_f64(0.0, 1.0))
+    handle = task.spawn(ping())
+    stamp = time.system_time()
+    return await handle, stamp, task.available_parallelism()
+
+
+async def ping():
+    ep = await Endpoint.bind("10.0.0.1:0")
+    stream = await TcpStream.connect("10.0.0.2:80")
+    await stream.write_all(b"hello")
+    ep.close()
+    stream.close()
+    return sorted(range(8), key=lambda n: n)

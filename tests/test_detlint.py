@@ -1,0 +1,179 @@
+"""detlint tests: golden fixtures, suppression mechanics, the tier-1
+self-scan invariant, and sim/real parity drift injection."""
+import json
+import os
+import shutil
+
+import pytest
+
+from madsim_tpu.analysis import (Allowlist, run_escape_pass, run_lint,
+                                 run_parity_pass, scan_source)
+from madsim_tpu.analysis.cli import main as detlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "detlint")
+
+# fixture -> {rule code: expected finding count} (golden findings).
+GOLDEN = {
+    "bad_wallclock.py": {"DET001": 3},
+    "bad_entropy.py": {"DET002": 4},
+    "bad_threads.py": {"DET003": 3},
+    "bad_hostinfo.py": {"DET004": 2},
+    "bad_socket.py": {"DET005": 2},
+    "bad_idhash.py": {"DET006": 2},
+    "bad_stale_pragma.py": {"DET900": 1},
+}
+
+
+@pytest.mark.parametrize("fixture,expected", sorted(GOLDEN.items()))
+def test_golden_fixture_findings(fixture, expected):
+    findings = run_escape_pass(FIXTURES, [fixture])
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    assert counts == expected, "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("fixture", sorted(GOLDEN))
+def test_cli_exits_nonzero_on_bad_fixture(fixture, capsys):
+    rc = detlint_main(["--root", FIXTURES, "--no-parity", fixture])
+    assert rc == 1
+    out = capsys.readouterr().out
+    code = next(iter(GOLDEN[fixture]))
+    assert code in out and fixture in out
+
+
+def test_clean_fixture_and_cli_exit_zero(capsys):
+    assert run_escape_pass(FIXTURES, ["clean.py"]) == []
+    assert detlint_main(["--root", FIXTURES, "--no-parity", "clean.py"]) == 0
+
+
+def test_cli_json_output(capsys):
+    rc = detlint_main(["--root", FIXTURES, "--no-parity", "--json",
+                       "bad_socket.py"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert {d["rule"] for d in data} == {"DET005"}
+    assert all(d["path"] == "bad_socket.py" and d["line"] > 0 for d in data)
+
+
+# -- suppression mechanics --------------------------------------------------
+
+def test_pragma_suppresses_same_line_and_line_above():
+    src = "import time\n\nt = time.time()  # detlint: allow[DET001]\n"
+    assert scan_source(src, "x.py") == []
+    src = ("import time\n"
+           "# detlint: allow[DET001]\n"
+           "t = time.time()\n")
+    assert scan_source(src, "x.py") == []
+
+
+def test_stale_pragma_is_an_error():
+    (f,) = scan_source("x = 1  # detlint: allow[DET002]\n", "x.py")
+    assert f.rule == "DET900" and "DET002" in f.message
+
+
+def test_pragma_in_docstring_is_documentation_not_suppression():
+    src = ('"""Silence with `# detlint: allow[DET001]` on the line."""\n'
+           "import time\n"
+           "t = time.time()\n")
+    rules = [f.rule for f in scan_source(src, "x.py")]
+    assert rules == ["DET001"]  # the docstring neither suppresses nor DET900s
+
+
+def test_allowlist_prefix_and_rule_scoping():
+    from madsim_tpu.analysis import Finding
+
+    allow = Allowlist.parse("pkg/real/\npkg/driver.py:DET003\n")
+
+    assert allow.allows(Finding("pkg/real/net.py", 1, "DET002", ""))
+    assert allow.allows(Finding("pkg/driver.py", 1, "DET003", ""))
+    assert not allow.allows(Finding("pkg/driver.py", 1, "DET001", ""))
+    assert not allow.allows(Finding("pkg/sim.py", 1, "DET002", ""))
+
+
+# -- the tier-1 invariant ---------------------------------------------------
+
+def test_self_scan_is_clean():
+    """The framework passes its own lint (modulo the checked-in allowlist
+    and inline pragmas). A regression here means a new nondeterminism
+    escape or a sim/real signature drift landed in madsim_tpu/ or tools/."""
+    allow = Allowlist.load(os.path.join(REPO, "detlint-allow.txt"))
+    findings = run_lint(REPO, ["madsim_tpu", "tools"], allow)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- pass 2: sim/real parity ------------------------------------------------
+
+_PARITY_FILES = [
+    "madsim_tpu/net/endpoint.py", "madsim_tpu/net/tcp.py",
+    "madsim_tpu/net/netsim.py", "madsim_tpu/fs.py", "madsim_tpu/time.py",
+    "madsim_tpu/real/net.py", "madsim_tpu/real/tcp.py",
+    "madsim_tpu/real/fs.py",
+]
+
+
+def _copy_tree(tmp_path):
+    for rel in _PARITY_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+    return str(tmp_path)
+
+
+def _edit(tmp_path, rel, old, new):
+    p = tmp_path / rel
+    src = p.read_text()
+    assert old in src, f"drift-injection anchor missing from {rel}: {old!r}"
+    p.write_text(src.replace(old, new))
+
+
+def test_parity_clean_on_repo():
+    assert run_parity_pass(REPO) == []
+
+
+def test_parity_detects_injected_parameter_drift(tmp_path):
+    root = _copy_tree(tmp_path)
+    _edit(tmp_path, "madsim_tpu/real/tcp.py",
+          "async def read_exact(self, n: int)",
+          "async def read_exact(self, n: int, strict: bool = True)")
+    findings = run_parity_pass(root)
+    assert any(f.rule == "PAR001" and "read_exact" in f.message
+               for f in findings), findings
+
+
+def test_parity_detects_renamed_real_method(tmp_path):
+    root = _copy_tree(tmp_path)
+    _edit(tmp_path, "madsim_tpu/real/fs.py",
+          "async def sync_all", "async def fsync_all")
+    findings = run_parity_pass(root)
+    msgs = [f.message for f in findings if f.rule == "PAR001"]
+    # Both directions: sim's sync_all lost its twin, real grew an extra.
+    assert any("sync_all" in m and "not in the real twin" in m for m in msgs)
+    assert any("fsync_all" in m for m in msgs)
+
+
+def test_parity_detects_asyncness_drift(tmp_path):
+    root = _copy_tree(tmp_path)
+    _edit(tmp_path, "madsim_tpu/real/tcp.py",
+          "    def close(self) -> None:\n        self._writer.close()",
+          "    async def close(self) -> None:\n        self._writer.close()")
+    findings = run_parity_pass(root)
+    assert any(f.rule == "PAR001" and "async-ness" in f.message
+               and "close" in f.message for f in findings), findings
+
+
+def test_parity_dispatch_check_flags_missing_is_real(tmp_path):
+    (tmp_path / "madsim_tpu").mkdir(parents=True)
+    (tmp_path / "madsim_tpu" / "time.py").write_text(
+        '__all__ = ["sleep", "monotonic"]\n'
+        "def monotonic():\n"
+        "    return 0.0\n"
+        "def sleep(seconds):\n"
+        "    from .core.backend import is_real\n"
+        "    if is_real():\n"
+        "        return None\n"
+        "    return None\n")
+    findings = run_parity_pass(str(tmp_path))
+    assert [f.rule for f in findings] == ["PAR002"]
+    assert "monotonic" in findings[0].message

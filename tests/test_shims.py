@@ -5,6 +5,10 @@ import madsim_tpu as ms
 from madsim_tpu import task, time
 from madsim_tpu.shims import aio, grpc_sim, postgres
 
+# On 3.11+ this IS the builtin; on 3.10 it is the shim's stand-in that sim
+# TaskGroups raise — either way the TaskGroup contract tests can catch it.
+ExceptionGroup = aio.ExceptionGroup
+
 
 # ---------------------------------------------------------------------------
 # aio: asyncio-shaped surface
@@ -205,6 +209,48 @@ def test_patched_falls_through_outside_sim():
         assert 0.0 <= v < 1.0
     # After uninstall the originals are restored.
     assert wall.time.__module__ == "time" or callable(wall.time)
+
+
+def test_patched_cpu_introspection_sees_node_cores():
+    # The sched_getaffinity/sysconf interception analog (`task.rs:508-560`,
+    # VERDICT "What's missing" #2): unmodified third-party code sizing a
+    # thread pool inside a sim node must observe the NODE's configured
+    # cores, matching task.available_parallelism() — not the host machine.
+    import os as real_os
+
+    host_cpus = real_os.cpu_count()
+    rt = ms.Runtime(seed=5)
+    node = rt.create_node(name="big", cores=6)
+    out = {}
+
+    async def probe():
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+
+        out["cpu_count"] = os.cpu_count()
+        if hasattr(os, "process_cpu_count"):
+            out["process_cpu_count"] = os.process_cpu_count()
+        out["affinity"] = os.sched_getaffinity(0)
+        # Default-sized executor: stdlib computes max_workers from the
+        # (patched) cpu count at construction time; no thread starts until
+        # submit, so building one in-sim is safe.
+        pool = ThreadPoolExecutor()
+        out["pool_workers"] = pool._max_workers
+        pool.shutdown(wait=False)
+
+    async def main():
+        await node.spawn(probe())
+
+    with aio.patched():
+        rt.block_on(main())
+        # Outside the sim the passthrough still reports the host.
+        import os
+
+        assert os.cpu_count() == host_cpus
+    assert out["cpu_count"] == 6
+    assert out.get("process_cpu_count", 6) == 6
+    assert out["affinity"] == set(range(6))
+    assert out["pool_workers"] == min(32, 6 + 4)
 
 
 # ---------------------------------------------------------------------------

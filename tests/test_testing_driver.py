@@ -59,6 +59,42 @@ def test_failing_seed_banner(capsys):
     assert "MADSIM_CONFIG_HASH=" in err
 
 
+def test_wallclock_seed_logged_up_front(monkeypatch, capsys):
+    # No MADSIM_TEST_SEED and no explicit seed: the builder falls back to
+    # the wall clock (its one sanctioned nondeterminism — see the detlint
+    # pragma at the default-seed site). The chosen seed must be logged
+    # BEFORE the run, so even a hang or SIGKILL leaves a repro line.
+    monkeypatch.delenv("MADSIM_TEST_SEED", raising=False)
+    seen = []
+
+    @ms.test
+    async def my_test():
+        seen.append(ms.Handle.current().seed)
+
+    my_test()
+    err = capsys.readouterr().err
+    assert "MADSIM_TEST_SEED not set" in err
+    assert f"MADSIM_TEST_SEED={seen[0]}" in err
+
+
+def test_no_wallclock_banner_when_seed_pinned(monkeypatch, capsys):
+    monkeypatch.setenv("MADSIM_TEST_SEED", "5")
+
+    @ms.test
+    async def env_pinned():
+        pass
+
+    env_pinned()
+    monkeypatch.delenv("MADSIM_TEST_SEED")
+
+    @ms.test(seed=9)
+    async def kwarg_pinned():
+        pass
+
+    kwarg_pinned()
+    assert "MADSIM_TEST_SEED not set" not in capsys.readouterr().err
+
+
 def test_config_from_toml(tmp_path, monkeypatch):
     cfg_file = tmp_path / "sim.toml"
     cfg_file.write_text("[net]\npacket_loss_rate = 0.25\nsend_latency = [0.002, 0.020]\n")

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""detlint entry point: nondeterminism-escape + sim/real-parity linter.
+
+Equivalent to ``python -m madsim_tpu.analysis``; this wrapper works from
+any cwd by anchoring --root at the repo it lives in.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from madsim_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if "--root" not in argv:
+        argv = ["--root", _REPO] + argv
+    sys.exit(main(argv))
