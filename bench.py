@@ -552,11 +552,17 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     assert not obs["overflow"].any(), \
         f"queue overflow (qmax={int(obs['qmax'].max())}): raise queue_cap"
     committed = obs["max_commit"]
+    hist = res.n_active_history
     out = {"seeds_per_sec": round(n_worlds / dt, 2),
            "n_worlds": n_worlds,
            "mean_committed": round(float(committed.mean()), 2),
            "worlds_with_commits": int((committed > 0).sum()),
-           "elected_frac": round(float(obs["leader_elected"].mean()), 4)}
+           "elected_frac": round(float(obs["leader_elected"].mean()), 4),
+           # Occupancy telemetry (docs/perf.md "world recycling"): measured
+           # per-chunk, not inferred from a one-off steps histogram.
+           "world_utilization": round(res.world_utilization, 4),
+           "n_chunks": int(hist.size),
+           "n_active_history": [int(x) for x in hist]}
     log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
     return out
 
@@ -674,6 +680,36 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
     n_bugs = int(obs["bug"].sum())
     assert n_bugs > 0, "device engine failed to find the injected bug"
     dev_rate = n_bugs / device_worlds
+    # Measured world-utilization of the monolithic batch (docs/perf.md
+    # "the straggler tail"): mean vs max masked steps across the batch.
+    max_steps_run = int(obs["steps"].max())
+    batch_util = (float(obs["steps"].mean()) / max_steps_run
+                  if max_steps_run else 0.0)
+
+    # World recycling (docs/perf.md): the same hunt streamed through a
+    # bounded batch with stop_on_first_bug, refilling retired slots from
+    # the seed cursor. Reports the per-chunk occupancy telemetry the
+    # monolithic run cannot have.
+    from madsim_tpu.parallel.sweep import sweep as device_sweep
+
+    rcfg_s = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg_s = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                         t_limit_us=2_000_000, stop_on_bug=True)
+    eng_s = DeviceEngine(RaftActor(rcfg_s), cfg_s)
+    batch_w = max(256, device_worlds // 8)
+    t0 = walltime.perf_counter()
+    res = device_sweep(None, cfg_s, np.arange(device_worlds), engine=eng_s,
+                       chunk_steps=256, max_steps=4_000,
+                       stop_on_first_bug=True, recycle=True,
+                       batch_worlds=batch_w)
+    recycled_dt = walltime.perf_counter() - t0
+    recycled = {
+        "batch_worlds": batch_w,
+        "world_utilization": round(res.world_utilization, 4),
+        "n_chunks": int(res.n_active_history.size),
+        "found_bug": bool(res.bug.any()),
+        "wall_s_incl_compile": round(recycled_dt, 3),
+    }
     # Expected seeds to first bug = 1/rate; the device explores
     # device_worlds/dev_dt seeds per second.
     dev_expected = (1.0 / dev_rate) / (device_worlds / dev_dt)
@@ -695,6 +731,8 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         "device_run_seeds_per_sec": round(device_worlds / run_dt, 1),
         "device_expected_s_to_first_bug": round(dev_expected, 4),
         "device_first_failing_seed": int(np.argmax(obs["bug"])),
+        "device_world_utilization": round(batch_util, 4),
+        "recycled_hunt": recycled,
         # Statistical gate (docs/perf.md): Wilson-CI overlap, with a
         # bounded model-difference allowance (the two engines share the
         # bug mechanism, not the timing model) — replaces the toothless

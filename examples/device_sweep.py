@@ -40,7 +40,9 @@ def main(n_worlds: int = 4096) -> None:
                 checkpoint_every_chunks=4)
     n_bug = len(res.failing_seeds)
     print(f"swept {n_worlds} worlds on {res.n_devices} device(s): "
-          f"{n_bug} seeds violate election safety")
+          f"{n_bug} seeds violate election safety "
+          f"(world utilization {res.world_utilization:.0%} over "
+          f"{res.n_active_history.size} chunks)")
     if not res.failing_seeds:
         print("no failing seeds in this sweep — try more worlds")
         return

@@ -248,6 +248,31 @@ class BridgeKernel:
                                                      k_events=k_events))
                 _STEP_CACHE[(cap, k_events)] = self._fn
 
+    def reset_slot(self, slot: int, seed: int) -> None:
+        """Recycle one world slot for a fresh seed: re-derive its NET
+        stream key and clear its device rows (clock zero, all timer lanes
+        empty). After the reset the slot is indistinguishable from row
+        ``slot`` of a freshly built kernel keyed on ``seed``, so a world
+        spawned into it keeps the bit-identical per-seed contract — this
+        is what lets bounded-width sweeps (``sweep(batch=...)``) stream
+        seeds through a fixed batch instead of sizing W to the seed list.
+        """
+        from ..core.rng import STREAM_NET
+        from ..ops.threefry import derive_stream_np, seed_to_key
+
+        import jax.numpy as jnp
+
+        nk0, nk1 = derive_stream_np(*seed_to_key(int(seed)), STREAM_NET)
+        with self._jax.default_device(self.device), self._enable_x64():
+            self._net_k0 = self._net_k0.at[slot].set(jnp.uint32(nk0))
+            self._net_k1 = self._net_k1.at[slot].set(jnp.uint32(nk1))
+            st = self.state
+            self.state = BridgeState(
+                clock=st.clock.at[slot].set(0),
+                lane_dl=st.lane_dl.at[slot].set(jnp.int64(INF_NS)),
+                lane_seq=st.lane_seq.at[slot].set(0),
+            )
+
     def step(self, batch: HostBatch) -> StepOut:
         import jax.numpy as jnp
 

@@ -241,10 +241,11 @@ class Outcome(NamedTuple):
 
 
 class _World:
-    __slots__ = ("idx", "rt", "root", "done", "stat")
+    __slots__ = ("idx", "slot", "rt", "root", "done", "stat")
 
-    def __init__(self, idx: int, rt: BridgeRuntime, root):
-        self.idx = idx
+    def __init__(self, idx: int, slot: int, rt: BridgeRuntime, root):
+        self.idx = idx          # position in the seed list (outcome row)
+        self.slot = slot        # kernel batch row currently hosting it
         self.rt = rt
         self.root = root
         self.done = False
@@ -255,7 +256,7 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
           configs: Optional[List[Config]] = None, cap: int = 128,
           k_events: int = 4, time_limit: Optional[float] = None,
           trace: bool = False, device: Optional[str] = None,
-          jobs: int = 1) -> List[Outcome]:
+          jobs: int = 1, batch: Optional[int] = None) -> List[Outcome]:
     """Sweep an unmodified host workload over many seeds with the device
     decision kernel (`builder.rs:118-136`, batched).
 
@@ -270,7 +271,15 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     (`builder.rs:55-107`; the reference forks OS threads, which a GIL
     rules out for Python task bodies). Task bodies are CPU-bound Python,
     so jobs only helps up to the machine's core count; jobs=0 picks
-    ``os.cpu_count()``."""
+    ``os.cpu_count()``.
+
+    ``batch`` bounds how many worlds are live at once (world recycling,
+    the host-side analog of ``parallel.sweep(recycle=True)``): seeds
+    stream through ``batch`` kernel slots, each finished world's slot
+    re-keyed (`BridgeKernel.reset_slot`) for the next seed. Memory and
+    per-round pack width stay O(batch) however long the seed list, and
+    every seed's trajectory stays bit-identical to an unbatched run
+    (tests/test_bridge.py). Default: all seeds at once."""
     if jobs == 0:
         # Host driver sizing its own fork pool — no simulation is live here.
         jobs = os.cpu_count() or 1  # detlint: allow[DET004]
@@ -280,11 +289,12 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
         # to the in-process loop.
         return _sweep_jobs(world_fn, seeds, jobs, config=config,
                            configs=configs, cap=cap, k_events=k_events,
-                           time_limit=time_limit, device=device)
+                           time_limit=time_limit, device=device,
+                           batch=batch)
     outcomes, _ = _sweep_impl(world_fn, seeds, config=config,
                               configs=configs, cap=cap, k_events=k_events,
                               time_limit=time_limit, trace=trace,
-                              device=device)
+                              device=device, batch=batch)
     return outcomes
 
 
@@ -372,37 +382,31 @@ def sweep_profiled(world_fn, seeds, **kw) -> Tuple[List[Outcome], dict]:
 
 def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 k_events=4, time_limit=None, trace=False, device=None,
-                profile=None):
+                profile=None, batch=None):
     seeds = [int(s) for s in seeds]
-    W = len(seeds)
+    n = len(seeds)
+    # World recycling: W kernel slots, n seeds streamed through them. A
+    # finished world's slot is re-keyed for the next seed, so batch width
+    # (and host memory) stays O(W) for arbitrarily long seed lists.
+    W = n if batch is None else max(1, min(int(batch), n))
     wants_seed = len(inspect.signature(world_fn).parameters) >= 1
-    worlds: List[_World] = []
-    traces: List[list] = []
-    for i, seed in enumerate(seeds):
-        if configs is not None:
-            cfg = copy.deepcopy(configs[i])
-        else:
-            cfg = copy.deepcopy(config) if config is not None else None
-        rt = BridgeRuntime(seed=seed, config=cfg, cap=cap)
-        if time_limit is not None:
-            rt.set_time_limit(time_limit)
-        tr: list = []
-        if trace:
-            rt.task.trace = tr
-        traces.append(tr)
-        with context.enter_handle(rt.handle):
-            coro = world_fn(seed) if wants_seed else world_fn()
-            root = rt.task.start_root(coro)
-        worlds.append(_World(i, rt, root))
+    outcomes: List[Optional[Outcome]] = [None] * n
+    traces: List[list] = [[] for _ in range(n)]
+    slots: List[Optional[_World]] = [None] * W
+    free: List[int] = list(range(W - 1, -1, -1))  # pop() fills slot 0 first
+    pending: set = set()            # slots holding a live world
+    next_pos = 0                    # next seed position to admit
+    polls_done = 0                  # poll_count of retired worlds
 
-    kernel = BridgeKernel(seeds, cap=cap, k_events=k_events, device=device)
-    outcomes: List[Optional[Outcome]] = [None] * W
-    pending = set(range(W))
+    kernel = BridgeKernel(seeds[:W], cap=cap, k_events=k_events, device=device)
 
     def finish(w: _World, value=None, error=None):
+        nonlocal polls_done
         outcomes[w.idx] = Outcome(seeds[w.idx], value, error)
         w.done = True
-        pending.discard(w.idx)
+        pending.discard(w.slot)
+        free.append(w.slot)
+        polls_done += w.rt.task.poll_count
 
     def run_host(w: _World) -> None:
         """One host burst: run all ready tasks, then settle the root."""
@@ -419,6 +423,48 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             else:
                 finish(w, value=fut.result())
 
+    def spawn(slot: int, pos: int) -> _World:
+        if configs is not None:
+            cfg = copy.deepcopy(configs[pos])
+        else:
+            cfg = copy.deepcopy(config) if config is not None else None
+        rt = BridgeRuntime(seed=seeds[pos], config=cfg, cap=cap)
+        if time_limit is not None:
+            rt.set_time_limit(time_limit)
+        if trace:
+            rt.task.trace = traces[pos]
+        with context.enter_handle(rt.handle):
+            coro = world_fn(seeds[pos]) if wants_seed else world_fn()
+            root = rt.task.start_root(coro)
+        w = _World(pos, slot, rt, root)
+        slots[slot] = w
+        pending.add(slot)
+        return w
+
+    def top_up() -> None:
+        """Admit seeds into free slots (runs between rounds only — a slot
+        reset mid-round would let stale kernel rows fire into the fresh
+        world's seq space)."""
+        nonlocal next_pos
+        blocked: List[int] = []
+        while free and next_pos < n:
+            slot = free.pop()
+            old = slots[slot]
+            if old is not None:
+                t = old.rt.time
+                if t.pending_add or t.sends or t.cancels:
+                    # The retiring world's final host burst recorded
+                    # activity that has not been shipped yet (its stats
+                    # ride the next round's batch): recycle this slot one
+                    # round later.
+                    blocked.append(slot)
+                    continue
+                kernel.reset_slot(slot, seeds[next_pos])
+            w = spawn(slot, next_pos)
+            next_pos += 1
+            run_host(w)
+        free.extend(blocked)
+
     if profile is not None:
         from time import perf_counter
 
@@ -434,8 +480,7 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             return 0.0
 
     t0 = _clk()
-    for w in worlds:
-        run_host(w)
+    top_up()
     if profile is not None:
         profile["host_s"] += _clk() - t0
 
@@ -479,12 +524,12 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
         np.ones((W, 0), np.int64), np.zeros((W, 0), np.bool_),
         np.zeros((W, 0), np.bool_))
     no_advance = np.zeros((W,), np.bool_)
-    while pending:
+    while pending or next_pos < n:
         # -- build the padded round batch ---------------------------------
         t0 = _clk()
         rounds = []
         t_n = c_n = s_n = 0
-        for w in worlds:
+        for w in slots:
             adds, cancels, sends = w.rt.time.take_round()
             rounds.append((adds, cancels, sends))
             t_n = max(t_n, len(adds))
@@ -495,8 +540,8 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
          s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
          s_lat_lo, s_lat_w, s_mask, s_live, clock, advance) = \
             round_buffers(T, C, S)
-        for w, (adds, cancels, sends) in zip(worlds, rounds):
-            i = w.idx
+        for w, (adds, cancels, sends) in zip(slots, rounds):
+            i = w.slot
             clock[i] = w.rt.time.elapsed_ns
             advance[i] = not w.done
             for j, (slot, (dl, sq)) in enumerate(adds.items()):
@@ -535,8 +580,8 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
         # -- settle sends, dispatch events, detect stops ------------------
         t0 = _clk()
         woke: List[_World] = []
-        for w, (adds, cancels, sends) in zip(worlds, rounds):
-            i = w.idx
+        for w, (adds, cancels, sends) in zip(slots, rounds):
+            i = w.slot
             for j, s in enumerate(sends):
                 if out.send_ok[i, j]:
                     w.stat.msg_count += 1
@@ -573,8 +618,8 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             # recorded would silently miss its own due cluster and fire in
             # the wrong order vs the host heap. No framework callback does
             # that today — enforce it rather than assume it.
-            for w in worlds:
-                if w.done or not out.more_due[w.idx]:
+            for w in slots:
+                if w.done or not out.more_due[w.slot]:
                     continue
                 t = w.rt.time
                 assert not (t.pending_add or t.sends or t.cancels), (
@@ -584,8 +629,8 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 profile["drain_rounds"] += 1
             drained = kernel.step(HostBatch(
                 *drain_batch_tail, np.asarray(out.clock), no_advance))
-            for w in worlds:
-                i = w.idx
+            for w in slots:
+                i = w.slot
                 if w.done or not out.more_due[i]:
                     continue
                 with context.enter_handle(w.rt.handle):
@@ -603,8 +648,10 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
         for w in woke:
             if not w.done:
                 run_host(w)
+        top_up()  # recycle freed slots for the next seeds in the stream
         if profile is not None:
             profile["host_s"] += _clk() - t0
-            profile["polls"] = sum(w.rt.task.poll_count for w in worlds)
+            profile["polls"] = polls_done + sum(
+                w.rt.task.poll_count for w in slots if not w.done)
 
     return [o for o in outcomes], traces

@@ -169,6 +169,22 @@ def tree_select(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
+def tree_select_worlds(mask, a, b):
+    """Slot-wise select over two identically batched pytrees.
+
+    ``mask`` is a (W,) bool vector over the leading world axis; it
+    broadcasts over each leaf's trailing axes, so whole worlds are taken
+    from ``a`` where True and from ``b`` where False. This is the
+    device-side primitive behind world recycling: fresh worlds are
+    selected into retired slots without the batch ever leaving the chip.
+    """
+    def pick(x, y):
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        return jnp.where(m, x, y)
+
+    return jax.tree.map(pick, a, b)
+
+
 class DeviceEngine:
     """Compiles (actor, config) into jit-ready batched simulation functions.
 
@@ -202,6 +218,7 @@ class DeviceEngine:
         # repeated init() calls (and every sweep) reuse the compilation
         # instead of paying a fresh trace per call.
         self._init_batched = jax.jit(jax.vmap(self._init_one))
+        self._refill_select = jax.jit(tree_select_worlds)
 
     # ------------------------------------------------------------------
     # Initialization
@@ -330,6 +347,32 @@ class DeviceEngine:
             lat_max=lat_max,
             loss=loss,
         )
+
+    def refill(self, state: WorldState, slot_mask, new_seeds,
+               faults: Optional[np.ndarray] = None,
+               configs: Optional[np.ndarray] = None) -> WorldState:
+        """Recycle retired batch slots: select freshly initialized worlds
+        into the masked positions, on device.
+
+        ``slot_mask`` is a (W,) bool vector over the batch; True slots
+        receive the world initialized from the matching row of
+        ``new_seeds`` (length W — rows outside the mask are initialized
+        and immediately discarded by the select, so any placeholder seed
+        works there). ``faults``/``configs`` follow :meth:`init`.
+
+        Worlds are position-independent, so a refilled slot's trajectory
+        is bit-identical to an independent ``init``+run of that seed —
+        the recycled-sweep contract (tests/test_parallel.py). When
+        ``state`` is mesh-sharded, the fresh worlds are placed onto the
+        same sharding first so the select is a device-side program, not
+        an implicit reshard through the host.
+        """
+        fresh = self.init(new_seeds, faults=faults, configs=configs)
+        mask = jnp.asarray(np.asarray(slot_mask, bool))
+        sharding = getattr(state.now, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            fresh, mask = jax.device_put((fresh, mask), sharding)
+        return self._refill_select(mask, fresh, state)
 
     # ------------------------------------------------------------------
     # The per-world step

@@ -9,8 +9,9 @@ the bug/active flags riding ICI (`any`-reduce to answer "did any seed find a
 bug?" without pulling per-world state to host). Multi-host sweeps extend the
 same mesh over DCN — the sharded world axis simply spans processes.
 """
-from .mesh import multihost_mesh, seed_mesh, shard_worlds, world_spec
+from .mesh import (multihost_mesh, seed_mesh, shard_worlds, world_sharding,
+                   world_spec)
 from .sweep import SweepResult, sharded_engine, sweep
 
 __all__ = ["seed_mesh", "multihost_mesh", "shard_worlds", "world_spec",
-           "sharded_engine", "sweep", "SweepResult"]
+           "world_sharding", "sharded_engine", "sweep", "SweepResult"]

@@ -83,6 +83,18 @@ def world_spec(mesh: Mesh) -> P:
     return P(tuple(mesh.axis_names))
 
 
+def world_sharding(mesh: Mesh) -> NamedSharding:
+    """The NamedSharding splitting the leading world axis over the mesh.
+
+    One sharding covers every leaf of a batched WorldState (trailing axes
+    stay unsharded), so it doubles as the ``out_shardings`` of jitted
+    programs that must hand back mesh-resident state — e.g. the on-device
+    sweep compaction (`parallel/sweep.py`), which would otherwise need a
+    host round trip to re-place its permuted output.
+    """
+    return NamedSharding(mesh, world_spec(mesh))
+
+
 def shard_worlds(state, mesh: Mesh):
     """Place a batched WorldState so its leading axis is split over the mesh.
 
@@ -90,5 +102,4 @@ def shard_worlds(state, mesh: Mesh):
     PartitionSpec over all mesh axes shards the entire pytree; XLA then
     runs the vmapped step on each shard with no cross-chip traffic.
     """
-    sharding = NamedSharding(mesh, world_spec(mesh))
-    return jax.device_put(state, sharding)
+    return jax.device_put(state, world_sharding(mesh))

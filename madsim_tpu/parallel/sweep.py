@@ -8,6 +8,13 @@ over ICI — "any bug found?" and "how many worlds still active?" — so the hos
 loop makes progress/early-exit decisions without ever pulling per-world state
 off device. Failing seeds (the repro banner of `runtime/mod.rs:192-199`)
 are gathered once, at the end.
+
+The loop is a slot-occupancy model (docs/perf.md "World recycling"): the
+batch is a fixed set of world slots, compaction is an on-device stable
+partition (no host pull of per-world state), and with ``recycle=True``
+retired slots are refilled with fresh seeds from a host-side cursor so
+the mesh stays full for open-ended hunts. Per-chunk occupancy telemetry
+(``n_active_history`` / ``world_utilization``) rides every result.
 """
 from __future__ import annotations
 
@@ -26,7 +33,7 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 from ..engine.core import DeviceEngine, EngineConfig, WorldState
-from .mesh import seed_mesh, shard_worlds, world_spec
+from .mesh import seed_mesh, shard_worlds, world_sharding, world_spec
 
 
 def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
@@ -166,6 +173,14 @@ class SweepResult:
     observations: Dict[str, np.ndarray]  # engine + actor metrics, per seed
     steps_run: int               # chunks * chunk_steps issued
     n_devices: int
+    # Occupancy telemetry (docs/perf.md "world recycling"): the active
+    # world count after each chunk, and the fraction of issued slot-steps
+    # that advanced a live world — useful/(sum over chunks of
+    # batch_width*chunk_steps). Frozen worlds riding masked in the batch
+    # are the difference; 1.0 means the mesh never ran a frozen slot.
+    n_active_history: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))
+    world_utilization: float = 0.0
 
     @property
     def failing_seeds(self) -> List[int]:
@@ -187,8 +202,17 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           checkpoint_path: Optional[str] = None,
           checkpoint_every_chunks: int = 0,
           resume: bool = False,
-          compact: bool = False) -> SweepResult:
+          compact: bool = False,
+          recycle: bool = False,
+          batch_worlds: Optional[int] = None) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
+
+    The loop is a slot-occupancy model: the device batch is a fixed set of
+    world *slots*, each holding a live world, a finished one awaiting
+    retirement, or (after retirement) a recycled world for a fresh seed.
+    Per chunk the host learns exactly two scalars — "any bug?" and "how
+    many slots are active?" — and every occupancy decision (shrink,
+    retire, refill) runs as an on-device program keyed off that count.
 
     Preemption survival: with ``checkpoint_path`` set, the (padded) world
     state is written every ``checkpoint_every_chunks`` chunks (and at the
@@ -201,17 +225,34 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     tail"). A chunked batch runs until its SLOWEST world finishes, so
     once most worlds are done the chip mostly advances frozen state.
     When the active count drops below half the batch, the sweep gathers
-    the active worlds to the front (one on-device permutation), retires
-    the frozen ones (their observations are pulled exactly once, as the
-    final observe would have), and continues on a power-of-two-smaller
-    batch — worlds' trajectories are position-independent, so results
-    are bitwise identical to the uncompacted run (tested). Off by
-    default: each compaction adds host↔device round trips, which on a
-    co-located chip cost microseconds but on a TUNNELED device (this
-    repo's bench machine) cost more than the masked straggler steps they
-    save — measured in docs/perf.md. Enable on co-located hardware with
-    long tails. Disabled automatically when checkpointing (a shrunken
-    state cannot resume into the full-shape contract).
+    the active worlds to the front — a stable active-first ``argsort``
+    computed INSIDE a jitted, mesh-resident program, so no per-world
+    state (not even ``state.active``) crosses to the host and no reshard
+    round trip follows — retires the frozen tail (its observations are
+    pulled exactly once, as the final observe would have), and continues
+    on a power-of-two-smaller batch. Worlds' trajectories are
+    position-independent, so results are bitwise identical to the
+    uncompacted run (tested). Disabled automatically when checkpointing
+    (a shrunken state cannot resume into the full-shape contract).
+
+    ``recycle`` + ``batch_worlds``: world recycling / seed streaming
+    (docs/perf.md "world recycling"). Instead of only shrinking, retired
+    slots are REFILLED with freshly initialized worlds for the next
+    seeds from a host-side cursor: the sweep holds ``batch_worlds``
+    slots (rounded to the mesh) and streams the full seed list through
+    them, keeping utilization near 100% while any seeds remain; once the
+    cursor is dry it falls back to shrink compaction for the tail. Each
+    refilled world is bit-identical to an independent run of its seed
+    (tested). This is the shape for open-ended hunts —
+    ``stop_on_first_bug`` sweeps over huge seed spaces on a bounded
+    memory footprint. On an early stop, seeds never admitted report
+    zeroed observations (``bug=False``). Incompatible with
+    checkpointing: the seed cursor and retired observations are host
+    state a resume could not re-attribute (raises ``ValueError``).
+
+    Occupancy telemetry rides the result: ``SweepResult.n_active_history``
+    (per-chunk active counts) and ``SweepResult.world_utilization``
+    (live-world steps / issued slot-steps, mesh padding included).
     """
     from ..engine import checkpoint as ckpt
 
@@ -220,16 +261,57 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     n_dev = mesh.devices.size
     seeds = np.asarray(seeds, np.uint64)
     n = seeds.shape[0]
-    # Pad the world axis to a multiple of the mesh (padded worlds are real
+
+    if recycle and checkpoint_path:
+        raise ValueError(
+            "recycle=True cannot be combined with checkpointing: the seed "
+            "cursor and retired observations live on the host, so a "
+            "resumed sweep could not re-attribute recycled slots")
+
+    # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
+    # once; recycled sweeps hold batch_worlds slots and stream the rest.
+    full_w = n + ((-n) % n_dev)
+    if recycle and batch_worlds is not None:
+        w0 = min(max(1, int(batch_worlds)), max(n, 1))
+        w0 += (-w0) % n_dev
+        w0 = min(w0, full_w)
+    else:
+        w0 = full_w
+    # Pad the seed-id space to the batch width (padded worlds are real
     # simulations of dummy seeds; their results are sliced off below).
-    pad = (-n) % n_dev
-    seeds_p = np.concatenate([seeds, seeds[:1].repeat(pad)]) if pad else seeds
+    n_ids = max(n, w0)
+    seeds_p = (np.concatenate([seeds, seeds[:1].repeat(n_ids - n)])
+               if n_ids > n else seeds)
+
     faults_p = faults
-    if faults is not None and pad:
+    per_world_faults = False
+    if faults is not None:
         faults_p = np.asarray(faults, np.int32)
-        if faults_p.ndim == 3:
-            faults_p = np.concatenate(
-                [faults_p, faults_p[:1].repeat(pad, axis=0)], axis=0)
+        if faults_p.ndim == 2:
+            if faults_p.shape[-1] != 4:
+                raise ValueError(
+                    f"shared fault schedule must be (F, 4) rows of "
+                    f"[time_us, op, a, b]; got shape {faults_p.shape}")
+        elif faults_p.ndim == 3:
+            if faults_p.shape[0] != n or faults_p.shape[-1] != 4:
+                raise ValueError(
+                    f"per-world fault schedules must be (n_seeds, F, 4) "
+                    f"with n_seeds={n}; got shape {faults_p.shape}")
+            per_world_faults = True
+            if n_ids > n:
+                faults_p = np.concatenate(
+                    [faults_p, faults_p[:1].repeat(n_ids - n, axis=0)],
+                    axis=0)
+        else:
+            raise ValueError(
+                f"faults must be (F, 4) or (n_seeds, F, 4); got "
+                f"{faults_p.ndim}-D shape {faults_p.shape}")
+
+    def batch_faults(ids: np.ndarray):
+        """Fault rows for the worlds holding the given seed ids."""
+        if faults_p is None:
+            return None
+        return faults_p[ids] if per_world_faults else faults_p
 
     import hashlib
     import os
@@ -252,7 +334,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 f"sweep expects {seeds_p.shape[0]} (seeds + mesh padding)")
         state = shard_worlds(state, mesh)
     else:
-        state = shard_worlds(eng.init(seeds_p, faults=faults_p), mesh)
+        state = shard_worlds(
+            eng.init(seeds_p[:w0], faults=batch_faults(np.arange(w0))), mesh)
     runner = sharded_engine(eng, mesh, chunk_steps)
 
     writer = (_AsyncCheckpointer(eng, checkpoint_path, seeds_meta)
@@ -262,21 +345,41 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     chunks = 0
     submitted_at = -1  # chunk counter, not an object ref: a pytree ref
     # here would pin a full extra device state between checkpoints.
-    w_cur = seeds_p.shape[0]           # current (compacted) batch width
-    orig_idx = np.arange(w_cur)        # row i of state ↔ seeds_p[orig_idx[i]]
+    w_cur = w0                         # current batch width (slot count)
+    cursor = w0                        # next seed id the stream admits
+    # Slot→seed-id map, DEVICE-resident: compaction permutes it with the
+    # state in the same on-device program, so the host never needs the
+    # permutation (or state.active) to keep attribution straight. -1
+    # marks a dead slot (retired world still riding in the batch).
+    idx = shard_worlds(jnp.arange(w_cur, dtype=jnp.int32), mesh)
+    reordered = False                  # batch rows still == seed order?
     retired: Dict[str, list] = {}      # field → retired observation batches
     retired_rows: List[np.ndarray] = []
+    n_active_hist: List[int] = []
+    issued_slot_steps = 0              # sum over chunks of width*chunk_steps
+    live_world_steps = 0               # steps that advanced a live world
 
     def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray) -> None:
+        """Record final observations for rows leaving the batch (dead
+        slots — already retired earlier — are filtered out by idx)."""
+        nonlocal live_world_steps
+        keep = rows >= 0
+        if not keep.all():
+            rows = rows[keep]
+            obs_slice = {k: np.asarray(v)[keep] for k, v in obs_slice.items()}
+        if rows.size == 0:
+            return
+        live_world_steps += int(np.asarray(obs_slice["steps"]).sum())
         retired_rows.append(rows)
         for k, v in obs_slice.items():
-            retired.setdefault(k, []).append(v)
+            retired.setdefault(k, []).append(np.asarray(v))
 
     try:
         while steps < max_steps:
             state, any_bug, n_active = runner(state)
             steps += chunk_steps
             chunks += 1
+            issued_slot_steps += w_cur * chunk_steps
             if writer is not None and checkpoint_every_chunks and \
                     chunks % checkpoint_every_chunks == 0:
                 # Async: the pull + write overlap the next chunk's device
@@ -284,23 +387,46 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 writer.submit(state)
                 submitted_at = chunks
             n_act = int(n_active)
-            if n_act == 0:
+            n_active_hist.append(n_act)
+            more_seeds = cursor < n_ids
+            if n_act == 0 and not more_seeds:
                 break
             if stop_on_first_bug and bool(any_bug):
                 break
-            new_w = _compact_bucket(n_act, w_cur, n_dev)
-            if compact and new_w < w_cur:
-                active = np.asarray(jax.device_get(state.active))
-                # Stable partition: active worlds first, original order
-                # preserved either side of the split.
-                perm = np.argsort(~active, kind="stable")
-                permuted = _permute_worlds(state, jnp.asarray(perm))
-                frozen = jax.tree.map(lambda x: x[new_w:], permuted)
-                obs_f = eng.observe(frozen)
-                retire(obs_f, orig_idx[perm[new_w:]])
+            if recycle and more_seeds and n_act <= w_cur // 2:
+                # World recycling: stable active-first partition on
+                # device, retire the frozen tail, refill it with the next
+                # seeds from the cursor. Only the n_active scalar (already
+                # on host) shapes the refill mask.
+                state, idx = _compactor(eng, mesh, w_cur, w_cur)(state, idx)
+                reordered = True
+                obs_full = eng.observe(state)
+                idx_h = np.asarray(jax.device_get(idx))
+                retire({k: v[n_act:] for k, v in obs_full.items()},
+                       idx_h[n_act:])
+                take = min(w_cur - n_act, n_ids - cursor)
+                repl = np.full(w_cur, -1, np.int32)
+                repl[n_act:n_act + take] = np.arange(
+                    cursor, cursor + take, dtype=np.int32)
+                cursor += take
+                mask = np.zeros(w_cur, bool)
+                mask[n_act:n_act + take] = True
+                fill_ids = np.maximum(repl, 0)
                 state = shard_worlds(
-                    jax.tree.map(lambda x: x[:new_w], permuted), mesh)
-                orig_idx = orig_idx[perm[:new_w]]
+                    eng.refill(state, mask, seeds_p[fill_ids],
+                               faults=batch_faults(fill_ids)), mesh)
+                idx = jnp.where(jnp.asarray(np.arange(w_cur) >= n_act),
+                                jnp.asarray(repl), idx)
+                continue
+            new_w = _compact_bucket(n_act, w_cur, n_dev)
+            if (compact or (recycle and not more_seeds)) and new_w < w_cur:
+                # Shrink compaction, fully on device: permutation, split,
+                # and the live batch's mesh placement all happen inside
+                # one jitted program (out_shardings = the world sharding).
+                (state, idx), (frozen, fidx) = \
+                    _compactor(eng, mesh, w_cur, new_w)(state, idx)
+                reordered = True
+                retire(eng.observe(frozen), np.asarray(jax.device_get(fidx)))
                 w_cur = new_w
         if writer is not None and submitted_at != chunks:
             writer.submit(state)  # the final state is always durable
@@ -312,19 +438,30 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             writer.flush_and_close(suppress_errors=True)
 
     obs_live = eng.observe(state)
-    if retired_rows:
-        rows = np.concatenate(retired_rows + [orig_idx])
+    idx_h = np.asarray(jax.device_get(idx))
+    live_keep = idx_h >= 0
+    live_world_steps += int(np.asarray(obs_live["steps"])[live_keep].sum())
+    if reordered or retired_rows:
+        rows = np.concatenate(retired_rows + [idx_h[live_keep]])
         obs = {}
         for k, v_live in obs_live.items():
-            merged = np.concatenate(retired[k] + [np.asarray(v_live)], axis=0)
-            out = np.empty_like(merged)
+            v_live = np.asarray(v_live)[live_keep]
+            merged = np.concatenate(retired.get(k, []) + [v_live], axis=0)
+            # Zeros, not empty: an early stop (stop_on_first_bug) can
+            # leave streamed seeds never admitted — they report zeroed
+            # observations (bug=False) rather than garbage.
+            out = np.zeros((n_ids,) + merged.shape[1:], merged.dtype)
             out[rows] = merged
             obs[k] = out
     else:
         obs = obs_live
     obs = {k: v[:n] for k, v in obs.items()}
+    util = (live_world_steps / issued_slot_steps if issued_slot_steps
+            else 0.0)
     return SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
-                       steps_run=steps, n_devices=n_dev)
+                       steps_run=steps, n_devices=n_dev,
+                       n_active_history=np.asarray(n_active_hist, np.int64),
+                       world_utilization=util)
 
 
 def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:
@@ -343,3 +480,35 @@ def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:
 def _permute_worlds(state, perm):
     """Reorder the world axis of a whole state pytree on device."""
     return jax.tree.map(lambda x: x[perm], state)
+
+
+def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
+    """Compile (and cache per engine) the on-device compaction program.
+
+    The program computes the stable active-first permutation of a
+    width-``w`` batch with ``jnp.argsort`` ON DEVICE, applies it to the
+    state and the slot→seed index vector via :func:`_permute_worlds`, and
+    (for ``new_w < w``) splits off the frozen tail. ``out_shardings``
+    pins every output to the mesh's world sharding, so compaction needs
+    no host pull of ``state.active``, no host-built permutation, and no
+    ``device_put`` reshard afterwards — the host contributes only the
+    ``n_active`` scalar the chunk runner already returned. Shrink widths
+    are power-of-two buckets, so at most log2(W) programs compile.
+    """
+    cache = eng.__dict__.setdefault("_compactor_cache", {})
+    key = (mesh, w, new_w)
+    if key in cache:
+        return cache[key]
+
+    def compacted(state, idx):
+        order = jnp.argsort((~state.active).astype(jnp.int32), stable=True)
+        state, idx = _permute_worlds((state, idx), order)
+        if new_w == w:
+            return state, idx
+        live = jax.tree.map(lambda x: x[:new_w], (state, idx))
+        frozen = jax.tree.map(lambda x: x[new_w:], (state, idx))
+        return live, frozen
+
+    fn = jax.jit(compacted, out_shardings=world_sharding(mesh))
+    cache[key] = fn
+    return fn

@@ -17,6 +17,10 @@ Environment variables (same names as the reference):
   device kernel (:func:`madsim_tpu.bridge.sweep`) — same trajectories per
   seed (the bit-identical contract, tests/test_bridge.py), one batched
   decision kernel for all of them. See docs/bridge.md for when this wins.
+- ``MADSIM_TEST_BATCH`` — bridge backend only: cap on concurrently live
+  worlds; seeds stream through recycled kernel slots
+  (``bridge.sweep(batch=...)``), so a million-seed sweep runs in bounded
+  memory with unchanged per-seed trajectories.
 
 On failure the driver prints the repro banner with the failing seed and the
 config hash (`runtime/mod.rs:192-199`).
@@ -47,7 +51,7 @@ class Builder:
     def __init__(self, seed: Optional[int] = None, count: int = 1, jobs: int = 1,
                  config: Optional[Config] = None, config_path: Optional[str] = None,
                  time_limit: Optional[float] = None, check_determinism: bool = False,
-                 backend: str = "host"):
+                 backend: str = "host", batch: Optional[int] = None):
         # Wall-clock default seed (the reference's builder does the same):
         # deliberate nondeterminism, made reproducible by the up-front
         # banner in run() that logs the chosen seed.
@@ -62,6 +66,11 @@ class Builder:
         if backend not in ("host", "bridge"):
             raise ValueError("backend must be 'host' or 'bridge'")
         self.backend = backend
+        # Bridge world recycling: bound how many worlds are live at once;
+        # seeds stream through the recycled slots (bridge/runtime.py).
+        if batch is not None and batch < 1:
+            raise ValueError("batch must be >= 1")
+        self.batch = batch
 
     @staticmethod
     def from_env() -> "Builder":
@@ -78,10 +87,13 @@ class Builder:
         if config_path:
             with open(config_path) as f:
                 config = Config.from_toml(f.read())
+        batch = int(env["MADSIM_TEST_BATCH"]) if "MADSIM_TEST_BATCH" in env \
+            else None
         return Builder(seed=seed, count=count, jobs=jobs, config=config,
                        config_path=config_path, time_limit=time_limit,
                        check_determinism=check,
-                       backend=env.get("MADSIM_TEST_BACKEND", "host"))
+                       backend=env.get("MADSIM_TEST_BACKEND", "host"),
+                       batch=batch)
 
     def _run_one(self, seed: int, make_coro: Callable[[], Coroutine]) -> Any:
         config = copy.deepcopy(self.config) if self.config is not None else None
@@ -176,7 +188,8 @@ class Builder:
 
         kw = dict(config=copy.deepcopy(self.config)
                   if self.config is not None else None,
-                  time_limit=self.time_limit)
+                  time_limit=self.time_limit,
+                  batch=self.batch)
         if self.check_determinism:
             outs_a, traces_a = sweep_traced(lambda s: make_coro(),
                                             list(seeds), **kw)
@@ -222,7 +235,7 @@ def _run_on_thread(fn: Callable[[int], Any], seed: int) -> Any:
 def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Optional[int] = None,
          jobs: Optional[int] = None, config: Optional[Config] = None,
          time_limit: Optional[float] = None, check_determinism: Optional[bool] = None,
-         backend: Optional[str] = None):
+         backend: Optional[str] = None, batch: Optional[int] = None):
     """Decorator: turn an async test fn into a multi-seed simulation test.
 
     ``@madsim_tpu.test`` / ``@madsim_tpu.test(count=10, time_limit=300)``.
@@ -254,6 +267,8 @@ def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Op
                 if backend not in ("host", "bridge"):
                     raise ValueError("backend must be 'host' or 'bridge'")
                 b.backend = backend
+            if batch is not None:
+                b.batch = max(1, batch)
             return b.run(lambda: async_fn(*args, **kwargs))
 
         return runner

@@ -207,6 +207,35 @@ def test_bridge_config_grid_axis():
     assert any(trs[2 * i] != trs[2 * i + 1] for i in range(3))
 
 
+def test_bridge_batched_sweep_bit_identical():
+    """World recycling on the bridge (sweep(batch=...)): seeds stream
+    through a bounded set of kernel slots, each retired slot re-keyed for
+    the next seed (BridgeKernel.reset_slot). Trajectories must stay
+    bit-identical to pure-host runs — the slot a world lands in, and
+    whoever occupied it before, must be invisible to the world."""
+    assert_identical(_pingpong_world(rounds=4), SEEDS, batch=2)
+    # And a batch that doesn't divide the seed count.
+    assert_identical(_pingpong_world(rounds=4), SEEDS[:5], batch=3)
+
+
+def test_bridge_batched_sweep_mixed_outcomes():
+    # Recycling must keep error attribution straight: odd seeds raise,
+    # even seeds return their value, across several slot generations.
+    async def world(seed):
+        await vtime.sleep(0.1)
+        if seed % 2:
+            raise ValueError(f"boom {seed}")
+        return seed * 10
+
+    outs = sweep(world, list(range(9)), batch=2)
+    for seed, o in enumerate(outs):
+        assert o.seed == seed
+        if seed % 2:
+            assert isinstance(o.error, ValueError) and str(seed) in str(o.error)
+        else:
+            assert o.error is None and o.value == seed * 10
+
+
 def test_bridge_deadlock_and_time_limit():
     async def deadlocked():
         await _await(ms.sync.SimFuture())  # never resolved, no timers

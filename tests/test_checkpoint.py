@@ -69,6 +69,31 @@ def test_sweep_resume_rejects_different_seeds(tmp_path):
               max_steps=64, checkpoint_path=path, resume=True)
 
 
+def test_sweep_resume_rejects_wrong_world_count(tmp_path):
+    """Defense-in-depth behind the seeds-hash gate: a checkpoint whose
+    metadata matches but whose state holds a different world count must
+    raise CheckpointError, not shard a mis-shaped batch. (Reachable only
+    via a forged/corrupted checkpoint — the seeds hash normally pins the
+    padded width — so the file is forged here.)"""
+    import hashlib
+
+    from madsim_tpu.parallel.sweep import sweep
+
+    path = str(tmp_path / "sweep.npz")
+    seeds = np.arange(24)
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    # Metadata for the 24-seed sweep, wrapped around a 16-world state.
+    meta = {
+        "seeds_sha256": hashlib.sha256(
+            seeds.astype(np.uint64).tobytes()).hexdigest(),
+        "faults_sha256": hashlib.sha256(b"none").hexdigest(),
+    }
+    save_checkpoint(eng, eng.init(np.arange(16)), path, extra_meta=meta)
+    with pytest.raises(CheckpointError, match="16 worlds"):
+        sweep(None, ECFG, seeds, engine=eng, chunk_steps=64,
+              max_steps=64, checkpoint_path=path, resume=True)
+
+
 def test_sweep_resumes_from_checkpoint(tmp_path):
     from madsim_tpu.parallel.sweep import sweep
 
