@@ -279,7 +279,10 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     re-keyed (`BridgeKernel.reset_slot`) for the next seed. Memory and
     per-round pack width stay O(batch) however long the seed list, and
     every seed's trajectory stays bit-identical to an unbatched run
-    (tests/test_bridge.py). Default: all seeds at once."""
+    (tests/test_bridge.py). The bound is per lockstep loop: with
+    ``jobs>1`` each forked worker holds up to ``batch`` live worlds, so
+    the process tree's total is O(jobs*batch). Default: all seeds at
+    once."""
     if jobs == 0:
         # Host driver sizing its own fork pool — no simulation is live here.
         jobs = os.cpu_count() or 1  # detlint: allow[DET004]

@@ -441,7 +441,11 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     idx_h = np.asarray(jax.device_get(idx))
     live_keep = idx_h >= 0
     live_world_steps += int(np.asarray(obs_live["steps"])[live_keep].sum())
-    if reordered or retired_rows:
+    # Scatter whenever the live batch does not cover the full id space in
+    # seed order — after any reorder/retirement, OR when a recycled sweep
+    # exited (stop_on_first_bug / max_steps) before its first refill, so
+    # only the first w0 < n_ids seeds were ever admitted.
+    if reordered or retired_rows or w0 < n_ids:
         rows = np.concatenate(retired_rows + [idx_h[live_keep]])
         obs = {}
         for k, v_live in obs_live.items():

@@ -268,7 +268,9 @@ def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Op
                     raise ValueError("backend must be 'host' or 'bridge'")
                 b.backend = backend
             if batch is not None:
-                b.batch = max(1, batch)
+                if batch < 1:  # same contract as Builder(batch=...)
+                    raise ValueError("batch must be >= 1")
+                b.batch = batch
             return b.run(lambda: async_fn(*args, **kwargs))
 
         return runner

@@ -183,6 +183,39 @@ def test_recycled_sweep_bitwise_equals_independent_runs():
                 recycled.observations[key][seed], v[0], err_msg=key)
 
 
+def test_recycled_sweep_early_exit_before_first_refill():
+    """REVIEW regression: a recycled sweep that exits before its first
+    recycle/compact event (max_steps, or stop_on_first_bug — the
+    documented headline hunt mode) must still report full-length,
+    seed-attributed results: never-admitted seeds come back zeroed
+    (bug=False), not truncated to batch_worlds."""
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_500_000, stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(64)
+    # max_steps == one chunk: guaranteed exit before any refill/compact.
+    res = sweep(None, cfg, seeds, engine=eng, chunk_steps=64, max_steps=64,
+                recycle=True, batch_worlds=16)
+    assert res.bug.shape == seeds.shape
+    res.failing_seeds  # used to raise IndexError on the truncated array
+    for key, v in res.observations.items():
+        assert v.shape[0] == seeds.shape[0], key
+        assert not np.asarray(v)[16:].any(), key  # never admitted: zeroed
+    # Admitted seeds carry real results: identical to the same 16 seeds
+    # swept alone for the same step budget.
+    head = sweep(None, cfg, seeds[:16], engine=eng, chunk_steps=64,
+                 max_steps=64)
+    for key, v in head.observations.items():
+        np.testing.assert_array_equal(res.observations[key][:16], v,
+                                      err_msg=key)
+    # The headline use: stop_on_first_bug over a streamed seed space.
+    hunt = sweep(None, cfg, np.arange(128), engine=eng, chunk_steps=64,
+                 stop_on_first_bug=True, recycle=True, batch_worlds=16)
+    assert hunt.bug.shape == (128,)
+    assert hunt.failing_seeds  # attribution intact whenever the stop fires
+
+
 def test_recycled_utilization_beats_shrink_only():
     """Tier-1 occupancy regression for world recycling: on a synthetic
     short-tail workload — every world but one kill-alls its nodes at 1 ms

@@ -18,6 +18,17 @@ def test_decorator_basic():
     assert runs == [7, 8, 9]
 
 
+def test_decorator_rejects_invalid_batch():
+    """@test(batch=0) must fail loudly like Builder(batch=0), not clamp."""
+
+    @ms.test(seed=1, batch=0)
+    async def my_test():
+        pass
+
+    with pytest.raises(ValueError, match="batch must be >= 1"):
+        my_test()
+
+
 def test_env_driven(monkeypatch):
     monkeypatch.setenv("MADSIM_TEST_SEED", "100")
     monkeypatch.setenv("MADSIM_TEST_NUM", "4")
