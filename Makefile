@@ -39,6 +39,12 @@ smoke:
 	@$(PY) -c "import json; d=json.load(open('bench_results.json')); \
 	missing={'metric','value','unit','vs_baseline','configs'}-set(d); \
 	assert not missing, f'bench_results.json missing {missing}'; \
+	xc=[d['configs'][k].get('xla_cost') for k in \
+	    ('time_to_first_bug','madraft_5node')]; \
+	need={'flops_per_step','flops_per_world_step','peak_bytes_est', \
+	      'argument_size_bytes','aliased_bytes'}; \
+	assert all(isinstance(x,dict) and need<=set(x) for x in xc), \
+	    f'xla_cost records missing/incomplete: {xc}'; \
 	print('bench_results.json ok:', d['metric'])"
 
 dryrun:

@@ -54,6 +54,59 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def xla_cost_record(eng, state, max_steps: int) -> dict:
+    """XLA's own per-step cost model for the compiled (donated) run path.
+
+    Lowers ``eng._run`` at the given state's shapes (no execution — safe
+    on a donated state) and records ``cost_analysis()`` flops/bytes and
+    ``memory_analysis()`` sizes into the bench result, so per-iteration
+    performance accounting is a tracked artifact per round (PRISM-style)
+    instead of a one-off measurement. ``make smoke`` asserts the keys
+    exist; the tier-1 op-budget test (tests/test_queue_insert.py) gates
+    flops per world-step against a recorded budget. Never raises: on any
+    analysis failure the keys are present with null values plus an
+    ``error`` string, keeping the bench record intact.
+    """
+    import numpy as _np
+
+    out = {"n_worlds": None, "max_steps": max_steps,
+           "flops_per_step": None, "flops_per_world_step": None,
+           "bytes_accessed_per_step": None,
+           "argument_size_bytes": None, "output_size_bytes": None,
+           "temp_size_bytes": None, "aliased_bytes": None,
+           "peak_bytes_est": None, "peak_over_state": None}
+    try:
+        w = int(_np.asarray(state.now).shape[0])
+        out["n_worlds"] = w
+        comp = eng._run.lower(state, max_steps).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        if flops is not None:
+            out["flops_per_step"] = float(flops)
+            out["flops_per_world_step"] = round(float(flops) / w, 2)
+        ba = ca.get("bytes accessed")
+        if ba is not None:
+            out["bytes_accessed_per_step"] = float(ba)
+        ma = comp.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        out.update({
+            "argument_size_bytes": arg,
+            "output_size_bytes": int(ma.output_size_in_bytes),
+            "temp_size_bytes": int(ma.temp_size_in_bytes),
+            "aliased_bytes": int(ma.alias_size_in_bytes),
+        })
+        peak = (arg + int(ma.output_size_in_bytes)
+                + int(ma.temp_size_in_bytes) - int(ma.alias_size_in_bytes))
+        out["peak_bytes_est"] = peak
+        if arg:
+            out["peak_over_state"] = round(peak / arg, 4)
+    except Exception as exc:  # noqa: BLE001 — observability must not fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Config 1: RPC ping-pong, 2 nodes, single seed, host engine
 # ---------------------------------------------------------------------------
@@ -535,6 +588,13 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     eng = DeviceEngine(RaftActor(rcfg), cfg)
     faults = make_fault_schedules(n_worlds, 5, t_limit_us)
 
+    # Cost-model record for this engine config (capped batch: the model
+    # is per-shape, flops_per_world_step is the tracked quantity; the
+    # probe state dies before the timed sweep allocates).
+    rec_w = min(n_worlds, 4_096)
+    xla_cost = xla_cost_record(
+        eng, eng.init(np.arange(rec_w), faults=faults[:rec_w]), 2_000)
+
     # Warmup compile on the SAME batch shape as the timed run (jit
     # specializes on shapes; a smaller warmup batch would leave the real
     # compile inside the timed window).
@@ -562,7 +622,8 @@ def bench_madraft_5node(n_worlds: int) -> dict:
            # per-chunk, not inferred from a one-off steps histogram.
            "world_utilization": round(res.world_utilization, 4),
            "n_chunks": int(hist.size),
-           "n_active_history": [int(x) for x in hist]}
+           "n_active_history": [int(x) for x in hist],
+           "xla_cost": xla_cost}
     log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
     return out
 
@@ -676,6 +737,9 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
     jax.block_until_ready(state)
     run_dt = walltime.perf_counter() - t0
     obs = eng.observe(state)
+    # Cost-model record at the exact shapes the timed run used (lower
+    # only — the donated buffers are never re-executed).
+    xla_cost = xla_cost_record(eng, state, 4_000)
     dev_dt = init_dt + run_dt
     n_bugs = int(obs["bug"].sum())
     assert n_bugs > 0, "device engine failed to find the injected bug"
@@ -732,6 +796,9 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         "device_expected_s_to_first_bug": round(dev_expected, 4),
         "device_first_failing_seed": int(np.argmax(obs["bug"])),
         "device_world_utilization": round(batch_util, 4),
+        # Per-step XLA cost model of this engine config (the op-budget
+        # regression axis; docs/perf.md "Single-pass insert + donation").
+        "xla_cost": xla_cost,
         "recycled_hunt": recycled,
         # Statistical gate (docs/perf.md): Wilson-CI overlap, with a
         # bounded model-difference allowance (the two engines share the

@@ -187,7 +187,12 @@ def _step(state: BridgeState, net_k0, net_k1,
 # One jitted step per (cap, k_events), shared by every kernel instance:
 # a fresh jax.jit object per sweep would re-trace and re-compile (~0.8 s
 # on CPU XLA for this unrolled kernel) on every sweep() call in a process.
-# The step is pure (all state is passed in), so sharing is sound.
+# The step is pure (all state is passed in), so sharing is sound. The
+# BridgeState argument is DONATED: XLA updates the W×(CAP+1) timer lanes
+# in place instead of double-buffering them per step — sound because
+# ``BridgeKernel.step`` immediately rebinds ``self.state`` to the output
+# and nothing else holds the previous state (``reset_slot`` only ever
+# touches the current one).
 _STEP_CACHE: dict = {}
 
 
@@ -245,7 +250,8 @@ class BridgeKernel:
             self._fn = _STEP_CACHE.get((cap, k_events))
             if self._fn is None:
                 self._fn = jax.jit(functools.partial(_step, cap=cap,
-                                                     k_events=k_events))
+                                                     k_events=k_events),
+                                   donate_argnums=(0,))
                 _STEP_CACHE[(cap, k_events)] = self._fn
 
     def reset_slot(self, slot: int, seed: int) -> None:

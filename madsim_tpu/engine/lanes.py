@@ -1,16 +1,30 @@
-"""One-hot select/update primitives for the device engine.
+"""Indexing primitives for the device engine: one-hot writes, tiny gathers.
 
-TPU-first data movement: under ``vmap``, ``x[i]`` and ``x.at[i].set(v)`` with
-traced indices lower to gather/scatter HLOs, which XLA cannot fuse and which
-serialize badly on TPU. For the tiny per-world axes this engine indexes
-(nodes N ≤ 8, queue slots Q ≤ 256), a one-hot mask + elementwise
-select/reduce is strictly better: it fuses into the surrounding kernel and
-vectorizes over the world axis for free. Every dynamic index in the engine
-and its actors goes through these helpers.
+The doctrine, refined by measurement over two perf rounds
+(docs/perf.md):
+
+- **Single-slot writes** (``upd``/``upd2``) stay one-hot mask + select:
+  a lone ``x.at[i].set(v)`` with a traced index lowers to a scatter XLA
+  cannot fuse, while the mask write fuses into the surrounding kernel
+  and vectorizes over the world axis for free.
+- **Reads** use real gathers (``take_small``) when the source axis is
+  tiny (nodes N ≤ 8, log rows L ≤ 64, outbox M ≤ 8): the one-hot
+  contraction costs k·m·width ops per read — measured as one of the
+  step's dominant flop consumers — while the gather is priced at ~zero
+  and its operand is a state buffer that is materialized anyway.
+- **The queue insert** (``queue.push_many``) is the one deliberate
+  scatter: M rows, computed slots, in-place under buffer donation — see
+  its docstring for why it beats both the unrolled one-hot chain and a
+  (Q,)-gather-driven rewrite.
+
+Anything not covered above goes through these helpers rather than raw
+``x[i]`` / ``.at[i]`` so the layout decisions keep exactly one home.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 
 def onehot(i, n: int) -> jnp.ndarray:
@@ -49,6 +63,71 @@ def sel_many(x: jnp.ndarray, idxs: jnp.ndarray) -> jnp.ndarray:
     """
     m = jnp.arange(x.shape[0])[None, :] == idxs[:, None]
     return jnp.sum(jnp.where(m, x[None, :], 0), axis=1).astype(x.dtype)
+
+
+def prefix_count(mask: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive prefix count: how many True lanes strictly precede each
+    lane.
+
+    For the engine's queue widths (n ≤ 64) the mask packs into one or
+    two uint32 words (a ``where`` against the constant powers-of-two
+    vector + a sum); each lane then ANDs the word with a *constant*
+    below-me bitmask and ``population_count``s it. Two subtleties make
+    this the cheapest form in practice, not just on paper:
+
+    - XLA CPU *clones* elementwise producer chains into every consumer
+      fusion, so the chain is pinned behind an identity gather (a
+      materialization point fusion cannot clone through) — without it,
+      the queue's three lane writes would each re-price the whole
+      prefix (docs/perf.md r7).
+    - The alternative, ``jnp.cumsum``, prices flat but its hierarchical
+      scan lowering allocates ~1 KB/world of scratch inside the step —
+      the difference between fitting 1.2× state in peak memory and not.
+
+    Larger n falls back to ``jnp.cumsum`` (the word trick scales as
+    n·(n/32) and stops winning past two words).
+    """
+    n = mask.shape[0]
+    if n > 64:
+        inc = jnp.cumsum(mask.astype(jnp.int32))
+        return jnp.concatenate([jnp.zeros((1,), jnp.int32), inc[:-1]])
+    counts = jnp.zeros((n,), jnp.int32)
+    for w in range((n + 31) // 32):
+        lanes = min(32, n - 32 * w)
+        pow2 = jnp.asarray(np.uint32(1) << np.arange(lanes, dtype=np.uint32),
+                           jnp.uint32)
+        word = jnp.sum(jnp.where(mask[32 * w:32 * w + lanes], pow2,
+                                 jnp.uint32(0)))
+        # below[s]: bits of word w strictly below lane s (zero before the
+        # word, all-ones once past it) — a host-built constant vector.
+        rel = np.clip(np.arange(n) - 32 * w, 0, 32)
+        partial = (np.uint32(1) << np.minimum(rel, 31).astype(np.uint32)) \
+            - np.uint32(1)
+        below = jnp.asarray(np.where(rel < 32, partial,
+                                     np.uint32(0xFFFFFFFF)), jnp.uint32)
+        counts = counts + lax.population_count(word & below) \
+            .astype(jnp.int32)
+    # Identity gather = an explicit materialization point (see docstring).
+    return jnp.take(counts, jnp.arange(n), axis=0)
+
+
+def take_small(x: jnp.ndarray, idxs: jnp.ndarray) -> jnp.ndarray:
+    """``x[idxs]`` as a REAL gather — for tiny leading axes only.
+
+    x: (m, ...), idxs: (k,) → (k, ...). The one place the engine prefers a
+    gather over a one-hot contraction: when the *source* axis is tiny
+    (m ≲ 8, e.g. an outbox-sized table) but the index vector is long
+    (k = queue capacity) and the rows are wide (payload words), the
+    one-hot select costs k·m·width ops — the very per-slot rewrite cost
+    :func:`~madsim_tpu.engine.queue.push_many` exists to eliminate —
+    while the gather reads each destination row once.
+
+    Out-of-range indices clamp to the edge ("clip" mode — measured
+    cheaper post-fusion than both ``promise_in_bounds``'s at-get lowering
+    and "wrap"); callers with possibly-wild indices get edge values and
+    must mask the result.
+    """
+    return jnp.take(x, jnp.asarray(idxs, jnp.int32), axis=0, mode="clip")
 
 
 def upd(x: jnp.ndarray, i, v) -> jnp.ndarray:

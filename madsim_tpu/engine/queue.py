@@ -5,16 +5,22 @@ The device analog of the host timer wheel + NetSim delivery queue
 future occurrence in a world — timer expiry, message delivery, fault
 injection — is one slot in a flat array. ``pop`` is a masked argmin over the
 time lane (a single vectorized reduction, which is exactly the shape TPUs
-like); ``push`` scatters into the first free slot. No pointer heap: priority
-order is recomputed per pop, which for capacities ~64-256 is cheaper on TPU
-than maintaining heap invariants with data-dependent control flow.
+like); ``push`` fills the first free slot, and ``push_many`` inserts a whole
+outbox of events in one fused pass (bitwise identical to chained pushes —
+see its docstring). No pointer heap: priority order is recomputed per pop,
+which for capacities ~64-256 is cheaper on TPU than maintaining heap
+invariants with data-dependent control flow.
 
 Storage is two lanes plus payload: the time lane (``INF_TIME`` ⇔ slot free —
 there is no separate valid lane) and a *packed meta* lane holding
-kind/flags/src/dst/gen in one int32. The queue is rewritten wholesale every
-step (functional update under ``vmap``), so queue bytes/slot directly set
-the engine's HBM traffic — packing the five meta fields and dropping the
-valid lane cuts that by ~35% vs one-lane-per-field. Width limits (asserted
+kind/flags/src/dst/gen in one int32. Since round 7 the per-step update is a
+sparse in-place one — ``push_many`` scatters M rows and, under the run
+loop's buffer donation, XLA aliases the queue in place — but the lanes are
+still read wholesale every step (pop's min, the free mask), so queue
+bytes/slot
+remain the engine's HBM-traffic knob — packing the five meta fields and
+dropping the valid lane cuts that by ~35% vs one-lane-per-field. Width
+limits (asserted
 at :func:`~madsim_tpu.engine.core.DeviceEngine.init` time): kind < 64,
 flags < 4, src/dst < 256 nodes, and generations compare modulo 256
 (``GEN_MASK``) — a node must be killed 256 times within one pending timer's
@@ -32,8 +38,10 @@ from __future__ import annotations
 from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
-from .lanes import onehot, sel, sel_many
+from .lanes import onehot, prefix_count, take_small
 
 INF_TIME = jnp.int32(2**31 - 1)
 
@@ -141,6 +149,145 @@ def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray
     return q, ok
 
 
+def push_many(q: EventQueue, evs: Event, enable=None,
+              clear=None) -> Tuple[EventQueue, jnp.ndarray, jnp.ndarray]:
+    """Insert up to M events in ONE pass over the queue lanes.
+    Returns ``(queue, ok, n_inserted)``; ``ok`` is (M,) bool per event.
+
+    ``evs`` is a batched :class:`Event` (every field carries a leading
+    (M,) axis; payload is (M, P)); ``enable`` an optional (M,) bool mask.
+    Semantics are **bitwise identical** to the sequential chain
+    ``for i in range(M): q, ok[i] = push(q, evs[i], enable[i])`` — the
+    contract the engine's trajectory-equivalence tests pin
+    (tests/test_queue_insert.py, via ``EngineConfig.sequential_insert``):
+
+    - events keep their order: the i-th *enabled* event (after the
+      time < INF_TIME drop filter) lands in the i-th lowest free slot;
+    - overflow matches: once the free slots run out, every remaining
+      enabled event reports ok=False and writes nothing;
+    - an event at INF_TIME is dropped (ok=True) and consumes no slot.
+
+    Why one pass: each sequential ``push`` recomputes the free mask, an
+    argmax and a one-hot, then rewrites all three lanes — M·Q·(2+P)
+    selects per call site, the single largest int-op consumer in the
+    step (docs/perf.md "Single-pass insert"). Here the assignment is
+    closed-form — the i-th enabled event's cumulative-sum *rank* names
+    the free slot it gets — so the insert is M row writes, not M lane
+    rewrites: the free mask packs into Q/32 uint32 words, each rank's
+    target slot is the word's lowest set bit (clear-lowest-bit +
+    ``population_count``, a handful of scalar ops per rank), and the
+    compacted events scatter into those slots. With the run loop's
+    buffer donation the scatter updates the queue in place: per step the
+    queue costs M·(2+P) element writes instead of Q·(2+P). (The first
+    build used the issue's (Q,)-gather-driven select; measurement moved
+    it to this scatter form — the batched gather materializes a (Q, 2)
+    index buffer per world that dominated peak temp memory, while the
+    scatter's index buffer is (M, 2). Same rank assignment either way,
+    and the M-row scatter is also strictly less write traffic.)
+
+    ``clear``: optional ``(slot, found)`` from :func:`pop_indexed` over
+    THIS ``q``. When given, slot ``slot`` is treated as freed (and its
+    time lane rewritten to INF unless re-filled) — i.e. the result equals
+    pushing into the pop-cleared queue. The step uses this to fuse the
+    pop's clear into the insert's own scatter pass, so the pop never
+    rewrites the time lane at all: routing the cleared lane through a
+    separate elementwise write makes CPU XLA clone the whole pop chain
+    into every downstream reader of the free mask (measured ~2×
+    over-pricing of the insert, docs/perf.md r7).
+    """
+    m = evs.time.shape[0]
+    qcap = q.time.shape[0]
+    t = jnp.asarray(evs.time, jnp.int32)
+    en = jnp.ones((m,), bool) if enable is None else jnp.asarray(enable, bool)
+    en = en & (t < INF_TIME)
+    # rank[i]: how many enabled events precede i == which free slot (in
+    # lowest-first order) the sequential chain would hand event i.
+    rank = prefix_count(en)
+    base_time = q.time
+    free = base_time == INF_TIME
+    if clear is not None:
+        cslot, cfound = clear
+        free = free | (onehot(cslot, qcap) & cfound)
+        base_time = base_time.at[jnp.where(cfound, cslot, qcap)].set(
+            INF_TIME, mode="drop")
+    # Pack the free mask into uint32 words: bit s of word w ⇔ slot
+    # 32w + s is free. Everything below runs on these scalars.
+    words = []
+    for w in range((qcap + 31) // 32):
+        lanes = min(32, qcap - 32 * w)
+        pow2 = jnp.asarray(np.uint32(1) << np.arange(lanes, dtype=np.uint32),
+                           jnp.uint32)
+        words.append(jnp.sum(jnp.where(free[32 * w:32 * w + lanes], pow2,
+                                       jnp.uint32(0))))
+    n_free = sum(lax.population_count(w).astype(jnp.int32) for w in words)
+    n_en = rank[-1] + en[-1].astype(jnp.int32)
+    ok = ~en | (rank < n_free)
+    # Order-preserving compaction of the enabled events to the front:
+    # row r of the compacted table is the event with rank r. The (M, M)
+    # one-hot collapses to an M-long *index* vector and the field tables
+    # are gathered rows (tiny-source gathers, lanes.take_small).
+    cm = en[None, :] & (rank[None, :] == jnp.arange(m)[:, None])
+    ev_idx = jnp.sum(jnp.where(cm, jnp.arange(m)[None, :], 0), axis=1)
+    meta = pack_meta(evs.kind, evs.flags, evs.src, evs.dst, evs.gen)
+    ct = take_small(t, ev_idx)
+    cmeta = take_small(meta, ev_idx)
+    cpay = take_small(evs.payload, ev_idx)
+    # Target slot of rank r = lowest set bit still standing; clear it and
+    # move on. Ranks past n_en aim at slot Q and are dropped.
+    slots = []
+    for r in range(m):
+        pos = jnp.int32(qcap)
+        placed = jnp.asarray(False)
+        nxt = []
+        for wi, w in enumerate(words):
+            lsb = w & (~w + jnp.uint32(1))
+            p = lax.population_count(lsb - jnp.uint32(1)).astype(jnp.int32) \
+                + 32 * wi
+            use = ~placed & (w != 0)
+            pos = jnp.where(use, p, pos)
+            nxt.append(jnp.where(use, w & (w - jnp.uint32(1)), w))
+            placed = placed | use
+        words = nxt
+        slots.append(jnp.where(r < n_en, pos, qcap))
+    slots = jnp.stack(slots)
+    # Slots are distinct (dropped ranks all aim at the same out-of-range
+    # Q, which "drop" discards), so the scatters are order-independent;
+    # XLA chains the clear scatter and this one through a single buffer.
+    q = EventQueue(
+        time=base_time.at[slots].set(ct, mode="drop"),
+        meta=q.meta.at[slots].set(cmeta, mode="drop"),
+        payload=q.payload.at[slots].set(cpay, mode="drop"),
+    )
+    return q, ok, jnp.minimum(n_en, n_free)
+
+
+def pop_indexed(q: EventQueue, eligible=None
+                ) -> Tuple[EventQueue, Event, jnp.ndarray, jnp.ndarray]:
+    """:func:`pop` that also returns the popped ``slot`` index, so the
+    caller can hand ``(slot, found)`` to :func:`push_many`'s ``clear``
+    and fuse the clear into the insert's single time-lane write (the
+    engine step does; the returned queue is then dead code and XLA drops
+    its redundant clear write)."""
+    times = q.time if eligible is None else jnp.where(eligible, q.time,
+                                                      INF_TIME)
+    n = q.time.shape[0]
+    tmin = jnp.min(times)
+    found = tmin < INF_TIME
+    # First slot holding the min — argmin's first-occurrence tie-break,
+    # but min-priced: argmin's tuple comparator costs ~8 flops/element,
+    # while "max of (n-1-slot) over the min positions" is a where + max.
+    slot = (n - 1) - jnp.max(jnp.where(times == tmin,
+                                       (n - 1) - jnp.arange(n), -1))
+    mask = onehot(slot, n)
+    kind, flags, src, dst, gen = unpack_meta(take_small(q.meta, slot))
+    ev = Event(
+        time=tmin, kind=kind, flags=flags, src=src, dst=dst, gen=gen,
+        payload=take_small(q.payload, slot),
+    )
+    q = q._replace(time=jnp.where(mask & found, INF_TIME, q.time))
+    return q, ev, found, slot
+
+
 def pop(q: EventQueue, eligible=None) -> Tuple[EventQueue, Event, jnp.ndarray]:
     """Remove and return the earliest valid event. Returns (queue, ev, found).
 
@@ -154,21 +301,16 @@ def pop(q: EventQueue, eligible=None) -> Tuple[EventQueue, Event, jnp.ndarray]:
     (time, slot) order (`task.rs:243-261` park/unpark analog). With every
     slot ineligible, ``found`` is False even for a non-empty queue.
 
-    Scatter/gather-free: the min slot is read back via a one-hot masked
-    reduction and cleared via an elementwise select.
+    Scatter-free: the min slot comes from an argmin (first-occurrence
+    tie-break), the clear is an elementwise select, and the meta/payload
+    read-back is a single-row gather at that slot
+    (:func:`~madsim_tpu.engine.lanes.take_small` — one element per world,
+    priced at zero by the cost model, vs 2 ops/element over the whole
+    meta+payload footprint for the old one-hot masked reduction). When
+    the queue is empty the gathered row is arbitrary — covered by the
+    "mask on ``found``" contract above.
     """
-    times = q.time if eligible is None else jnp.where(eligible, q.time,
-                                                      INF_TIME)
-    slot = jnp.argmin(times)
-    mask = onehot(slot, q.time.shape[0])
-    tmin = jnp.min(times)
-    found = tmin < INF_TIME
-    kind, flags, src, dst, gen = unpack_meta(sel(q.meta, slot))
-    ev = Event(
-        time=tmin, kind=kind, flags=flags, src=src, dst=dst, gen=gen,
-        payload=sel(q.payload, slot),
-    )
-    q = q._replace(time=jnp.where(mask & found, INF_TIME, q.time))
+    q, ev, found, _slot = pop_indexed(q, eligible)
     return q, ev, found
 
 
@@ -181,8 +323,12 @@ def eligible_mask(q: EventQueue, paused, n_nodes: int) -> jnp.ndarray:
     """(Q,) pop-eligibility under node pause: events to a paused node are
     buffered (skipped in place); faults always fire — the matching resume
     must be able to reach the paused node. Lives here, next to
-    pack_meta/unpack_meta, so the bit layout has exactly one home."""
-    _kind, flags_q, _src, dst_q, _gen = unpack_meta(q.meta)
-    dst_q = jnp.clip(dst_q, 0, n_nodes - 1)
-    is_fault_q = (flags_q & FLAG_FAULT) != 0
-    return is_fault_q | ~sel_many(paused, dst_q)
+    pack_meta/unpack_meta, so the bit layout has exactly one home.
+
+    Reads the two needed fields straight off the packed bits (one masked
+    compare for the fault flag) instead of a full :func:`unpack_meta` —
+    this runs over the whole (Q,) meta lane every step."""
+    is_fault_q = (q.meta & jnp.int32(FLAG_FAULT << 6)) != 0
+    dst_q = (q.meta >> 16) & 0xFF  # take_small clamps to [0, n_nodes)
+    del n_nodes
+    return is_fault_q | ~take_small(paused, dst_q)

@@ -6,9 +6,11 @@ replication over the engine's simulated network, with on-device invariant
 checking (election safety, log matching) producing the per-world *bug flag*
 that BASELINE.json's time-to-first-bug metric measures. All state is
 fixed-shape int32 arrays, all control flow is ``lax`` primitives, and all
-node indexing goes through the one-hot helpers in engine/lanes.py (no
-gather/scatter HLOs), so the whole cluster steps inside one fused XLA
-program and vmaps over thousands of worlds.
+node-indexed *writes* go through the one-hot helpers in engine/lanes.py
+(no scatter HLOs) while *reads* use tiny-source gathers
+(:func:`~madsim_tpu.engine.lanes.take_small` — same values bitwise, a
+fraction of the one-hot contraction's op count), so the whole cluster
+steps inside one fused XLA program and vmaps over thousands of worlds.
 
 Fault tolerance matches the host model: node kill drops timers via the
 engine's generation counters; restart preserves persistent state
@@ -29,7 +31,7 @@ import jax.numpy as jnp
 
 from .actor_util import bcast_payload, make_outbox, pad_payload
 from .core import EngineConfig, Outbox
-from .lanes import sel, sel2, sel_many, upd, upd2
+from .lanes import take_small, upd, upd2
 from .queue import Event, FLAG_TIMER, INF_TIME
 from .rng import DevRng, uniform_u32
 
@@ -156,7 +158,7 @@ class RaftActor:
         r = self.rcfg
         n = r.n
         me = jnp.clip(node, 0, n - 1)
-        epoch2 = sel(s.elect_epoch, me) + 1
+        epoch2 = take_small(s.elect_epoch, me) + 1
         s = s._replace(
             role=upd(s.role, me, FOLLOWER),
             votes=upd(s.votes, me, 0),
@@ -214,27 +216,27 @@ class RaftActor:
 
         # -- shared step-down (the four message kinds carrying a term) --
         sd = is_rv | is_vr | is_ap | is_ar
-        term_pre = sel(s.term, me)
-        role_pre = sel(s.role, me)
+        term_pre = take_small(s.term, me)
+        role_pre = take_small(s.role, me)
         higher = sd & (t > term_pre)
         demote = higher | (is_ap & (t == term_pre) & (role_pre == CANDIDATE))
         s = s._replace(
             term=upd(s.term, me, jnp.where(higher, t, term_pre)),
             voted_for=upd(s.voted_for, me,
-                          jnp.where(higher, -1, sel(s.voted_for, me))),
+                          jnp.where(higher, -1, take_small(s.voted_for, me))),
             role=upd(s.role, me, jnp.where(demote, FOLLOWER, role_pre)),
         )
 
         # -- shared views of the post-step-down row --
-        term_me = sel(s.term, me)
-        role_me = sel(s.role, me)
-        voted_me = sel(s.voted_for, me)
-        votes_me = sel(s.votes, me)
-        commit_me = sel(s.commit, me)
-        llen_me = sel(s.log_len, me)
-        epoch_me = sel(s.elect_epoch, me)
-        log_term_row = sel(s.log_term, me)   # (L,)
-        log_cmd_row = sel(s.log_cmd, me)     # (L,)
+        term_me = take_small(s.term, me)
+        role_me = take_small(s.role, me)
+        voted_me = take_small(s.voted_for, me)
+        votes_me = take_small(s.votes, me)
+        commit_me = take_small(s.commit, me)
+        llen_me = take_small(s.log_len, me)
+        epoch_me = take_small(s.elect_epoch, me)
+        log_term_row = take_small(s.log_term, me)   # (L,)
+        log_cmd_row = take_small(s.log_cmd, me)     # (L,)
         my_last_term = self._row_term_at(log_term_row, llen_me)
         reject = t < term_me  # rv/ap stale-term test
 
@@ -272,7 +274,7 @@ class RaftActor:
         node_won_term = jnp.any((s.won_terms & term_mask[None, :]) != 0,
                                 axis=1)                           # (N,)
         hist_bug = win & jnp.any((jnp.arange(n) != me) & node_won_term)
-        my_won = sel(s.won_terms, me)                             # (W,)
+        my_won = take_small(s.won_terms, me)                      # (W,)
 
         # -- append --
         leader = jnp.clip(p[1], 0, n - 1)
@@ -284,8 +286,9 @@ class RaftActor:
         idx = prev_idx + 1
         write = success & (n_ent > 0) & (idx <= L)
         pos_ap = jnp.clip(idx - 1, 0, L - 1)
-        same = (idx <= llen_me) & (sel(log_term_row, pos_ap) == e_term) & \
-               (sel(log_cmd_row, pos_ap) == e_cmd)
+        same = (idx <= llen_me) & \
+               (take_small(log_term_row, pos_ap) == e_term) & \
+               (take_small(log_cmd_row, pos_ap) == e_cmd)
         new_len_ap = jnp.where(write, jnp.where(same, llen_me, idx), llen_me)
         match_ap = jnp.where(write, idx, jnp.where(success, prev_idx, 0))
         commit_ap = jnp.where(success,
@@ -303,14 +306,14 @@ class RaftActor:
         live_ar = is_ar & (role_me == LEADER) & (t == term_me)
         ok_ar = live_ar & (p[1] != 0)
         fail_ar = live_ar & (p[1] == 0)
-        cur_match = sel2(s.match_idx, me, follower)
-        cur_next = sel2(s.next_idx, me, follower)
+        cur_match = take_small(take_small(s.match_idx, me), follower)
+        cur_next = take_small(take_small(s.next_idx, me), follower)
         match2 = jnp.maximum(cur_match, p[2])
 
         # -- one combined log write (append XOR propose position) --
         pos = jnp.where(is_ap, pos_ap, pos_pr)
-        lt_at = sel(log_term_row, pos)
-        lc_at = sel(log_cmd_row, pos)
+        lt_at = take_small(log_term_row, pos)
+        lc_at = take_small(log_cmd_row, pos)
         lt_new = jnp.where(write, e_term,
                            jnp.where(accept, term_me, lt_at))
         lc_new = jnp.where(write, e_cmd, jnp.where(accept, p[0], lc_at))
@@ -318,8 +321,8 @@ class RaftActor:
         # -- per-row combines --
         arange_n = jnp.arange(n)
         oh_follower = arange_n == follower
-        match_row0 = sel(s.match_idx, me)
-        next_row0 = sel(s.next_idx, me)
+        match_row0 = take_small(s.match_idx, me)
+        next_row0 = take_small(s.next_idx, me)
         match_row = jnp.where(
             win, jnp.where(arange_n == me, llen_me, 0),
             jnp.where(is_ar & oh_follower,
@@ -371,7 +374,22 @@ class RaftActor:
         )
 
         # -- one AppendEntries construction for heartbeat/win/propose --
-        am_valid, am_payload = self._append_msgs(cfg, s2, me)
+        # The me-row views are rebuilt from values already in hand (the
+        # combined log write above) instead of gathered back out of s2:
+        # a gather operand must materialize, and re-reading the freshly
+        # written (N, L) log arrays was pinning two extra full log
+        # buffers into the step's peak memory (docs/perf.md r7).
+        oh_pos = jnp.arange(L) == pos
+        log_term_row2 = jnp.where(oh_pos, lt_new, log_term_row)
+        log_cmd_row2 = jnp.where(oh_pos, lc_new, log_cmd_row)
+        llen_me2 = jnp.where(is_ap, new_len_ap,
+                             jnp.where(is_pr, llen_pr, llen_me))
+        term_me2 = jnp.where(fire, term2, term_me)
+        commit_me2 = jnp.where(is_ap, commit_ap,
+                               jnp.where(is_ar, commit_ar, commit_me))
+        am_valid, am_payload = self._append_msgs(
+            cfg, me, llen_me2, log_term_row2, log_cmd_row2, next_row,
+            term_me2, commit_me2)
         live_hb = is_hb & (role_me == LEADER) & (term_me == p[0])
 
         # -- outbox: one combined build --
@@ -426,15 +444,22 @@ class RaftActor:
         # two wins of T, and roles only become LEADER via a win. Dropping
         # the pairwise scan here saves O(N^2) per step with identical bug
         # flags and timing (verified bitwise against the scanning version).
-        # Log matching on committed prefixes (on_commit analog).
-        L = self.rcfg.log_cap
-        k = jnp.arange(L)
-        lim = jnp.minimum(s.commit[:, None], s.commit[None, :])  # (N, N)
-        mask = k[None, None, :] < lim[:, :, None]
-        diff = (s.log_term[:, None, :] != s.log_term[None, :, :]) | \
-               (s.log_cmd[:, None, :] != s.log_cmd[None, :, :])
-        log_mismatch = jnp.any(mask & diff)
-        return log_mismatch
+        # Log matching on committed prefixes (on_commit analog). The
+        # check is symmetric and trivially true on the diagonal, so it
+        # runs over the N(N-1)/2 ordered pairs (a static unroll) instead
+        # of the full (N, N, L) broadcast — same bug flag, under half the
+        # per-step lanes. This runs on EVERY step (it is the bug flag),
+        # so its op count is hot-loop cost (docs/perf.md r7).
+        n = self.rcfg.n
+        k = jnp.arange(self.rcfg.log_cap)
+        bad = jnp.asarray(False)
+        for i in range(n):
+            for j in range(i + 1, n):
+                lim = jnp.minimum(s.commit[i], s.commit[j])
+                diff = (s.log_term[i] != s.log_term[j]) | \
+                       (s.log_cmd[i] != s.log_cmd[j])
+                bad = bad | jnp.any((k < lim) & diff)
+        return bad
 
     # ------------------------------------------------------------------
     # Protocol: observation
@@ -454,28 +479,31 @@ class RaftActor:
     def _row_term_at(self, log_term_row, idx):
         L = self.rcfg.log_cap
         pos = jnp.clip(idx - 1, 0, L - 1)
-        return jnp.where(idx <= 0, 0, sel(log_term_row, pos))
+        return jnp.where(idx <= 0, 0, take_small(log_term_row, pos))
 
-    def _append_msgs(self, cfg, s: RaftState, me):
-        """Per-peer AppendEntries payloads from the leader's next_idx row."""
+    def _append_msgs(self, cfg, me, llen_me, log_term_row, log_cmd_row,
+                     next_row, term_me, commit_me):
+        """Per-peer AppendEntries payloads from the leader's next_idx row.
+
+        Takes the leader's post-update row VIEWS (scalars and (L,)/(N,)
+        rows the handler already holds) rather than the whole state — see
+        the call site for why re-gathering them from the updated (N, L)
+        arrays costs peak memory."""
         r = self.rcfg
         n, L = r.n, r.log_cap
-        llen_me = sel(s.log_len, me)
-        log_term_row = sel(s.log_term, me)            # (L,)
-        log_cmd_row = sel(s.log_cmd, me)              # (L,)
-        nxt = jnp.clip(sel(s.next_idx, me), 1, L + 1)  # (N,)
+        nxt = jnp.clip(next_row, 1, L + 1)             # (N,)
         prev = nxt - 1
         prev_term = jnp.where(
-            prev <= 0, 0, sel_many(log_term_row, jnp.clip(prev - 1, 0, L - 1)))
+            prev <= 0, 0, take_small(log_term_row, jnp.clip(prev - 1, 0, L - 1)))
         have = nxt <= llen_me                          # entry to ship?
         pos = jnp.clip(nxt - 1, 0, L - 1)
-        e_term = jnp.where(have, sel_many(log_term_row, pos), 0)
-        e_cmd = jnp.where(have, sel_many(log_cmd_row, pos), 0)
-        term = jnp.full((n,), sel(s.term, me), jnp.int32)
+        e_term = jnp.where(have, take_small(log_term_row, pos), 0)
+        e_cmd = jnp.where(have, take_small(log_cmd_row, pos), 0)
+        term = jnp.full((n,), term_me, jnp.int32)
         payload = jnp.stack([
             term, jnp.full((n,), me, jnp.int32), prev, prev_term,
             have.astype(jnp.int32), e_term, e_cmd,
-            jnp.full((n,), sel(s.commit, me), jnp.int32),
+            jnp.full((n,), commit_me, jnp.int32),
         ], axis=1)
         pad = jnp.zeros((n, cfg.payload_words - 8), jnp.int32)
         return jnp.arange(n) != me, jnp.concatenate([payload, pad], axis=1)

@@ -36,7 +36,8 @@ from ..engine.core import DeviceEngine, EngineConfig, WorldState
 from .mesh import seed_mesh, shard_worlds, world_sharding, world_spec
 
 
-def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
+def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
+                   donate: bool = False):
     """Compile a chunk runner: state → (state, any_bug, n_active).
 
     The body is `shard_map`'d so each device advances only its world shard
@@ -44,12 +45,19 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
     over ALL mesh axes — ICI within a host, DCN across hosts on a 2-D
     ``multihost_mesh`` — the only cross-chip communication in a sweep.
 
-    Runners are cached per (mesh, chunk_steps) on the engine, so repeated
-    sweeps reuse the compiled program instead of paying a fresh XLA compile
-    for an identical closure.
+    ``donate=True`` donates the input state: XLA updates the sharded
+    batch in place instead of double-buffering it, which roughly doubles
+    the W that fits in HBM — but the caller's reference is DEAD after
+    each call. The sweep enables this exactly when no checkpoint writer
+    is attached: the async checkpointer reads the pre-chunk state from a
+    background thread, which donation would invalidate.
+
+    Runners are cached per (mesh, chunk_steps, donate) on the engine, so
+    repeated sweeps reuse the compiled program instead of paying a fresh
+    XLA compile for an identical closure.
     """
     cache = eng.__dict__.setdefault("_sharded_runner_cache", {})
-    key = (mesh, chunk_steps)
+    key = (mesh, chunk_steps, donate)
     if key in cache:
         return cache[key]
     spec = world_spec(mesh)
@@ -69,7 +77,7 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512):
     except TypeError:  # pragma: no cover — older jax
         mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
                            out_specs=(spec, P(), P()), check_rep=False)
-    runner = jax.jit(mapped)
+    runner = jax.jit(mapped, donate_argnums=(0,) if donate else ())
     cache[key] = runner
     return runner
 
@@ -83,7 +91,9 @@ class _AsyncCheckpointer:
     snapshot arrives, the queued-but-unstarted one is replaced — for
     preemption survival only the newest durable state matters, and write
     cadence must not backpressure the sweep. Reading completed jax arrays
-    from this thread is safe (the runner does not donate its inputs), and
+    from this thread is safe: whenever a writer is attached the sweep
+    compiles its chunk runner WITHOUT input donation (donation would hand
+    XLA the submitted buffers mid-read — see ``sharded_engine``), and
     the on-disk write stays atomic (engine/checkpoint.py tmp+rename).
     """
 
@@ -221,6 +231,14 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     resumed trajectories equal an unbroken run's (the state carries every
     RNG cursor and queue). ``max_steps`` counts steps issued by THIS call.
 
+    Donation caveat: without checkpointing, the chunk runner DONATES its
+    input state (XLA steps the batch in place — roughly double the W per
+    HBM; a donated state is dead after the call). Checkpointing turns
+    donation off, because the async writer still reads the submitted
+    pre-chunk state while the next chunk runs — so a checkpointed sweep
+    keeps the old double-buffered peak. Budget W accordingly when
+    enabling ``checkpoint_path``.
+
     ``compact``: straggler compaction (docs/perf.md "the straggler
     tail"). A chunked batch runs until its SLOWEST world finishes, so
     once most worlds are done the chip mostly advances frozen state.
@@ -336,10 +354,13 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     else:
         state = shard_worlds(
             eng.init(seeds_p[:w0], faults=batch_faults(np.arange(w0))), mesh)
-    runner = sharded_engine(eng, mesh, chunk_steps)
 
     writer = (_AsyncCheckpointer(eng, checkpoint_path, seeds_meta)
               if checkpoint_path else None)
+    # Donate the chunk state unless a checkpoint writer holds references
+    # to it between chunks (the writer reads the submitted pytree from a
+    # background thread; donating would hand XLA its buffers mid-read).
+    runner = sharded_engine(eng, mesh, chunk_steps, donate=writer is None)
     compact = compact and writer is None  # shrunken state cannot resume
     steps = 0
     chunks = 0
@@ -498,6 +519,13 @@ def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
     ``device_put`` reshard afterwards — the host contributes only the
     ``n_active`` scalar the chunk runner already returned. Shrink widths
     are power-of-two buckets, so at most log2(W) programs compile.
+
+    Deliberately NOT donated: the permutation is a gather, whose output
+    XLA can never alias onto its input (an in-place permute would read
+    clobbered rows), so donating here frees nothing and trips the
+    "donated buffer not usable" warning on every leaf. Compaction
+    transiently holds two batches; the chunk runner — where the state
+    lives 99% of the time — is the donated path.
     """
     cache = eng.__dict__.setdefault("_compactor_cache", {})
     key = (mesh, w, new_w)

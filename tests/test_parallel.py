@@ -258,3 +258,50 @@ def test_recycle_rejects_checkpointing(tmp_path):
     with pytest.raises(ValueError, match="recycle"):
         sweep(None, ECFG, np.arange(16), engine=eng, recycle=True,
               batch_worlds=8, checkpoint_path=str(tmp_path / "x.npz"))
+
+
+def test_recycled_sweep_zero_recompiles_after_warmup():
+    """Jit-cache reuse guard for DeviceEngine.__init__'s claims: a full
+    recycled sweep (chunk runner + on-device compactor + vmapped refill
+    init + refill select + final merge) performs ZERO new XLA
+    compilations once an identical sweep has warmed the caches — counted
+    via jax.log_compiles. A regression here (e.g. a jit object rebuilt
+    per call, or a cache key that includes a fresh object) would silently
+    pay seconds of recompiles on every sweep in a hunt loop."""
+    import logging
+
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_500_000, stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(96)
+
+    def run():
+        return sweep(None, cfg, seeds, engine=eng, chunk_steps=64,
+                     max_steps=10_000, recycle=True, batch_worlds=32)
+
+    first = run()  # warmup: compiles runner, compactors, init, refill
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture(level=logging.WARNING)
+    jax_logger = logging.getLogger("jax")
+    jax_logger.addHandler(handler)
+    try:
+        with jax.log_compiles():
+            second = run()
+    finally:
+        jax_logger.removeHandler(handler)
+
+    compiles = [m for m in records if "Finished XLA compilation" in m]
+    assert not compiles, (
+        f"{len(compiles)} new compilations in a warmed recycled sweep:\n"
+        + "\n".join(compiles[:5]))
+    # Same sweep, same results — the cached programs are the right ones.
+    for key in first.observations:
+        np.testing.assert_array_equal(first.observations[key],
+                                      second.observations[key], err_msg=key)
