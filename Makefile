@@ -45,6 +45,13 @@ smoke:
 	      'argument_size_bytes','aliased_bytes'}; \
 	assert all(isinstance(x,dict) and need<=set(x) for x in xc), \
 	    f'xla_cost records missing/incomplete: {xc}'; \
+	sl=[d['configs'][k].get('sweep_loop') for k in \
+	    ('time_to_first_bug','madraft_5node')]; \
+	sneed={'device_wait_s','host_decision_s','dispatch_depth', \
+	       'dispatches_per_seed','chunks','dispatches', \
+	       'chunks_per_dispatch','loop_wall_s'}; \
+	assert all(isinstance(x,dict) and sneed<=set(x) for x in sl), \
+	    f'sweep_loop records missing/incomplete: {sl}'; \
 	print('bench_results.json ok:', d['metric'])"
 
 dryrun:

@@ -581,8 +581,11 @@ def bench_madraft_5node(n_worlds: int) -> dict:
                             propose_interval_us=200_000)
     # Measured high-water mark: 58 slots over 100k fault-scheduled seeds;
     # 64 runs ~13% faster than 80 and the overflow assert below guards the
-    # headroom. chunk_steps=512 beat 128 (per-chunk sync costs more than
-    # the masked tail steps it saves at max-steps ~844).
+    # headroom. chunk_steps: 512 used to beat 128 because each chunk cost
+    # a host sync; with superstepped dispatch (r8) the host pays one
+    # dispatch per ~K chunks, so fine chunks now WIN — 16 measured ~15%
+    # faster than 512 (utilization 0.94 vs 0.77: stragglers waste <16
+    # masked steps instead of <512) at a 5.9x chunk-per-dispatch fold.
     cfg = EngineConfig(n_nodes=5, outbox_cap=6, queue_cap=64,
                        t_limit_us=t_limit_us)
     eng = DeviceEngine(RaftActor(rcfg), cfg)
@@ -599,11 +602,11 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     # specializes on shapes; a smaller warmup batch would leave the real
     # compile inside the timed window).
     res = sweep(None, cfg, np.arange(n_worlds), faults=faults, engine=eng,
-                chunk_steps=512, max_steps=20_000)
+                chunk_steps=16, max_steps=20_000)
 
     t0 = walltime.perf_counter()
     res = sweep(None, cfg, np.arange(n_worlds), faults=faults, engine=eng,
-                chunk_steps=512, max_steps=20_000)
+                chunk_steps=16, max_steps=20_000)
     dt = walltime.perf_counter() - t0
 
     obs = res.observations
@@ -623,6 +626,10 @@ def bench_madraft_5node(n_worlds: int) -> dict:
            "world_utilization": round(res.world_utilization, 4),
            "n_chunks": int(hist.size),
            "n_active_history": [int(x) for x in hist],
+           # Orchestration breakdown of the timed sweep (docs/perf.md
+           # "Pipelined orchestration"): dispatch counts, superstep
+           # fan-in, and the host/device wall split of the chunk loop.
+           "sweep_loop": res.loop_stats,
            "xla_cost": xla_cost}
     log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
     return out
@@ -761,9 +768,13 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
                          t_limit_us=2_000_000, stop_on_bug=True)
     eng_s = DeviceEngine(RaftActor(rcfg_s), cfg_s)
     batch_w = max(256, device_worlds // 8)
+    # chunk_steps=64 (was 256): with supersteps the host no longer pays a
+    # dispatch+sync per chunk, so fine-grained chunks are affordable and
+    # buy 4x finer on-device stop_on_first_bug granularity — the device
+    # exits within 64 steps of the first detection instead of 256.
     t0 = walltime.perf_counter()
     res = device_sweep(None, cfg_s, np.arange(device_worlds), engine=eng_s,
-                       chunk_steps=256, max_steps=4_000,
+                       chunk_steps=64, max_steps=4_000,
                        stop_on_first_bug=True, recycle=True,
                        batch_worlds=batch_w)
     recycled_dt = walltime.perf_counter() - t0
@@ -800,6 +811,11 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         # regression axis; docs/perf.md "Single-pass insert + donation").
         "xla_cost": xla_cost,
         "recycled_hunt": recycled,
+        # Orchestration breakdown of the recycled hunt's chunk loop
+        # (docs/perf.md "Pipelined orchestration"): the acceptance axes
+        # are host_decision_s vs loop_wall_s (stall fraction) and
+        # chunks_per_dispatch (superstep fan-in).
+        "sweep_loop": res.loop_stats,
         # Statistical gate (docs/perf.md): Wilson-CI overlap, with a
         # bounded model-difference allowance (the two engines share the
         # bug mechanism, not the timing model) — replaces the toothless

@@ -43,6 +43,11 @@ def main(n_worlds: int = 4096) -> None:
           f"{n_bug} seeds violate election safety "
           f"(world utilization {res.world_utilization:.0%} over "
           f"{res.n_active_history.size} chunks)")
+    st = res.loop_stats
+    print(f"orchestration: {st['chunks']} chunks in {st['dispatches']} host "
+          f"dispatches ({st['chunks_per_dispatch']}x superstep fan-in); "
+          f"host decision stall {st['host_decision_s']:.3f}s + device wait "
+          f"{st['device_wait_s']:.3f}s of {st['loop_wall_s']:.3f}s loop wall")
     if not res.failing_seeds:
         print("no failing seeds in this sweep — try more worlds")
         return

@@ -184,6 +184,54 @@ def _step(state: BridgeState, net_k0, net_k1,
                               more_due=more_due)
 
 
+class DrainOut(NamedTuple):
+    """Outputs of a pop-only drain round, as DEVICE arrays (lazy): the
+    driver materializes them with ``np.asarray`` at use, after the next
+    drain is already in the queue."""
+
+    event_seq: object    # i64[W, K] — popped seqs (host dispatch key)
+    event_valid: object  # bool[W, K]
+    more_due: object     # bool[W] — still >K events due
+
+
+def _drain_step(state: BridgeState, *, cap: int, k_events: int):
+    """Pop-only kernel for drain rounds: no cancels, no timers, no sends,
+    no clock advance — exactly what a zero-width ``advance=False``
+    :func:`_step` round did, minus the dead scatter machinery.
+
+    Every input is device-resident (the kernel state), which is what lets
+    the sweep driver dispatch drain round r+1 BEFORE round r's popped
+    events are unpacked and fired on the host (dispatch-ahead): a drain
+    dispatched when nothing is due pops nothing and leaves the lanes
+    semantically untouched, so the one speculative round at the end of a
+    drain chain is a no-op by construction.
+    """
+    import jax.numpy as jnp
+
+    W = state.clock.shape[0]
+    lane_dl, lane_seq = state.lane_dl, state.lane_seq
+    clock = state.clock
+    row = jnp.arange(W)
+    ev_seq, ev_valid = [], []
+    for _ in range(k_events):
+        live = lane_dl[:, :cap]
+        m = live.min(axis=1)
+        is_due = m <= clock
+        cand = jnp.where(live == m[:, None], lane_seq[:, :cap],
+                         jnp.int64(INF_NS))
+        j = jnp.argmin(cand, axis=1)
+        ev_seq.append(lane_seq[row, j])
+        ev_valid.append(is_due)
+        lane_dl = lane_dl.at[row, jnp.where(is_due, j, cap)].set(
+            jnp.int64(INF_NS))
+    event_seq = jnp.stack(ev_seq, axis=1)
+    event_valid = jnp.stack(ev_valid, axis=1)
+    more_due = lane_dl[:, :cap].min(axis=1) <= clock
+    new_state = BridgeState(clock=clock, lane_dl=lane_dl, lane_seq=lane_seq)
+    return new_state, DrainOut(event_seq=event_seq, event_valid=event_valid,
+                               more_due=more_due)
+
+
 # One jitted step per (cap, k_events), shared by every kernel instance:
 # a fresh jax.jit object per sweep would re-trace and re-compile (~0.8 s
 # on CPU XLA for this unrolled kernel) on every sweep() call in a process.
@@ -194,6 +242,7 @@ def _step(state: BridgeState, net_k0, net_k1,
 # and nothing else holds the previous state (``reset_slot`` only ever
 # touches the current one).
 _STEP_CACHE: dict = {}
+_DRAIN_CACHE: dict = {}
 
 
 class BridgeKernel:
@@ -253,6 +302,13 @@ class BridgeKernel:
                                                      k_events=k_events),
                                    donate_argnums=(0,))
                 _STEP_CACHE[(cap, k_events)] = self._fn
+            self._drain_fn = _DRAIN_CACHE.get((cap, k_events))
+            if self._drain_fn is None:
+                self._drain_fn = jax.jit(
+                    functools.partial(_drain_step, cap=cap,
+                                      k_events=k_events),
+                    donate_argnums=(0,))
+                _DRAIN_CACHE[(cap, k_events)] = self._drain_fn
 
     def reset_slot(self, slot: int, seed: int) -> None:
         """Recycle one world slot for a fresh seed: re-derive its NET
@@ -278,6 +334,18 @@ class BridgeKernel:
                 lane_dl=st.lane_dl.at[slot].set(jnp.int64(INF_NS)),
                 lane_seq=st.lane_seq.at[slot].set(0),
             )
+
+    def drain(self) -> DrainOut:
+        """Dispatch one pop-only drain round and return LAZY device
+        outputs (materialize with ``np.asarray`` at use). The round's
+        only input is the device-resident kernel state, so the driver can
+        enqueue drain r+1 before unpacking round r's events — and a
+        speculatively dispatched round that finds nothing due is a
+        semantic no-op on the lanes."""
+        with self._jax.default_device(self.device), self._enable_x64():
+            state, out = self._drain_fn(self.state)
+            self.state = state
+            return out
 
     def step(self, batch: HostBatch) -> StepOut:
         import jax.numpy as jnp

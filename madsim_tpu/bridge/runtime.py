@@ -517,16 +517,6 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             buf[15].fill(False)  # s_live
         return buf
 
-    zero_i32 = np.zeros((W, 0), np.int32)
-    drain_batch_tail = (
-        zero_i32, np.zeros((W, 0), np.int64), np.zeros((W, 0), np.int64),
-        np.zeros((W, 0), np.bool_), zero_i32, np.zeros((W, 0), np.bool_),
-        np.zeros((W, 0), np.uint64), np.zeros((W, 0), np.int64), zero_i32,
-        np.zeros((W, 0), np.int64), np.zeros((W, 0), np.uint64),
-        np.zeros((W, 0), np.bool_), np.zeros((W, 0), np.int64),
-        np.ones((W, 0), np.int64), np.zeros((W, 0), np.bool_),
-        np.zeros((W, 0), np.bool_))
-    no_advance = np.zeros((W,), np.bool_)
     while pending or next_pos < n:
         # -- build the padded round batch ---------------------------------
         t0 = _clk()
@@ -616,13 +606,23 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 woke.append(w)
 
         # -- drain rounds: >K events due fire before any poll runs --------
-        while np.any(out.more_due[list(pending)] if pending else False):
-            # Drain batches are zero-width: anything a fire() callback
+        # Pop-only kernel + dispatch-ahead (docs/perf.md "Pipelined
+        # orchestration"): a drain round's only input is the
+        # device-resident kernel state, so round r+1 enters the device
+        # queue BEFORE round r's popped events are unpacked and fired on
+        # the host. The one speculative round at chain end finds nothing
+        # due and pops nothing — a semantic no-op on the lanes.
+        more = out.more_due
+        inflight_drain = (kernel.drain()
+                          if pending and np.any(more[list(pending)])
+                          else None)
+        while inflight_drain is not None:
+            # Drain rounds carry no host batch: anything a fire() callback
             # recorded would silently miss its own due cluster and fire in
             # the wrong order vs the host heap. No framework callback does
             # that today — enforce it rather than assume it.
             for w in slots:
-                if w.done or not out.more_due[w.slot]:
+                if w.done or not more[w.slot]:
                     continue
                 t = w.rt.time
                 assert not (t.pending_add or t.sends or t.cancels), (
@@ -630,20 +630,26 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                     "recorded timers/sends during event dispatch")
             if profile is not None:
                 profile["drain_rounds"] += 1
-            drained = kernel.step(HostBatch(
-                *drain_batch_tail, np.asarray(out.clock), no_advance))
+            cur = inflight_drain
+            # Dispatch-ahead: queue the next round before materializing
+            # this one's events (the device pops while the host fires).
+            inflight_drain = kernel.drain()
+            ev_valid = np.asarray(cur.event_valid)
+            ev_seq = np.asarray(cur.event_seq)
             for w in slots:
                 i = w.slot
-                if w.done or not out.more_due[i]:
+                if w.done or not more[i]:
                     continue
                 with context.enter_handle(w.rt.handle):
-                    for k in range(drained.event_valid.shape[1]):
-                        if not drained.event_valid[i, k]:
+                    for k in range(ev_valid.shape[1]):
+                        if not ev_valid[i, k]:
                             break
-                        w.rt.time.fire(int(drained.event_seq[i, k]))
+                        w.rt.time.fire(int(ev_seq[i, k]))
                         if profile is not None:
                             profile["events"] += 1
-            out = drained
+            more = np.asarray(cur.more_due)
+            if not (pending and np.any(more[list(pending)])):
+                break  # the in-flight round is the no-op tail
 
         if profile is not None:
             profile["settle_s"] += _clk() - t0

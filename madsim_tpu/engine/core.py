@@ -621,6 +621,77 @@ class DeviceEngine:
         """
         return self._run_steps(state, k)
 
+    def _superstep_impl(self, state: WorldState, stop_threshold,
+                        stop_on_bug, k_chunks, *, chunk_steps: int,
+                        k_max: int, reduce_sum, min_one: bool = False):
+        """Up to ``k_chunks`` chunk bodies under ONE ``lax.while_loop``.
+
+        This is the device half of the pipelined sweep orchestration
+        (parallel/sweep.py): instead of one host dispatch per chunk, the
+        host dispatches a *superstep* of K chunks and the early-exit
+        decisions the serial loop made between chunks run ON DEVICE —
+        the loop stops after the first chunk where the (reduced) active
+        count drops to ``stop_threshold`` or, with ``stop_on_bug`` set,
+        any world's bug flag rises. Threshold, stop flag AND ``k_chunks``
+        are *traced scalars* (only the ``k_max`` history-buffer width is
+        static), so ONE compiled program serves every threshold and
+        superstep length the sweep cycles through — the loop bound of a
+        ``lax.while_loop`` is dynamic anyway, and keying compiles on K
+        would re-pay the whole step-body compile per ramp value.
+
+        The condition is checked BEFORE the first chunk too: a superstep
+        dispatched against a state that already satisfies a stop
+        condition is a bitwise pass-through (zero chunks run). That
+        no-op-by-construction property is what lets the sweep dispatch
+        superstep k+1 before reading superstep k's scalars without ever
+        advancing a world the serial loop would not have advanced.
+
+        ``min_one`` (static) forces the FIRST chunk to run regardless of
+        the entry condition — the serial loop's exact cadence right
+        after a refill/shrink (it always runs one chunk before
+        re-evaluating occupancy, even when the refilled count is already
+        at the threshold). The sweep sets it on the first dispatch of
+        each occupancy epoch; speculative dispatch-ahead supersteps keep
+        ``min_one=False`` so stale ones stay pass-through no-ops.
+
+        ``reduce_sum`` reduces a per-shard int32 scalar over the world
+        axis — ``lax.psum`` inside a shard_mapped sweep, ``jnp.sum``'s
+        identity under plain vmap use. Returns ``(state, any_bug,
+        n_active, k_done, hist)`` where ``hist[j]`` is the active count
+        measured after chunk ``j`` (-1 for chunks not run), exactly the
+        per-chunk sequence the serial loop observed.
+        """
+        def measure(s):
+            any_bug = reduce_sum(jnp.any(s.bug).astype(jnp.int32)) > 0
+            n_active = reduce_sum(jnp.sum(s.active.astype(jnp.int32)))
+            return any_bug, n_active
+
+        stop_threshold = jnp.asarray(stop_threshold, jnp.int32)
+        stop_on_bug = jnp.asarray(stop_on_bug, bool)
+        k_chunks = jnp.minimum(jnp.asarray(k_chunks, jnp.int32), k_max)
+        any_bug0, n_active0 = measure(state)
+        hist0 = jnp.full((k_max,), -1, jnp.int32)
+
+        def cond(carry):
+            _s, i, any_bug, n_active, _hist = carry
+            run_more = ((n_active > stop_threshold)
+                        & ~(stop_on_bug & any_bug))
+            if min_one:
+                run_more = (i == 0) | run_more
+            return (i < k_chunks) & run_more
+
+        def body(carry):
+            s, i, _any_bug, _n_active, hist = carry
+            s = self._run_steps_impl(s, chunk_steps)
+            any_bug, n_active = measure(s)
+            hist = jax.lax.dynamic_update_index_in_dim(hist, n_active, i, 0)
+            return s, i + 1, any_bug, n_active, hist
+
+        state, k_done, any_bug, n_active, hist = jax.lax.while_loop(
+            cond, body,
+            (state, jnp.int32(0), any_bug0, n_active0, hist0))
+        return state, any_bug, n_active, k_done, hist
+
     def _run_impl(self, state: WorldState, max_steps: int) -> WorldState:
         batched = jax.vmap(self._step_one)
 
@@ -741,8 +812,14 @@ class DeviceEngine:
     # ------------------------------------------------------------------
     # Observation
     # ------------------------------------------------------------------
-    def observe(self, state: WorldState) -> Dict[str, np.ndarray]:
-        """Pull engine metrics (plus the actor's) to host as numpy arrays."""
+    def observe_device(self, state: WorldState) -> Dict[str, jnp.ndarray]:
+        """The observation dict as device values — traceable under jit.
+
+        Same fields as :meth:`observe` with no host conversion, so jitted
+        programs (e.g. the sweep's frozen-tail retirement gather,
+        parallel/sweep.py) can slice observations ON DEVICE and ship only
+        the rows they need across the host boundary.
+        """
         out = {
             "now_us": state.now,
             "active": state.active,
@@ -758,4 +835,15 @@ class DeviceEngine:
             "queue_depth": state.qdepth,
         }
         out.update(self.actor.observe(self.cfg, state.astate))
+        return out
+
+    def observe(self, state: WorldState) -> Dict[str, np.ndarray]:
+        """Pull engine metrics (plus the actor's) to host as numpy arrays.
+
+        One explicit ``device_get`` of the whole dict (not per-field
+        ``np.asarray``), so the pull stays a single, *explicit* transfer
+        under ``jax.transfer_guard`` — the sweep's sync-discipline test
+        counts every device→host crossing.
+        """
+        out = jax.device_get(self.observe_device(state))
         return {k: np.asarray(v) for k, v in out.items()}

@@ -83,6 +83,18 @@ def world_spec(mesh: Mesh) -> P:
     return P(tuple(mesh.axis_names))
 
 
+def scalar_spec() -> P:
+    """PartitionSpec for mesh-replicated scalars.
+
+    The sweep's chunk/superstep runners reduce their control scalars
+    (any-bug, active count, chunks-run) with ``psum`` over every mesh
+    axis, so each comes back identical on all devices; likewise the
+    occupancy threshold and stop flag ride IN replicated. One named
+    helper keeps the in/out specs of both runner flavors in sync.
+    """
+    return P()
+
+
 def world_sharding(mesh: Mesh) -> NamedSharding:
     """The NamedSharding splitting the leading world axis over the mesh.
 

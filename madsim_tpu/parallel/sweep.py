@@ -15,17 +15,28 @@ partition (no host pull of per-world state), and with ``recycle=True``
 retired slots are refilled with fresh seeds from a host-side cursor so
 the mesh stays full for open-ended hunts. Per-chunk occupancy telemetry
 (``n_active_history`` / ``world_utilization``) rides every result.
+
+Orchestration is *pipelined and superstepped* by default (docs/perf.md
+"Pipelined orchestration"): up to ``superstep_max`` chunks fold into one
+jitted ``lax.while_loop`` dispatch whose early-exit decisions (all
+retired / occupancy at the recycle threshold / bug under
+``stop_on_first_bug``) run ON DEVICE, and the host issues superstep k+1
+before reading superstep k's scalars, so the device queue stays non-empty
+while the host decides. A superstep dispatched past a stop/recycle point
+is a bitwise pass-through (its entry condition is already false), which is
+what makes one-dispatch-stale decisions exact rather than approximate:
+results are bit-identical to the serial per-chunk loop (``pipeline=False``,
+kept as the equivalence reference and tier-1-tested against).
 """
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
     from jax import shard_map
@@ -33,7 +44,19 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 from ..engine.core import DeviceEngine, EngineConfig, WorldState
-from .mesh import seed_mesh, shard_worlds, world_sharding, world_spec
+from .mesh import (
+    scalar_spec,
+    seed_mesh,
+    shard_worlds,
+    world_sharding,
+    world_spec,
+)
+
+# Every device→host pull the sweep loop makes goes through this hook, so
+# the tier-1 sync-discipline test (tests/test_sweep_pipeline.py) can count
+# host-boundary crossings per superstep by monkeypatching it. Semantics:
+# jax.device_get of an arbitrary pytree.
+_fetch = jax.device_get
 
 
 def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
@@ -62,6 +85,7 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
         return cache[key]
     spec = world_spec(mesh)
     axes = tuple(mesh.axis_names)
+    sp = scalar_spec()
 
     def chunk(state: WorldState):
         state = eng._run_steps_impl(state, chunk_steps)
@@ -73,13 +97,80 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
 
     try:  # jax >= 0.8 renamed check_rep -> check_vma
         mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
-                           out_specs=(spec, P(), P()), check_vma=False)
+                           out_specs=(spec, sp, sp), check_vma=False)
     except TypeError:  # pragma: no cover — older jax
         mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
-                           out_specs=(spec, P(), P()), check_rep=False)
+                           out_specs=(spec, sp, sp), check_rep=False)
     runner = jax.jit(mapped, donate_argnums=(0,) if donate else ())
     cache[key] = runner
     return runner
+
+
+def sharded_superstep(eng: DeviceEngine, mesh: Mesh, chunk_steps: int,
+                      k_max: int, donate: bool = False,
+                      min_one: bool = False):
+    """Compile a superstep runner:
+    ``(state, stop_threshold, stop_on_bug, k_chunks) → (state, any_bug,
+    n_active, k_done, hist)``.
+
+    The superstep folds up to ``k_chunks`` chunk bodies into ONE jitted
+    dispatch (`DeviceEngine._superstep_impl`): a ``lax.while_loop`` whose
+    condition re-checks the psum'd occupancy/bug scalars after every
+    chunk, so the early exits the serial loop made from the host run on
+    device and the host pays one dispatch + one scalar read per K chunks.
+    ``stop_threshold`` / ``stop_on_bug`` / ``k_chunks`` are traced
+    scalars — ONE compiled program per (mesh, chunk_steps, k_max,
+    donate, min_one) serves every threshold and superstep length the
+    adaptive schedule cycles through; only the (k_max,)-shaped history
+    buffer is compile-time static.
+
+    ``hist[j]`` is the post-chunk active count for each chunk actually
+    run (-1 beyond ``k_done``) — the same per-chunk sequence the serial
+    loop's ``n_active_history`` records. ``min_one`` forces the first
+    chunk regardless of the entry condition (the serial loop's cadence
+    right after a refill/shrink — see ``_superstep_impl``). Donation
+    follows :func:`sharded_engine` (on exactly when no checkpoint writer
+    holds state references between dispatches).
+    """
+    cache = eng.__dict__.setdefault("_sharded_superstep_cache", {})
+    key = (mesh, chunk_steps, k_max, donate, min_one)
+    if key in cache:
+        return cache[key]
+    spec = world_spec(mesh)
+    axes = tuple(mesh.axis_names)
+    sp = scalar_spec()
+
+    def sstep(state: WorldState, stop_threshold, stop_on_bug, k_chunks):
+        return eng._superstep_impl(
+            state, stop_threshold, stop_on_bug, k_chunks,
+            chunk_steps=chunk_steps, k_max=k_max,
+            reduce_sum=lambda x: jax.lax.psum(x, axes), min_one=min_one)
+
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        mapped = shard_map(sstep, mesh=mesh, in_specs=(spec, sp, sp, sp),
+                           out_specs=(spec, sp, sp, sp, sp),
+                           check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        mapped = shard_map(sstep, mesh=mesh, in_specs=(spec, sp, sp, sp),
+                           out_specs=(spec, sp, sp, sp, sp),
+                           check_rep=False)
+    runner = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    cache[key] = runner
+    return runner
+
+
+class _Flight(NamedTuple):
+    """One dispatched-but-unread superstep: its scalar futures plus the
+    host-side facts (plan, width, epoch) needed to interpret them."""
+
+    any_bug: Any
+    n_active: Any
+    k_done: Any
+    hist: Any
+    planned: int          # chunks this dispatch may run (its K)
+    w: int                # batch width at dispatch time
+    epoch: int            # occupancy epoch at dispatch time
+    out_state: Any        # output state ref — kept ONLY for the writer
 
 
 class _AsyncCheckpointer:
@@ -181,7 +272,7 @@ class SweepResult:
     seeds: np.ndarray            # the (unpadded) seed vector
     bug: np.ndarray              # per-seed bug flag
     observations: Dict[str, np.ndarray]  # engine + actor metrics, per seed
-    steps_run: int               # chunks * chunk_steps issued
+    steps_run: int               # executed chunks * chunk_steps
     n_devices: int
     # Occupancy telemetry (docs/perf.md "world recycling"): the active
     # world count after each chunk, and the fraction of issued slot-steps
@@ -191,6 +282,24 @@ class SweepResult:
     n_active_history: np.ndarray = dataclasses.field(
         default_factory=lambda: np.zeros(0, np.int64))
     world_utilization: float = 0.0
+    # The chunk index each ``n_active_history`` entry was MEASURED at
+    # (0-based count of executed chunks, aligned entrywise). Under the
+    # pipelined loop the host reads a measurement only after dispatching
+    # the next superstep, so the decision taken at dispatch d is based on
+    # the entry measured at some chunk < d — up to one superstep behind.
+    # The measurement sequence itself is per-chunk and identical to the
+    # serial loop's; entries are strictly increasing (tier-1-tested).
+    n_active_chunks: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))
+    # Orchestration telemetry (docs/perf.md "Pipelined orchestration"):
+    # dispatch counts, superstep fan-in, and the host/device wall split
+    # of the chunk loop. Recorded into bench_results.json under
+    # configs.*.sweep_loop. Keys: pipelined, chunks, dispatches,
+    # chunks_per_dispatch, dispatches_per_seed, dispatch_depth,
+    # device_wait_s, host_decision_s, dispatch_s, retire_wait_s,
+    # scalar_fetches, retire_fetches, loop_wall_s, superstep_max,
+    # chunk_steps.
+    loop_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def failing_seeds(self) -> List[int]:
@@ -214,7 +323,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           resume: bool = False,
           compact: bool = False,
           recycle: bool = False,
-          batch_worlds: Optional[int] = None) -> SweepResult:
+          batch_worlds: Optional[int] = None,
+          pipeline: bool = True,
+          superstep_max: int = 16) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     The loop is a slot-occupancy model: the device batch is a fixed set of
@@ -224,12 +335,40 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     many slots are active?" — and every occupancy decision (shrink,
     retire, refill) runs as an on-device program keyed off that count.
 
+    ``pipeline`` (default True): dispatch-ahead, superstepped
+    orchestration (docs/perf.md "Pipelined orchestration"). Up to
+    ``superstep_max`` chunks fold into one jitted dispatch whose early
+    exits (all retired, occupancy at the recycle/compact threshold, bug
+    under ``stop_on_first_bug``) run on device, and the host issues the
+    next superstep BEFORE reading the previous one's scalars, so XLA's
+    async dispatch keeps the device queue non-empty while the host
+    decides. K adapts to the observed retirement rate: it doubles
+    (capped at ``superstep_max``) while supersteps run to plan and
+    settles to the chunks a cut-short superstep actually ran — all
+    inputs are sim outputs, so the dispatch schedule is deterministic
+    per (seeds, config), and K rides as a traced scalar so the schedule
+    never recompiles. A superstep dispatched past a stop/threshold point runs
+    ZERO chunks (its entry condition is false), so one-dispatch-stale
+    occupancy reads never advance, retire, or refill a world the serial
+    loop would not have: results — including retirement attribution —
+    are bitwise identical to ``pipeline=False`` (the serial per-chunk
+    reference loop, tier-1-tested for every actor family). Decisions are
+    additionally epoch-guarded: after a refill/shrink, occupancy reads
+    from supersteps dispatched before it are ignored (they ran zero
+    chunks), so a stale trigger can never re-fire on the slots it just
+    refilled.
+
     Preemption survival: with ``checkpoint_path`` set, the (padded) world
     state is written every ``checkpoint_every_chunks`` chunks (and at the
     end); with ``resume=True`` an existing checkpoint is loaded instead of
     re-initializing, and the sweep continues bit-exactly where it stopped —
     resumed trajectories equal an unbroken run's (the state carries every
     RNG cursor and queue). ``max_steps`` counts steps issued by THIS call.
+    Under pipelining the snapshot cadence is superstep-granular (K caps at
+    ``checkpoint_every_chunks``), and the submitted state is always a
+    COMPLETED superstep output the writer can read while later supersteps
+    run — donation stays disabled whenever a writer is attached, exactly
+    as in the serial loop.
 
     Donation caveat: without checkpointing, the chunk runner DONATES its
     input state (XLA steps the batch in place — roughly double the W per
@@ -247,8 +386,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     computed INSIDE a jitted, mesh-resident program, so no per-world
     state (not even ``state.active``) crosses to the host and no reshard
     round trip follows — retires the frozen tail (its observations are
-    pulled exactly once, as the final observe would have), and continues
-    on a power-of-two-smaller batch. Worlds' trajectories are
+    sliced out ON DEVICE and pulled alone, never the full batch), and
+    continues on a power-of-two-smaller batch. Worlds' trajectories are
     position-independent, so results are bitwise identical to the
     uncompacted run (tested). Disabled automatically when checkpointing
     (a shrunken state cannot resume into the full-shape contract).
@@ -269,8 +408,11 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     state a resume could not re-attribute (raises ``ValueError``).
 
     Occupancy telemetry rides the result: ``SweepResult.n_active_history``
-    (per-chunk active counts) and ``SweepResult.world_utilization``
-    (live-world steps / issued slot-steps, mesh padding included).
+    (per-chunk active counts, with ``n_active_chunks`` recording the
+    chunk index each entry was measured at), ``world_utilization``
+    (live-world steps / issued slot-steps, mesh padding included), and
+    ``loop_stats`` (the dispatch-count / host-stall breakdown of the
+    orchestration loop).
     """
     from ..engine import checkpoint as ckpt
 
@@ -285,6 +427,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             "recycle=True cannot be combined with checkpointing: the seed "
             "cursor and retired observations live on the host, so a "
             "resumed sweep could not re-attribute recycled slots")
+    if superstep_max < 1:
+        raise ValueError("superstep_max must be >= 1")
 
     # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
     # once; recycled sweeps hold batch_worlds slots and stream the rest.
@@ -333,6 +477,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
 
     import hashlib
     import os
+    from time import perf_counter
+
+    def _clk() -> float:
+        # Wall-clock telemetry of the orchestration loop itself (host
+        # side); never feeds a simulation decision.
+        return perf_counter()  # detlint: allow[DET001]
 
     # World identity travels with the checkpoint: resuming under different
     # seeds OR fault schedules would silently attribute results (repro
@@ -360,12 +510,17 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     # Donate the chunk state unless a checkpoint writer holds references
     # to it between chunks (the writer reads the submitted pytree from a
     # background thread; donating would hand XLA its buffers mid-read).
-    runner = sharded_engine(eng, mesh, chunk_steps, donate=writer is None)
+    donate = writer is None
     compact = compact and writer is None  # shrunken state cannot resume
     steps = 0
-    chunks = 0
-    submitted_at = -1  # chunk counter, not an object ref: a pytree ref
-    # here would pin a full extra device state between checkpoints.
+    chunks = 0                         # executed chunk bodies
+    c_max = -(-max_steps // chunk_steps)  # serial loop's chunk budget
+    # Chunk counter at the last writer submission — a counter, not an
+    # object ref: a pytree ref here would pin a full extra device state
+    # between checkpoints. Chunk-count identity implies state identity
+    # under a writer, because recycle is rejected and compact disabled
+    # whenever one is attached (no state change without a chunk).
+    submitted_chunks = -1
     w_cur = w0                         # current batch width (slot count)
     cursor = w0                        # next seed id the stream admits
     # Slot→seed-id map, DEVICE-resident: compaction permutes it with the
@@ -377,8 +532,13 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     retired: Dict[str, list] = {}      # field → retired observation batches
     retired_rows: List[np.ndarray] = []
     n_active_hist: List[int] = []
+    n_active_chunk: List[int] = []     # chunk index each entry measured at
     issued_slot_steps = 0              # sum over chunks of width*chunk_steps
     live_world_steps = 0               # steps that advanced a live world
+    perf = {"device_wait_s": 0.0, "host_decision_s": 0.0, "dispatch_s": 0.0,
+            "retire_wait_s": 0.0, "scalar_fetches": 0, "retire_fetches": 0,
+            "dispatches": 0, "dispatch_depth": 0}
+    t_loop0 = _clk()
 
     def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray) -> None:
         """Record final observations for rows leaving the batch (dead
@@ -395,61 +555,237 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         for k, v in obs_slice.items():
             retired.setdefault(k, []).append(np.asarray(v))
 
+    def fetch_retire(handles) -> None:
+        """Materialize a deferred on-device retirement slice and record
+        it. The pull covers ONLY the (bucketed) frozen-tail rows — the
+        full per-world observation arrays never cross to the host."""
+        obs_t, idx_t, tail_len = handles
+        t0 = _clk()
+        obs_h, idx_h = _fetch((obs_t, idx_t))
+        perf["retire_wait_s"] += _clk() - t0
+        perf["retire_fetches"] += 1
+        retire({k: np.asarray(v)[:tail_len] for k, v in obs_h.items()},
+               np.asarray(idx_h)[:tail_len])
+
+    def do_refill(n_act: int):
+        """World recycling: stable active-first partition on device,
+        retire the frozen tail, refill it with the next seeds from the
+        cursor. Only the n_active scalar (already on host) shapes the
+        refill mask; the tail observations are sliced on device and
+        returned as un-fetched handles so the pull can overlap later
+        dispatches."""
+        nonlocal state, idx, cursor, reordered
+        state, idx = _compactor(eng, mesh, w_cur, w_cur)(state, idx)
+        reordered = True
+        tail_len = w_cur - n_act
+        rows = min(_pow2_at_least(tail_len), _pow2_at_least(w_cur))
+        obs_t, idx_t = _tail_observer(eng, mesh, w_cur, rows)(
+            state, idx, jnp.int32(n_act))
+        take = min(tail_len, n_ids - cursor)
+        repl = np.full(w_cur, -1, np.int32)
+        repl[n_act:n_act + take] = np.arange(
+            cursor, cursor + take, dtype=np.int32)
+        cursor += take
+        mask = np.zeros(w_cur, bool)
+        mask[n_act:n_act + take] = True
+        fill_ids = np.maximum(repl, 0)
+        state = shard_worlds(
+            eng.refill(state, mask, seeds_p[fill_ids],
+                       faults=batch_faults(fill_ids)), mesh)
+        idx = jnp.where(jnp.asarray(np.arange(w_cur) >= n_act),
+                        jnp.asarray(repl), idx)
+        return obs_t, idx_t, tail_len
+
+    def do_shrink(new_w: int):
+        """Shrink compaction, fully on device: permutation, split, and
+        the live batch's mesh placement all happen inside one jitted
+        program (out_shardings = the world sharding). Returns the frozen
+        tail's observation handles, un-fetched."""
+        nonlocal state, idx, reordered, w_cur
+        (state, idx), (frozen, fidx) = \
+            _compactor(eng, mesh, w_cur, new_w)(state, idx)
+        reordered = True
+        tail_len = w_cur - new_w
+        w_cur = new_w
+        obs_t, idx_t = _observer(eng)(frozen, fidx)
+        return obs_t, idx_t, tail_len
+
     try:
-        while steps < max_steps:
-            state, any_bug, n_active = runner(state)
-            steps += chunk_steps
-            chunks += 1
-            issued_slot_steps += w_cur * chunk_steps
-            if writer is not None and checkpoint_every_chunks and \
-                    chunks % checkpoint_every_chunks == 0:
-                # Async: the pull + write overlap the next chunk's device
-                # work; the loop never blocks on the filesystem.
-                writer.submit(state)
-                submitted_at = chunks
-            n_act = int(n_active)
-            n_active_hist.append(n_act)
-            more_seeds = cursor < n_ids
-            if n_act == 0 and not more_seeds:
-                break
-            if stop_on_first_bug and bool(any_bug):
-                break
-            if recycle and more_seeds and n_act <= w_cur // 2:
-                # World recycling: stable active-first partition on
-                # device, retire the frozen tail, refill it with the next
-                # seeds from the cursor. Only the n_active scalar (already
-                # on host) shapes the refill mask.
-                state, idx = _compactor(eng, mesh, w_cur, w_cur)(state, idx)
-                reordered = True
-                obs_full = eng.observe(state)
-                idx_h = np.asarray(jax.device_get(idx))
-                retire({k: v[n_act:] for k, v in obs_full.items()},
-                       idx_h[n_act:])
-                take = min(w_cur - n_act, n_ids - cursor)
-                repl = np.full(w_cur, -1, np.int32)
-                repl[n_act:n_act + take] = np.arange(
-                    cursor, cursor + take, dtype=np.int32)
-                cursor += take
-                mask = np.zeros(w_cur, bool)
-                mask[n_act:n_act + take] = True
-                fill_ids = np.maximum(repl, 0)
-                state = shard_worlds(
-                    eng.refill(state, mask, seeds_p[fill_ids],
-                               faults=batch_faults(fill_ids)), mesh)
-                idx = jnp.where(jnp.asarray(np.arange(w_cur) >= n_act),
-                                jnp.asarray(repl), idx)
-                continue
-            new_w = _compact_bucket(n_act, w_cur, n_dev)
-            if (compact or (recycle and not more_seeds)) and new_w < w_cur:
-                # Shrink compaction, fully on device: permutation, split,
-                # and the live batch's mesh placement all happen inside
-                # one jitted program (out_shardings = the world sharding).
-                (state, idx), (frozen, fidx) = \
-                    _compactor(eng, mesh, w_cur, new_w)(state, idx)
-                reordered = True
-                retire(eng.observe(frozen), np.asarray(jax.device_get(fidx)))
-                w_cur = new_w
-        if writer is not None and submitted_at != chunks:
+        if pipeline:
+            # -- pipelined, superstepped orchestration ---------------------
+            k_cur = 1                  # adaptive superstep size (chunks)
+            epoch = 0                  # bumps on every refill/shrink
+            epoch_fresh = True         # next dispatch is its epoch's first
+            ckpt_mark = 0              # checkpoint cadence periods covered
+            inflight: Optional[_Flight] = None
+            pending_retires: list = []
+            stop = False
+
+            def threshold() -> int:
+                """The on-device early-exit occupancy for the NEXT
+                dispatch: the serial loop's trigger boundary (half the
+                batch) whenever a refill or shrink could actually fire,
+                else 0 (run until all retired)."""
+                if recycle and cursor < n_ids:
+                    return w_cur // 2
+                if ((compact or recycle) and w_cur % 2 == 0
+                        and (w_cur // 2) % n_dev == 0):
+                    return w_cur // 2
+                return 0
+
+            def dispatch() -> None:
+                """Issue one superstep on the CURRENT state (enqueue
+                only — never blocks on device results)."""
+                nonlocal state, inflight, epoch_fresh
+                budget = c_max - chunks - (inflight.planned if inflight
+                                           else 0)
+                k = max(1, min(k_cur, budget, superstep_max))
+                if writer is not None and checkpoint_every_chunks:
+                    k = min(k, checkpoint_every_chunks)
+                # The first dispatch of each occupancy epoch mirrors the
+                # serial cadence exactly: one chunk runs before occupancy
+                # is re-evaluated, even if a refill landed at/below the
+                # threshold. Speculative dispatches keep min_one=False
+                # so a stale one stays a pass-through no-op. K itself is
+                # a traced scalar of the (per min_one variant) single
+                # compiled runner, not a compile key.
+                if epoch_fresh:
+                    k = 1
+                runner = sharded_superstep(eng, mesh, chunk_steps,
+                                           superstep_max, donate,
+                                           min_one=epoch_fresh)
+                epoch_fresh = False
+                t0 = _clk()
+                state, any_bug, n_active, k_done, hist = runner(
+                    state, jnp.int32(threshold()),
+                    jnp.asarray(bool(stop_on_first_bug)), jnp.int32(k))
+                perf["dispatch_s"] += _clk() - t0
+                perf["dispatches"] += 1
+                inflight = _Flight(
+                    any_bug, n_active, k_done, hist, k, w_cur, epoch,
+                    state if writer is not None else None)
+
+            dispatch()
+            while inflight is not None:
+                prev, inflight = inflight, None
+                # Dispatch-ahead: superstep k+1 enters the device queue
+                # BEFORE superstep k's scalars are read, so the device
+                # never idles on host decision latency. If k's scalars
+                # turn out to demand a stop/refill, k+1 is a bitwise
+                # no-op (its entry condition is already false).
+                if not stop and chunks + prev.planned < c_max:
+                    dispatch()
+                t0 = _clk()
+                bug_h, n_act_h, k_done_h, hist_h = _fetch(
+                    (prev.any_bug, prev.n_active, prev.k_done, prev.hist))
+                perf["device_wait_s"] += _clk() - t0
+                perf["scalar_fetches"] += 1
+                perf["dispatch_depth"] = max(
+                    perf["dispatch_depth"], 1 if inflight is not None else 0)
+                # Retirement pulls deferred from earlier refills/shrinks:
+                # drain them here, where the loop blocks anyway.
+                while pending_retires:
+                    fetch_retire(pending_retires.pop(0))
+                t0 = _clk()
+                k_done = int(k_done_h)
+                n_act = int(n_act_h)
+                hist_np = np.asarray(hist_h)
+                for j in range(k_done):
+                    n_active_hist.append(int(hist_np[j]))
+                    n_active_chunk.append(chunks + j)
+                chunks += k_done
+                steps = chunks * chunk_steps
+                issued_slot_steps += prev.w * chunk_steps * k_done
+                if prev.epoch == epoch:
+                    # Superstep sizing adapts to the observed retirement
+                    # rate: double while supersteps run to plan (slow
+                    # start), and after an early exit settle on the
+                    # chunks it actually ran — the measured
+                    # chunks-per-decision of this workload. Deterministic
+                    # — every input is a sim output; and since K is a
+                    # traced scalar, the schedule costs no recompiles.
+                    if k_done == prev.planned:
+                        k_cur = min(k_cur * 2, superstep_max)
+                    else:
+                        k_cur = max(k_done, 1)
+                if writer is not None and checkpoint_every_chunks and \
+                        chunks // checkpoint_every_chunks > ckpt_mark:
+                    # Async: the pull + write overlap later supersteps'
+                    # device work; the submitted state is a COMPLETED
+                    # superstep output (donation is off with a writer).
+                    writer.submit(prev.out_state)
+                    submitted_chunks = chunks
+                    ckpt_mark = chunks // checkpoint_every_chunks
+                if prev.epoch == epoch and not stop:
+                    more_seeds = cursor < n_ids
+                    if n_act == 0 and not more_seeds:
+                        stop = True
+                    elif stop_on_first_bug and bool(bug_h):
+                        stop = True
+                    elif recycle and more_seeds and n_act <= w_cur // 2:
+                        pending_retires.append(do_refill(n_act))
+                        epoch += 1
+                        epoch_fresh = True
+                    else:
+                        new_w = _compact_bucket(n_act, w_cur, n_dev)
+                        if (compact or (recycle and not more_seeds)) \
+                                and new_w < w_cur:
+                            pending_retires.append(do_shrink(new_w))
+                            epoch += 1
+                            epoch_fresh = True
+                perf["host_decision_s"] += _clk() - t0
+                if stop:
+                    break
+                if inflight is None and chunks < c_max:
+                    dispatch()
+            while pending_retires:
+                fetch_retire(pending_retires.pop(0))
+        else:
+            # -- serial per-chunk reference loop ---------------------------
+            runner = sharded_engine(eng, mesh, chunk_steps, donate=donate)
+            while steps < max_steps:
+                t0 = _clk()
+                state, any_bug, n_active = runner(state)
+                perf["dispatch_s"] += _clk() - t0
+                perf["dispatches"] += 1
+                steps += chunk_steps
+                chunks += 1
+                issued_slot_steps += w_cur * chunk_steps
+                if writer is not None and checkpoint_every_chunks and \
+                        chunks % checkpoint_every_chunks == 0:
+                    # Async: the pull + write overlap the next chunk's
+                    # device work; the loop never blocks on the filesystem.
+                    writer.submit(state)
+                    submitted_chunks = chunks
+                t0 = _clk()
+                n_act_h, bug_h = _fetch((n_active, any_bug))
+                perf["device_wait_s"] += _clk() - t0
+                perf["scalar_fetches"] += 1
+                t0 = _clk()
+                n_act = int(n_act_h)
+                n_active_hist.append(n_act)
+                n_active_chunk.append(chunks - 1)
+                more_seeds = cursor < n_ids
+                if n_act == 0 and not more_seeds:
+                    perf["host_decision_s"] += _clk() - t0
+                    break
+                if stop_on_first_bug and bool(bug_h):
+                    perf["host_decision_s"] += _clk() - t0
+                    break
+                if recycle and more_seeds and n_act <= w_cur // 2:
+                    handles = do_refill(n_act)
+                    perf["host_decision_s"] += _clk() - t0
+                    fetch_retire(handles)
+                    continue
+                new_w = _compact_bucket(n_act, w_cur, n_dev)
+                if (compact or (recycle and not more_seeds)) \
+                        and new_w < w_cur:
+                    handles = do_shrink(new_w)
+                    perf["host_decision_s"] += _clk() - t0
+                    fetch_retire(handles)
+                else:
+                    perf["host_decision_s"] += _clk() - t0
+        if writer is not None and submitted_chunks != chunks:
             writer.submit(state)  # the final state is always durable
         if writer is not None:
             writer.flush_and_close()
@@ -459,7 +795,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             writer.flush_and_close(suppress_errors=True)
 
     obs_live = eng.observe(state)
-    idx_h = np.asarray(jax.device_get(idx))
+    idx_h = np.asarray(_fetch(idx))
     live_keep = idx_h >= 0
     live_world_steps += int(np.asarray(obs_live["steps"])[live_keep].sum())
     # Scatter whenever the live batch does not cover the full id space in
@@ -483,10 +819,31 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     obs = {k: v[:n] for k, v in obs.items()}
     util = (live_world_steps / issued_slot_steps if issued_slot_steps
             else 0.0)
+    loop_stats = {
+        "pipelined": bool(pipeline),
+        "superstep_max": int(superstep_max) if pipeline else 1,
+        "chunk_steps": int(chunk_steps),
+        "chunks": int(chunks),
+        "dispatches": int(perf["dispatches"]),
+        "chunks_per_dispatch": round(
+            chunks / max(perf["dispatches"], 1), 3),
+        "dispatches_per_seed": round(
+            perf["dispatches"] / max(n, 1), 6),
+        "dispatch_depth": int(perf["dispatch_depth"]),
+        "device_wait_s": round(perf["device_wait_s"], 6),
+        "host_decision_s": round(perf["host_decision_s"], 6),
+        "dispatch_s": round(perf["dispatch_s"], 6),
+        "retire_wait_s": round(perf["retire_wait_s"], 6),
+        "scalar_fetches": int(perf["scalar_fetches"]),
+        "retire_fetches": int(perf["retire_fetches"]),
+        "loop_wall_s": round(_clk() - t_loop0, 6),
+    }
     return SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
                        steps_run=steps, n_devices=n_dev,
                        n_active_history=np.asarray(n_active_hist, np.int64),
-                       world_utilization=util)
+                       world_utilization=util,
+                       n_active_chunks=np.asarray(n_active_chunk, np.int64),
+                       loop_stats=loop_stats)
 
 
 def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:
@@ -499,6 +856,15 @@ def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:
     while w % 2 == 0 and w // 2 >= max(n_active, 1) and w // 2 % n_dev == 0:
         w //= 2
     return w
+
+
+def _pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (>= 1): bucketed retirement-gather
+    widths, so the tail observer compiles at most log2(W) programs."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
 
 
 @jax.jit
@@ -543,4 +909,44 @@ def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
 
     fn = jax.jit(compacted, out_shardings=world_sharding(mesh))
     cache[key] = fn
+    return fn
+
+
+def _tail_observer(eng: DeviceEngine, mesh: Mesh, w: int, rows: int):
+    """Compile (and cache per engine) the frozen-tail retirement gather.
+
+    One jitted program slices ``rows`` observation rows starting at a
+    dynamic ``start`` out of a width-``w`` batch — gathering INSIDE the
+    device program via ``DeviceEngine.observe_device`` — so retirement
+    pulls only the (bucketed) frozen-tail rows across the host boundary
+    instead of the full per-world observation arrays. ``rows`` is a
+    power-of-two bucket (bounded compiles); indices past the batch clamp
+    to the last row and the caller slices the pull to the true tail
+    length. The slot→seed index vector rides the same gather so
+    attribution needs no second pull.
+    """
+    cache = eng.__dict__.setdefault("_tail_observer_cache", {})
+    key = (mesh, w, rows)
+    if key in cache:
+        return cache[key]
+
+    def tail(state, idx, start):
+        take = jnp.clip(start + jnp.arange(rows, dtype=jnp.int32), 0, w - 1)
+        obs = {k: jnp.take(v, take, axis=0)
+               for k, v in eng.observe_device(state).items()}
+        return obs, jnp.take(idx, take, axis=0)
+
+    fn = jax.jit(tail)
+    cache[key] = fn
+    return fn
+
+
+def _observer(eng: DeviceEngine):
+    """Cached jit of ``observe_device`` for an already-split frozen batch
+    (the shrink-compaction tail): builds the observation dict on device
+    so the host pull covers exactly the retiring rows."""
+    fn = eng.__dict__.get("_observer_fn")
+    if fn is None:
+        fn = jax.jit(lambda s, i: (eng.observe_device(s), i))
+        eng.__dict__["_observer_fn"] = fn
     return fn

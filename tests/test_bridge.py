@@ -254,6 +254,33 @@ def test_bridge_deadlock_and_time_limit():
     assert all(isinstance(o.error, TimeLimitExceeded) for o in outs)
 
 
+def test_bridge_drain_rounds_bit_identical():
+    """A due cluster wider than k_events forces drain rounds. The drain
+    chain is pop-only and dispatch-ahead since round 8
+    (``BridgeKernel.drain``: round r+1 is dispatched before round r's
+    events are unpacked/fired, and the speculative tail round pops
+    nothing) — the cluster must still fire in exact host-heap
+    (deadline, seq) order, checked poll-for-poll against the pure host
+    Runtime."""
+    N = 11
+
+    async def world():
+        order = []
+
+        async def sleeper(i):
+            # One shared deadline plus a few staggered ones: the cluster
+            # at t=0.5 drains k_events=2 per round over several rounds.
+            await vtime.sleep(0.5 if i % 3 else 0.5 + 0.001 * i)
+            order.append(i)
+
+        for i in range(N):
+            ms.task.spawn(sleeper(i))
+        await vtime.sleep(2.0)
+        return tuple(order)
+
+    assert_identical(world, SEEDS[:3], k_events=2)
+
+
 def test_bridge_task_error_propagates():
     async def boom():
         await vtime.sleep(0.1)
