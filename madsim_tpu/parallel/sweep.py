@@ -633,12 +633,16 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     return w_cur // 2
                 return 0
 
-            def dispatch() -> None:
+            def dispatch(reserve: int = 0) -> None:
                 """Issue one superstep on the CURRENT state (enqueue
-                only — never blocks on device results)."""
+                only — never blocks on device results). ``reserve`` is
+                the planned chunk count of a superstep already in the
+                device queue but not yet read: those chunks may still
+                execute, so the budget must treat them as spent or a
+                binding ``max_steps`` overruns the serial loop's
+                ``c_max`` chunk ceiling."""
                 nonlocal state, inflight, epoch_fresh
-                budget = c_max - chunks - (inflight.planned if inflight
-                                           else 0)
+                budget = c_max - chunks - reserve
                 k = max(1, min(k_cur, budget, superstep_max))
                 if writer is not None and checkpoint_every_chunks:
                     k = min(k, checkpoint_every_chunks)
@@ -665,7 +669,11 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     any_bug, n_active, k_done, hist, k, w_cur, epoch,
                     state if writer is not None else None)
 
-            dispatch()
+            # max_steps <= 0 means a zero-chunk budget: the serial loop
+            # never enters its body, so the pipelined loop must not
+            # force a min_one first chunk either.
+            if c_max > 0:
+                dispatch()
             while inflight is not None:
                 prev, inflight = inflight, None
                 # Dispatch-ahead: superstep k+1 enters the device queue
@@ -674,7 +682,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 # turn out to demand a stop/refill, k+1 is a bitwise
                 # no-op (its entry condition is already false).
                 if not stop and chunks + prev.planned < c_max:
-                    dispatch()
+                    dispatch(reserve=prev.planned)
                 t0 = _clk()
                 bug_h, n_act_h, k_done_h, hist_h = _fetch(
                     (prev.any_bug, prev.n_active, prev.k_done, prev.hist))

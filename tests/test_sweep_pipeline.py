@@ -236,6 +236,47 @@ def test_superstep_dispatch_reduction():
     assert pip.loop_stats["dispatch_depth"] == 1
 
 
+def test_binding_max_steps_respects_chunk_budget():
+    """Review regression: the dispatch-ahead budget must reserve the
+    planned chunks of the superstep already in the device queue but not
+    yet read. With non-retiring worlds (the clean raft family stays at
+    full occupancy for its first 6 chunks of 64 steps) and a binding
+    ``max_steps`` in the c_max 5-8 window — where the adaptive K ramp
+    (1, 1, 2, 4, ...) would otherwise overshoot — the pipelined loop
+    must execute EXACTLY the serial loop's chunk budget, bitwise."""
+    clean = DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=3, n_proposals=1)),
+        EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000))
+    seeds = np.arange(24)
+    for c_max in (5, 6, 7, 8):
+        ser, pip = both_loops(clean, seeds, chunk_steps=64,
+                              max_steps=64 * c_max)
+        assert_bitwise_equal(ser, pip)
+        assert pip.loop_stats["chunks"] <= c_max
+        assert pip.steps_run <= 64 * c_max
+    # In the fully non-retiring window the budget truly binds: the loop
+    # runs the whole budget, never a chunk more.
+    ser, pip = both_loops(clean, seeds, chunk_steps=64, max_steps=64 * 5)
+    assert (pip.n_active_history == 24).all()  # nobody retired
+    assert pip.loop_stats["chunks"] == 5 and pip.steps_run == 320
+
+
+def test_zero_step_budget_runs_no_chunks():
+    """Review regression: ``max_steps <= 0`` means a zero-chunk budget.
+    The serial loop never enters its body; the pipelined loop must not
+    force a min_one first chunk either."""
+    clean = DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=3, n_proposals=1)),
+        EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=3_000_000))
+    seeds = np.arange(8)
+    ser, pip = both_loops(clean, seeds, chunk_steps=64, max_steps=0)
+    assert_bitwise_equal(ser, pip)
+    assert ser.steps_run == pip.steps_run == 0
+    assert ser.loop_stats["chunks"] == pip.loop_stats["chunks"] == 0
+    assert pip.loop_stats["dispatches"] == 0
+    assert pip.n_active_history.size == 0
+
+
 def test_superstep_telemetry_fields(raft_eng):
     """SweepResult.loop_stats carries the bench contract fields
     (bench_results.json configs.*.sweep_loop, asserted by make smoke)."""
