@@ -8,7 +8,8 @@ PY ?= python
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check lint test smoke dryrun determinism dualmode native clean
+.PHONY: check lint test smoke dryrun determinism dualmode native clean \
+        replay-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -52,7 +53,20 @@ smoke:
 	       'chunks_per_dispatch','loop_wall_s'}; \
 	assert all(isinstance(x,dict) and sneed<=set(x) for x in sl), \
 	    f'sweep_loop records missing/incomplete: {sl}'; \
+	sm=[d['configs'][k].get('sim_metrics') for k in \
+	    ('time_to_first_bug','madraft_5node')]; \
+	mneed={'msgs_sent','msgs_delivered','timer_fires','kind_hist', \
+	       'fault_hist','enqueued','vtime_us'}; \
+	assert all(isinstance(x,dict) and mneed<=set(x) for x in sm), \
+	    f'sim_metrics records missing/incomplete: {sm}'; \
 	print('bench_results.json ok:', d['metric'])"
+
+# End-to-end repro-bundle workflow (docs/observability.md): sweep a known
+# buggy config, write a repro bundle for a failing seed, replay it through
+# `python -m madsim_tpu.obs replay`, and validate the exported Chrome
+# trace ends at the invariant raise.
+replay-demo:
+	$(CPU_ENV) $(PY) tools/replay_demo.py
 
 dryrun:
 	$(PY) -c "from __graft_entry__ import dryrun_multichip, entry; \

@@ -598,6 +598,19 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     xla_cost = xla_cost_record(
         eng, eng.init(np.arange(rec_w), faults=faults[:rec_w]), 2_000)
 
+    # Observability record (docs/observability.md): the same config swept
+    # metrics-on at the capped batch. metrics is a STATIC engine knob, so
+    # this uses its own engine and the timed sweep below stays the exact
+    # metrics-off program; trajectories are bit-identical either way
+    # (tier-1, tests/test_obs.py).
+    import dataclasses as _dc
+
+    eng_m = DeviceEngine(RaftActor(rcfg), _dc.replace(cfg, metrics=True))
+    res_m = sweep(None, eng_m.cfg, np.arange(rec_w), faults=faults[:rec_w],
+                  engine=eng_m, chunk_steps=16, max_steps=20_000)
+    sim_metrics = {"n_worlds": rec_w, **res_m.metrics["aggregate"]}
+    del eng_m, res_m
+
     # Warmup compile on the SAME batch shape as the timed run (jit
     # specializes on shapes; a smaller warmup batch would leave the real
     # compile inside the timed window).
@@ -630,7 +643,10 @@ def bench_madraft_5node(n_worlds: int) -> dict:
            # "Pipelined orchestration"): dispatch counts, superstep
            # fan-in, and the host/device wall split of the chunk loop.
            "sweep_loop": res.loop_stats,
-           "xla_cost": xla_cost}
+           "xla_cost": xla_cost,
+           # Fleet-aggregate simulation metrics of the metrics-on probe
+           # sweep (docs/observability.md; asserted by `make smoke`).
+           "sim_metrics": sim_metrics}
     log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
     return out
 
@@ -785,6 +801,19 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         "found_bug": bool(res.bug.any()),
         "wall_s_incl_compile": round(recycled_dt, 3),
     }
+    # Observability record (docs/observability.md): the hunt config swept
+    # metrics-on at a capped batch, with per-seed frames aggregated over
+    # the fleet. Separate engine — metrics is a static knob; every timed
+    # run above stays the exact metrics-off program.
+    import dataclasses as _dc
+
+    rec_w_m = min(device_worlds, 2_048)
+    eng_m = DeviceEngine(RaftActor(rcfg), _dc.replace(cfg, metrics=True))
+    res_m = device_sweep(None, eng_m.cfg, np.arange(rec_w_m), engine=eng_m,
+                         chunk_steps=64, max_steps=4_000)
+    sim_metrics = {"n_worlds": rec_w_m, **res_m.metrics["aggregate"]}
+    del eng_m, res_m
+
     # Expected seeds to first bug = 1/rate; the device explores
     # device_worlds/dev_dt seeds per second.
     dev_expected = (1.0 / dev_rate) / (device_worlds / dev_dt)
@@ -810,6 +839,9 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         # Per-step XLA cost model of this engine config (the op-budget
         # regression axis; docs/perf.md "Single-pass insert + donation").
         "xla_cost": xla_cost,
+        # Fleet-aggregate simulation metrics of the metrics-on probe
+        # sweep (docs/observability.md; asserted by `make smoke`).
+        "sim_metrics": sim_metrics,
         "recycled_hunt": recycled,
         # Orchestration breakdown of the recycled hunt's chunk loop
         # (docs/perf.md "Pipelined orchestration"): the acceptance axes
@@ -937,6 +969,9 @@ def bench_bridge_sweep(n_host: int, n_bridge: int) -> dict:
             k[:-2]: round(prof[k] / max(prof["rounds"], 1) * 1e3, 2)
             for k in ("host_s", "pack_s", "dispatch_s", "settle_s")},
         "bridge_rounds": prof["rounds"],
+        # The bridge kernel's device-resident observability block,
+        # aggregated over the fleet (docs/observability.md).
+        "sim_metrics": prof.get("sim_metrics"),
         "note": ("per-seed trajectories bit-identical to host "
                  "(tests/test_bridge.py); task bodies are serial Python, "
                  "so single-core speedup is Amdahl-bounded by the measured "

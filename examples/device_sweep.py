@@ -28,8 +28,11 @@ from madsim_tpu.parallel.sweep import sweep
 
 def main(n_worlds: int = 4096) -> None:
     rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    # metrics=True: the device-resident flight recorder (docs/
+    # observability.md) — per-world counters ride the sweep at zero
+    # trajectory impact (metrics-on is bit-identical to metrics-off).
     cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=48,
-                       t_limit_us=2_000_000)
+                       t_limit_us=2_000_000, metrics=True)
     eng = DeviceEngine(RaftActor(rcfg), cfg)
     faults = np.array([[600_000, FAULT_KILL, 1, 0],
                        [1_200_000, FAULT_RESTART, 1, 0]], np.int32)
@@ -48,6 +51,11 @@ def main(n_worlds: int = 4096) -> None:
           f"dispatches ({st['chunks_per_dispatch']}x superstep fan-in); "
           f"host decision stall {st['host_decision_s']:.3f}s + device wait "
           f"{st['device_wait_s']:.3f}s of {st['loop_wall_s']:.3f}s loop wall")
+    agg = res.metrics["aggregate"]
+    print(f"fleet metrics: {agg['msgs_sent']} msgs sent, "
+          f"{agg['msgs_delivered']} delivered, {agg['timer_fires']} timer "
+          f"fires, {agg['drop_loss']} lost, "
+          f"{sum(agg['fault_hist'])} faults injected")
     if not res.failing_seeds:
         print("no failing seeds in this sweep — try more worlds")
         return
@@ -63,6 +71,22 @@ def main(n_worlds: int = 4096) -> None:
         drop = " (dropped)" if e.get("dropped") else ""
         print(f"  t={e['t_us']:>9}us {e['kind']:<14} "
               f"{e['src']}->{e['dst']}{drop}{mark}")
+
+    # Durable artifacts: a Perfetto-loadable timeline and a one-file
+    # repro bundle the obs CLI replays verbatim (docs/observability.md).
+    from madsim_tpu.obs import trace_to_chrome
+    from madsim_tpu.obs.bundle import write_sweep_bundle
+    from madsim_tpu.obs.timeline import dump_chrome
+
+    dump_chrome(trace_to_chrome(trace, seed=seed), "/tmp/device_sweep_trace.json")
+    bundle = write_sweep_bundle(
+        "/tmp", seed=seed, actor="raft", actor_config=rcfg,
+        engine_config=cfg, faults=faults, max_steps=8_000,
+        error="RaftInvariantViolation: election safety",
+        trace_path="/tmp/device_sweep_trace.json")
+    print(f"\ntimeline: /tmp/device_sweep_trace.json (chrome://tracing)"
+          f"\nrepro bundle: {bundle}"
+          f"\n  replay: python -m madsim_tpu.obs replay --bundle {bundle}")
 
 
 if __name__ == "__main__":

@@ -26,7 +26,8 @@ import os
 from typing import Dict, List, Optional
 
 from .pragmas import Allowlist, Finding, apply_pragmas, extract_pragmas
-from .rules import ATTR_CALLS, EXACT_CALLS, PREFIX_CALLS, RULES
+from .rules import (ATTR_CALLS, CLOCK_DEFAULT_CALLS, EXACT_CALLS,
+                    PREFIX_CALLS, RULES)
 
 _SORT_BUILTINS = {"sorted", "min", "max"}
 
@@ -125,6 +126,20 @@ class _CallScanner(ast.NodeVisitor):
             if rule is not None and (resolved is not None or _looks_stdlib(parts[0])):
                 self._flag(node, rule, f"{full}()")
                 return
+            # Clock-DEFAULT decode calls (DET001 extension): escape only
+            # when the time operand is omitted — time.ctime(virtual_us)
+            # is a pure converter, time.ctime() reads the host clock.
+            # *args makes the operand count unknowable: stay conservative
+            # and treat the call as supplied.
+            entry = CLOCK_DEFAULT_CALLS.get(full)
+            if entry is not None and (resolved is not None
+                                      or _looks_stdlib(parts[0])):
+                crule, max_args = entry
+                starred = any(isinstance(a, ast.Starred) for a in node.args)
+                if len(node.args) <= max_args and not starred:
+                    self._flag(node, crule, f"{full}() with the time "
+                                            "operand defaulted")
+                    return
         # Method-name-only table: receivers with no static type.
         if isinstance(func, ast.Attribute) and func.attr in ATTR_CALLS:
             self._flag(node, ATTR_CALLS[func.attr], f".{func.attr}()")

@@ -15,7 +15,7 @@ rot into a blanket waiver.
 """
 from __future__ import annotations
 
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Tuple
 
 
 class Rule(NamedTuple):
@@ -99,6 +99,23 @@ EXACT_CALLS.update({f"random.{fn}": "DET002" for fn in _RANDOM_GLOBALS})
 # Dotted-prefix matches (any call under the module escapes).
 PREFIX_CALLS: Dict[str, str] = {
     "secrets.": "DET002",
+}
+
+# Clock-DEFAULT calls (DET001, decode-path extension for obs/ timeline
+# code): these read the wall clock only when the time operand is omitted
+# — with an explicit seconds/struct_time argument they are pure
+# converters a timeline renderer may legitimately use on *virtual*
+# timestamps. Value = (rule, max positional args at which the call still
+# defaults to "now"): ``time.ctime()`` escapes, ``time.ctime(t_us)`` is
+# clean; ``time.strftime(fmt)`` escapes, ``strftime(fmt, tm)`` is clean.
+# Motivated by obs/timeline.py: exported timelines must be byte-stable
+# across replays, so every timestamp comes from virtual time.
+CLOCK_DEFAULT_CALLS: Dict[str, Tuple[str, int]] = {
+    "time.ctime": ("DET001", 0),
+    "time.asctime": ("DET001", 0),
+    "time.localtime": ("DET001", 0),
+    "time.gmtime": ("DET001", 0),
+    "time.strftime": ("DET001", 1),
 }
 
 # Attribute-name matches on an unresolvable receiver: `loop` in

@@ -54,6 +54,29 @@ class BridgeState(NamedTuple):
     lane_seq: object  # i64[W, CAP+1]
 
 
+class BridgeMetrics(NamedTuple):
+    """Per-slot observability counters (obs/metrics.py's block, shaped
+    for the bridge: i64[W] lanes accumulated ON DEVICE inside the jitted
+    step — the host never pays a per-round pull for them).
+
+    Counters are per *slot*, cumulative across recycled seeds
+    (``reset_slot`` leaves them running): the fleet-aggregate frame the
+    profiled sweep reports (``sweep_profiled``'s ``sim_metrics``) is
+    exact either way, and zeroing on recycle would force a device
+    read-back per retirement. Write-only within the step — the
+    bitwise-invisibility contract of the device engine's MetricsBlock
+    holds here too (metrics-on trajectories are bit-identical,
+    tests/test_obs.py).
+    """
+
+    timers_set: object    # i64[W] — lane adds shipped to the device
+    cancels: object       # i64[W]
+    msgs_sent: object     # i64[W] — send attempts (loss drawn on device)
+    msgs_lost: object     # i64[W] — sends the loss draw dropped
+    events_fired: object  # i64[W] — due events popped (step + drain)
+    vtime_ns: object      # i64[W] — device-observed clock advance
+
+
 class StepOut(NamedTuple):
     clock: object        # i64[W] — after advance
     deadlock: object     # bool[W] — advance requested but no timers pending
@@ -101,12 +124,13 @@ def _u64_block(k0, k1, ctr):
     return x0.astype(jnp.uint64) | (x1.astype(jnp.uint64) << jnp.uint64(32))
 
 
-def _step(state: BridgeState, net_k0, net_k1,
+def _step(state: BridgeState, mb, net_k0, net_k1,
           t_slot, t_dl, t_seq, t_mask,
           c_slot, c_mask,
           s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
           s_lat_lo, s_lat_w, s_mask, s_live,
-          clock_in, advance, *, cap: int, k_events: int):
+          clock_in, advance, *, cap: int, k_events: int,
+          metrics: bool = False):
     import jax.numpy as jnp
 
     W = clock_in.shape[0]
@@ -178,10 +202,25 @@ def _step(state: BridgeState, net_k0, net_k1,
 
     new_state = BridgeState(clock=new_clock, lane_dl=lane_dl,
                             lane_seq=lane_seq)
-    return new_state, StepOut(clock=new_clock, deadlock=deadlock,
-                              send_ok=ok, event_slot=event_slot,
-                              event_seq=event_seq, event_valid=event_valid,
-                              more_due=more_due)
+    if metrics:
+        # Observability accumulation (BridgeMetrics): sums of masks the
+        # step already computed — write-only, so the metrics-on step's
+        # StepOut is bit-identical to metrics-off.
+        i64 = jnp.int64
+        mb = BridgeMetrics(
+            timers_set=mb.timers_set + t_mask.sum(axis=1, dtype=i64),
+            cancels=mb.cancels + c_mask.sum(axis=1, dtype=i64),
+            msgs_sent=mb.msgs_sent + s_mask.sum(axis=1, dtype=i64),
+            msgs_lost=mb.msgs_lost + (s_mask & lost).sum(axis=1, dtype=i64),
+            events_fired=mb.events_fired
+            + event_valid.sum(axis=1, dtype=i64),
+            vtime_ns=mb.vtime_ns + (new_clock - state.clock),
+        )
+    return new_state, mb, StepOut(clock=new_clock, deadlock=deadlock,
+                                  send_ok=ok, event_slot=event_slot,
+                                  event_seq=event_seq,
+                                  event_valid=event_valid,
+                                  more_due=more_due)
 
 
 class DrainOut(NamedTuple):
@@ -194,7 +233,8 @@ class DrainOut(NamedTuple):
     more_due: object     # bool[W] — still >K events due
 
 
-def _drain_step(state: BridgeState, *, cap: int, k_events: int):
+def _drain_step(state: BridgeState, mb, *, cap: int, k_events: int,
+                metrics: bool = False):
     """Pop-only kernel for drain rounds: no cancels, no timers, no sends,
     no clock advance — exactly what a zero-width ``advance=False``
     :func:`_step` round did, minus the dead scatter machinery.
@@ -228,8 +268,12 @@ def _drain_step(state: BridgeState, *, cap: int, k_events: int):
     event_valid = jnp.stack(ev_valid, axis=1)
     more_due = lane_dl[:, :cap].min(axis=1) <= clock
     new_state = BridgeState(clock=clock, lane_dl=lane_dl, lane_seq=lane_seq)
-    return new_state, DrainOut(event_seq=event_seq, event_valid=event_valid,
-                               more_due=more_due)
+    if metrics:
+        mb = mb._replace(events_fired=mb.events_fired
+                         + event_valid.sum(axis=1, dtype=jnp.int64))
+    return new_state, mb, DrainOut(event_seq=event_seq,
+                                   event_valid=event_valid,
+                                   more_due=more_due)
 
 
 # One jitted step per (cap, k_events), shared by every kernel instance:
@@ -254,7 +298,7 @@ class BridgeKernel:
     """
 
     def __init__(self, seeds, *, cap: int = 128, k_events: int = 4,
-                 device: str = None):
+                 device: str = None, metrics: bool = False):
         import os
 
         import jax
@@ -274,6 +318,7 @@ class BridgeKernel:
         self.W = len(seeds)
         self.cap = cap
         self.k_events = k_events
+        self.metrics_enabled = bool(metrics)
         # The lockstep protocol is dispatch-latency bound (one step per
         # event cluster), so the kernel defaults to the LOCAL XLA backend:
         # a co-located accelerator amortizes at large W, but a tunneled
@@ -294,21 +339,32 @@ class BridgeKernel:
                 lane_dl=jnp.full((self.W, cap + 1), INF_NS, jnp.int64),
                 lane_seq=jnp.zeros((self.W, cap + 1), jnp.int64),
             )
+            # The per-slot observability block (device-resident; donated
+            # through the step alongside the lane state).
+            self._mb = (BridgeMetrics(*[jnp.zeros((self.W,), jnp.int64)
+                                        for _ in BridgeMetrics._fields])
+                        if self.metrics_enabled else None)
             # One jitted step; XLA re-traces per padded batch shape.
             # Process-cached so repeated sweeps reuse the compilation.
-            self._fn = _STEP_CACHE.get((cap, k_events))
+            # Metrics-on compiles its own entry (the block is an extra
+            # donated argument); metrics-off is the unchanged program.
+            donate = (0, 1) if self.metrics_enabled else (0,)
+            key = (cap, k_events, self.metrics_enabled)
+            self._fn = _STEP_CACHE.get(key)
             if self._fn is None:
-                self._fn = jax.jit(functools.partial(_step, cap=cap,
-                                                     k_events=k_events),
-                                   donate_argnums=(0,))
-                _STEP_CACHE[(cap, k_events)] = self._fn
-            self._drain_fn = _DRAIN_CACHE.get((cap, k_events))
+                self._fn = jax.jit(
+                    functools.partial(_step, cap=cap, k_events=k_events,
+                                      metrics=self.metrics_enabled),
+                    donate_argnums=donate)
+                _STEP_CACHE[key] = self._fn
+            self._drain_fn = _DRAIN_CACHE.get(key)
             if self._drain_fn is None:
                 self._drain_fn = jax.jit(
                     functools.partial(_drain_step, cap=cap,
-                                      k_events=k_events),
-                    donate_argnums=(0,))
-                _DRAIN_CACHE[(cap, k_events)] = self._drain_fn
+                                      k_events=k_events,
+                                      metrics=self.metrics_enabled),
+                    donate_argnums=donate)
+                _DRAIN_CACHE[key] = self._drain_fn
 
     def reset_slot(self, slot: int, seed: int) -> None:
         """Recycle one world slot for a fresh seed: re-derive its NET
@@ -343,16 +399,17 @@ class BridgeKernel:
         speculatively dispatched round that finds nothing due is a
         semantic no-op on the lanes."""
         with self._jax.default_device(self.device), self._enable_x64():
-            state, out = self._drain_fn(self.state)
+            state, mb, out = self._drain_fn(self.state, self._mb)
             self.state = state
+            self._mb = mb
             return out
 
     def step(self, batch: HostBatch) -> StepOut:
         import jax.numpy as jnp
 
         with self._jax.default_device(self.device), self._enable_x64():
-            state, out = self._fn(
-                self.state, self._net_k0, self._net_k1,
+            state, mb, out = self._fn(
+                self.state, self._mb, self._net_k0, self._net_k1,
                 jnp.asarray(batch.t_slot), jnp.asarray(batch.t_dl),
                 jnp.asarray(batch.t_seq), jnp.asarray(batch.t_mask),
                 jnp.asarray(batch.c_slot), jnp.asarray(batch.c_mask),
@@ -363,7 +420,17 @@ class BridgeKernel:
                 jnp.asarray(batch.s_mask), jnp.asarray(batch.s_live),
                 jnp.asarray(batch.clock), jnp.asarray(batch.advance))
             self.state = state
+            self._mb = mb
             return StepOut(*[np.asarray(x) for x in out])
+
+    def metrics(self):
+        """Host copy of the per-slot :class:`BridgeMetrics` block (dict of
+        i64[W] numpy arrays), or ``None`` when metrics are off. One
+        explicit pull — call at sweep end, not per round."""
+        if self._mb is None:
+            return None
+        vals = self._jax.device_get(self._mb)
+        return {k: np.asarray(v) for k, v in vals._asdict().items()}
 
 
 def bucket(n: int, minimum: int = 4) -> int:

@@ -377,6 +377,11 @@ def sweep_profiled(world_fn, seeds, **kw) -> Tuple[List[Outcome], dict]:
     dispatch, drain rounds). ``rounds``/``drain_rounds`` count kernel
     dispatches; ``events``/``sends``/``timers`` are totals across worlds.
     This is the measured artifact behind docs/bridge.md.
+
+    Profiled sweeps additionally run the kernel with its device-resident
+    observability block (``BridgeMetrics``) and report the fleet
+    aggregate under ``sim_metrics`` — trajectories stay bit-identical to
+    an unprofiled sweep (tests/test_obs.py).
     """
     profile: dict = {}
     outs, _ = _sweep_impl(world_fn, seeds, profile=profile, **kw)
@@ -401,7 +406,11 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
     next_pos = 0                    # next seed position to admit
     polls_done = 0                  # poll_count of retired worlds
 
-    kernel = BridgeKernel(seeds[:W], cap=cap, k_events=k_events, device=device)
+    # Profiled sweeps also carry the device-resident observability block
+    # (BridgeMetrics): counters accumulate inside the jitted step and are
+    # pulled ONCE at the end — bit-invisible to trajectories either way.
+    kernel = BridgeKernel(seeds[:W], cap=cap, k_events=k_events,
+                          device=device, metrics=profile is not None)
 
     def finish(w: _World, value=None, error=None):
         nonlocal polls_done
@@ -663,4 +672,11 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             profile["polls"] = polls_done + sum(
                 w.rt.task.poll_count for w in slots if not w.done)
 
+    if profile is not None:
+        mb = kernel.metrics()
+        if mb is not None:
+            # Fleet aggregate of the kernel's per-slot counters
+            # (docs/observability.md; bench.py records it under
+            # configs.bridge_sweep.sim_metrics).
+            profile["sim_metrics"] = {k: int(v.sum()) for k, v in mb.items()}
     return [o for o in outcomes], traces

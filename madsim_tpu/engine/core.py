@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .lanes import take_small, upd, upd2
+from ..obs.metrics import NUM_FAULT_KINDS, MetricsBlock
+from .lanes import onehot, take_small, upd, upd2
 from .queue import (
     Event,
     EventQueue,
@@ -41,6 +42,7 @@ from .queue import (
     depth as queue_depth,
     eligible_mask,
     empty_queue,
+    insert_metrics,
     next_deadline,
     pop,
     pop_indexed,
@@ -110,6 +112,14 @@ class EngineConfig:
     # whole trajectories both ways); sequential exists ONLY to pin that
     # contract — it pays ~M full-queue rewrites per step.
     sequential_insert: bool = False
+    # Observability: carry a per-world MetricsBlock (obs/metrics.py) in
+    # WorldState.metrics and update it every step. The block is a
+    # separate pytree leaf the step WRITES but never reads for any
+    # simulation decision, so metrics-on trajectories are bit-identical
+    # to metrics-off (tier-1, tests/test_obs.py); with False (default)
+    # the field is None and the compiled step is the exact pre-metrics
+    # program — the op budget in tests/test_queue_insert.py is untouched.
+    metrics: bool = False
 
     @property
     def m(self) -> int:
@@ -173,6 +183,13 @@ class WorldState(NamedTuple):
     lat_min: jnp.ndarray      # int32 µs
     lat_max: jnp.ndarray      # int32 µs
     loss: jnp.ndarray         # float32 loss probability
+    # Observability counters (obs/metrics.py MetricsBlock) when
+    # EngineConfig.metrics, else None (an empty pytree subtree — the
+    # leaf list, and therefore every compiled program and checkpoint
+    # layout, is unchanged with metrics off). Write-only within the
+    # step: nothing below ever reads it — the bitwise-invisibility
+    # contract.
+    metrics: Any = None
 
 
 def tree_select(pred, a, b):
@@ -376,6 +393,10 @@ class DeviceEngine:
         # One O(Q) reduction at init seeds the carried depth; every step
         # after this maintains it incrementally (pop/push_many deltas).
         qd = queue_depth(q)
+        # Metrics start from the init-time queue contents: the actor's
+        # seed events and the fault rows count as enqueued.
+        mb = (MetricsBlock.zeros(self.actor.num_kinds)._replace(enqueued=qd)
+              if cfg.metrics else None)
         return WorldState(
             now=jnp.int32(0),
             queue=q,
@@ -398,6 +419,7 @@ class DeviceEngine:
             lat_min=lat_min,
             lat_max=lat_max,
             loss=loss,
+            metrics=mb,
         )
 
     def refill(self, state: WorldState, slot_mask, new_seeds,
@@ -435,6 +457,7 @@ class DeviceEngine:
     def _build_step(self) -> Callable[[WorldState], WorldState]:
         cfg = self.cfg
         actor = self.actor
+        num_kinds = int(actor.num_kinds)  # kind_hist width (metrics)
 
         def apply_fault(ws: WorldState, ev: Event) -> Tuple[WorldState, Outbox]:
             op, a, b = ev.kind, ev.src, ev.dst
@@ -519,6 +542,9 @@ class DeviceEngine:
                     q, ok = push(q, ev, enable=enable[i])
                     overflow = overflow | ~ok
                 qdepth = queue_depth(q)
+                # Inserted count via the carried-depth invariant (the
+                # chain exposes no n_ins): metrics stay path-independent.
+                n_ins = qdepth - ws.qdepth
             else:
                 # Single fused pass (queue.push_many): rank-matched M-row
                 # scatter of the compacted outbox — M·(2+P) element
@@ -539,8 +565,24 @@ class DeviceEngine:
                 overflow = ws.overflow | ~jnp.all(oks)
                 qdepth = ws.qdepth + n_ins
             qmax = jnp.maximum(ws.qmax, qdepth)
+            metrics = ws.metrics
+            if cfg.metrics:
+                # Send-side counters (obs/metrics.py). Strictly write-only:
+                # nothing above reads the block, so the metrics-on step is
+                # bit-identical to metrics-off on every other leaf.
+                i32 = jnp.int32
+                _n_req, n_inf, n_over = insert_metrics(t, enable, n_ins)
+                metrics = metrics._replace(
+                    msgs_sent=metrics.msgs_sent + jnp.sum(
+                        (ob.valid & ~ob.is_timer & ws.active).astype(i32)),
+                    drop_loss=metrics.drop_loss + jnp.sum(
+                        (ob.valid & dropped & ws.active).astype(i32)),
+                    enqueued=metrics.enqueued + jnp.asarray(n_ins, i32),
+                    drop_overflow=metrics.drop_overflow + n_over,
+                    drop_inf=metrics.drop_inf + n_inf,
+                )
             return ws._replace(queue=q, rng=rng, overflow=overflow,
-                               qdepth=qdepth, qmax=qmax)
+                               qdepth=qdepth, qmax=qmax, metrics=metrics)
 
         def step(ws: WorldState) -> WorldState:
             # The pop is gated on ws.active too (see push_outbox): a
@@ -586,6 +628,37 @@ class DeviceEngine:
                 dropped=ws3.dropped
                 + (found & in_time & ~deliver & ~do_fault).astype(jnp.int32),
             )
+            if cfg.metrics:
+                # Pop-side counters (obs/metrics.py); ws3.metrics already
+                # carries this step's send-side increments. Every
+                # increment is gated on ``found`` (itself gated on
+                # ws.active), so frozen worlds' blocks never move — no
+                # restore needed in the tail below. Write-only: the
+                # trajectory never reads these.
+                i32 = jnp.int32
+                mb = ws3.metrics
+                mb = mb._replace(
+                    msgs_delivered=mb.msgs_delivered
+                    + (deliver & ~is_timer).astype(i32),
+                    timer_fires=mb.timer_fires
+                    + (deliver & is_timer).astype(i32),
+                    drop_stale=mb.drop_stale
+                    + (found & in_time & ~is_fault & stale).astype(i32),
+                    drop_dead=mb.drop_dead
+                    + (found & in_time & ~is_fault & ~stale
+                       & dead).astype(i32),
+                    drop_out_of_time=mb.drop_out_of_time
+                    + (found & ~in_time).astype(i32),
+                    vtime_us=mb.vtime_us + (now - ws.now),
+                    # onehot's drop semantics cover wild kinds: an
+                    # out-of-range index increments no bin.
+                    fault_hist=mb.fault_hist
+                    + (onehot(ev.kind, NUM_FAULT_KINDS)
+                       & do_fault).astype(i32),
+                    kind_hist=mb.kind_hist
+                    + (onehot(ev.kind, num_kinds) & deliver).astype(i32),
+                )
+                ws4 = ws4._replace(metrics=mb)
             # Frozen worlds pass through untouched. Every lane write above
             # is already gated on ws.active (the pop found nothing, the
             # outbox was disabled, faults/delivery/bug flags all require
@@ -755,7 +828,7 @@ class DeviceEngine:
                    ev.src, ev.dst, ev.payload, delivered, s2.bug, s2.now)
             return s2, rec
 
-        _final, recs = jax.lax.scan(body, state, None, length=max_steps)
+        final, recs = jax.lax.scan(body, state, None, length=max_steps)
         valid, time_us, kind, flags, src, dst, payload, delivered, bug, now_us = \
             (np.asarray(r) for r in recs)
         kind_names = getattr(self.actor, "kind_names", None)
@@ -807,6 +880,22 @@ class DeviceEngine:
                 entry["bug_raised"] = True
                 bug_seen = True
             out.append(entry)
+        if bool(np.asarray(final.active)):
+            # max_steps hit with the world still live: mark the cut
+            # explicitly instead of silently ending the list — a consumer
+            # (or a human) must never mistake a truncated timeline for a
+            # retired world (obs/timeline.py renders the marker).
+            out.append({"step": max_steps, "t_us": int(np.asarray(final.now)),
+                        "kind": "truncated", "timer": False, "src": -1,
+                        "dst": -1, "payload": [], "bug_seen": bug_seen})
+            if not bug_seen:
+                import warnings
+
+                warnings.warn(
+                    f"trace(seed={seed}) truncated at max_steps={max_steps} "
+                    "before any bug_raised event — raise max_steps if you "
+                    "expected the invariant violation in this window",
+                    RuntimeWarning, stacklevel=2)
         return out
 
     # ------------------------------------------------------------------
@@ -834,6 +923,13 @@ class DeviceEngine:
             # invariant (carried == recomputed) is a tier-1 test.
             "queue_depth": state.qdepth,
         }
+        if self.cfg.metrics and state.metrics is not None:
+            # One ``m_<field>`` entry per MetricsBlock counter: the sweep's
+            # retirement machinery then attributes metrics per seed exactly
+            # like any other observation (slot→seed index, device-side tail
+            # gathers), and SweepResult.metrics reassembles the frames.
+            out.update({f"m_{name}": val for name, val
+                        in state.metrics._asdict().items()})
         out.update(self.actor.observe(self.cfg, state.astate))
         return out
 

@@ -261,6 +261,25 @@ def push_many(q: EventQueue, evs: Event, enable=None,
     return q, ok, jnp.minimum(n_en, n_free)
 
 
+def insert_metrics(times, enable, n_inserted):
+    """Insert-path counters for the observability block
+    (:mod:`madsim_tpu.obs.metrics`): given the push batch's ``times`` and
+    ``enable`` mask plus the count actually inserted (``push_many``'s
+    ``n_inserted``, or a carried-depth delta on the sequential path),
+    return ``(n_requested, n_inf_dropped, n_overflow)`` — attempts,
+    deadline-saturated drops (the INF_TIME contract above), and inserts
+    refused by a full queue. Lives here, next to the INF/overflow
+    semantics it mirrors, so the drop taxonomy has exactly one home.
+    Pure bookkeeping: never feeds the insert itself (the bitwise-
+    invisibility contract of metrics-on runs).
+    """
+    en = jnp.asarray(enable, bool)
+    n_req = jnp.sum(en.astype(jnp.int32))
+    n_inf = jnp.sum((en & (jnp.asarray(times, jnp.int32) >= INF_TIME))
+                    .astype(jnp.int32))
+    return n_req, n_inf, n_req - n_inf - jnp.asarray(n_inserted, jnp.int32)
+
+
 def pop_indexed(q: EventQueue, eligible=None
                 ) -> Tuple[EventQueue, Event, jnp.ndarray, jnp.ndarray]:
     """:func:`pop` that also returns the popped ``slot`` index, so the

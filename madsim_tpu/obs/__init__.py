@@ -1,0 +1,45 @@
+"""Observability for madsim_tpu sweeps: the FoundationDB-style triad.
+
+madsim's whole value is that a failure is a *seed you can replay*
+(`madsim/src/sim/runtime/builder.rs:118-136`, the repro banner at
+`runtime/mod.rs:192-199`). This package closes the gap between "seed
+17234 failed" and knowing *what the fleet did on the way there*, with
+the three-layer shape simulation-testing systems converge on (PAPERS.md,
+FoundationDB lineage):
+
+1. **Cheap always-on counters** (:mod:`.metrics`): an opt-in
+   ``MetricsBlock`` pytree carried alongside ``WorldState``
+   (``EngineConfig(metrics=True)``), accumulating per-world simulation
+   counters entirely on device — sends, deliveries, drops by cause,
+   timer fires, fault injections by kind, per-event-kind histograms,
+   virtual time. The load-bearing contract is **bitwise invisibility**:
+   metrics never feed step math, so a metrics-on sweep is bit-identical
+   to metrics-off (tier-1, tests/test_obs.py) and metrics-off compiles
+   the exact pre-existing program (the PR 3 op budget is untouched).
+2. **Deep on-demand traces** (:mod:`.timeline`): ``EngineCore.trace()``
+   output (and host ``Runtime`` poll traces) rendered as Chrome
+   trace-event / Perfetto JSON or human-readable text. Timestamps are
+   *virtual time* — never the wall clock (detlint-gated).
+3. **One-file repros** (:mod:`.bundle`): a failing run writes a JSON
+   artifact (seed, config + hash, fault schedule, backend/batch knobs)
+   that ``python -m madsim_tpu.obs replay`` re-runs verbatim.
+
+CLI: ``python -m madsim_tpu.obs replay --seed N --actor raft ...`` or
+``replay --bundle repro.json``. See docs/observability.md.
+"""
+from .bundle import load_bundle, write_sweep_bundle, write_test_bundle
+from .metrics import (
+    BLOCK_FIELDS,
+    NUM_FAULT_KINDS,
+    MetricsBlock,
+    aggregate_metrics,
+    metrics_from_observations,
+)
+from .timeline import polls_to_chrome, render_text, trace_to_chrome
+
+__all__ = [
+    "MetricsBlock", "NUM_FAULT_KINDS", "BLOCK_FIELDS",
+    "aggregate_metrics", "metrics_from_observations",
+    "trace_to_chrome", "polls_to_chrome", "render_text",
+    "write_sweep_bundle", "write_test_bundle", "load_bundle",
+]

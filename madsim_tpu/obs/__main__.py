@@ -1,0 +1,7 @@
+"""``python -m madsim_tpu.obs`` — the observability CLI (obs/cli.py)."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

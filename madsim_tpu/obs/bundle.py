@@ -1,0 +1,158 @@
+"""Repro bundles: one JSON file that replays a failure verbatim.
+
+The reference's repro story is a two-line banner (seed + config hash,
+`runtime/mod.rs:192-199`) the user must combine with the right binary,
+env vars and schedule by hand. A *bundle* captures the whole recipe —
+seed, engine/actor config (with a stable hash), fault schedule,
+backend/batch knobs, the recorded error — so
+``python -m madsim_tpu.obs replay --bundle repro.json`` reproduces the
+failure with no archaeology:
+
+- ``kind="device_sweep"``: a failing seed from a device-engine sweep
+  (``SweepResult.failing_seeds``); replay re-traces the seed through the
+  same actor/config/schedule and exports the timeline.
+- ``kind="host_test"``: a failing ``@madsim_tpu.test``; replay
+  re-imports the test entry point and re-runs it under the bundle's
+  pinned ``MADSIM_TEST_*`` environment, expecting the same error.
+
+``testing.Builder`` writes a host_test bundle automatically on failure
+when ``MADSIM_REPRO_DIR`` is set (the banner says where it landed).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+BUNDLE_VERSION = 1
+
+
+def _as_plain(obj: Any) -> Any:
+    """Config objects → JSON-plain dicts (dataclasses pass through
+    ``asdict``; dicts/lists/scalars unchanged)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    return obj
+
+
+def config_digest(obj: Any) -> str:
+    """Stable 16-hex fingerprint of a config dict/dataclass — the
+    device-engine analog of ``Config.hash()`` (`config.rs:27-31`)."""
+    canon = json.dumps(_as_plain(obj), sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def _write(bundle: Dict[str, Any], path: str, stem: str) -> str:
+    if os.path.isdir(path):
+        path = os.path.join(path, f"{stem}-{bundle['config_hash']}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(bundle, f, indent=2, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def write_sweep_bundle(path: str, *, seed: int, actor: str,
+                       actor_config: Any, engine_config: Any,
+                       faults: Optional[Any] = None,
+                       max_steps: int = 2_000,
+                       error: Optional[str] = None,
+                       trace_path: Optional[str] = None,
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write a device-sweep repro bundle; returns the file path.
+
+    ``path`` may be a directory (a ``repro-seed<seed>-<hash>.json`` name
+    is chosen inside it). ``actor`` is a replay-registry name
+    (``raft``/``pb``/``tpc`` — obs/cli.py); configs are the dataclass
+    instances (or plain dicts) the sweep ran with; ``faults`` the
+    schedule rows for THIS seed ((F, 4), or None).
+    """
+    import numpy as np
+
+    acfg = _as_plain(actor_config)
+    ecfg = _as_plain(engine_config)
+    frows = None if faults is None else np.asarray(faults, np.int32).tolist()
+    fault_sha = hashlib.sha256(
+        json.dumps(frows).encode()).hexdigest()[:16] if frows else None
+    bundle = {
+        "version": BUNDLE_VERSION,
+        "kind": "device_sweep",
+        "seed": int(seed),
+        "actor": actor,
+        "actor_config": acfg,
+        "engine_config": ecfg,
+        "config_hash": config_digest({"actor": actor, "actor_config": acfg,
+                                      "engine_config": ecfg}),
+        "faults": frows,
+        "faults_sha256": fault_sha,
+        "max_steps": int(max_steps),
+        "error": error,
+        "trace_path": trace_path,
+        "extra": dict(extra or {}),
+    }
+    return _write(bundle, path, f"repro-seed{int(seed)}")
+
+
+def write_test_bundle(path: str, *, seed: int, test_id: Optional[str],
+                      test_file: Optional[str] = None,
+                      backend: str = "host", batch: Optional[int] = None,
+                      config: Optional[Any] = None,
+                      config_path: Optional[str] = None,
+                      time_limit: Optional[float] = None,
+                      error: Optional[str] = None,
+                      extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write a host-test repro bundle (a failing ``@madsim_tpu.test``);
+    returns the file path. ``test_id`` is ``module:qualname`` of the
+    decorated test so replay can re-import it (``test_file`` is the
+    source-path fallback for tests whose module is not importable by
+    name — scripts run as ``__main__``); the ``env`` block is the exact
+    ``MADSIM_TEST_*`` environment that reproduces the failure —
+    including the backend/batch knobs a bridge-backend failure needs.
+    """
+    cfg_dict = None
+    cfg_hash = None
+    if config is not None:
+        cfg_dict = config.to_dict() if hasattr(config, "to_dict") \
+            else _as_plain(config)
+        cfg_hash = config.hash() if hasattr(config, "hash") \
+            else config_digest(cfg_dict)
+    env = {"MADSIM_TEST_SEED": str(int(seed)), "MADSIM_TEST_NUM": "1",
+           "MADSIM_TEST_BACKEND": backend}
+    if batch is not None:
+        env["MADSIM_TEST_BATCH"] = str(int(batch))
+    if config_path:
+        env["MADSIM_TEST_CONFIG"] = config_path
+    if time_limit is not None:
+        env["MADSIM_TEST_TIME_LIMIT"] = str(time_limit)
+    bundle = {
+        "version": BUNDLE_VERSION,
+        "kind": "host_test",
+        "seed": int(seed),
+        "test": test_id,
+        "test_file": test_file,
+        "backend": backend,
+        "batch": batch,
+        "config": cfg_dict,
+        "config_hash": cfg_hash or config_digest({"test": test_id,
+                                                  "backend": backend}),
+        "env": env,
+        "error": error,
+        "extra": dict(extra or {}),
+    }
+    return _write(bundle, path, f"repro-seed{int(seed)}")
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Read and validate a bundle written by the writers above."""
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    if bundle.get("version") != BUNDLE_VERSION:
+        raise ValueError(
+            f"unsupported bundle version {bundle.get('version')!r} "
+            f"(this build reads version {BUNDLE_VERSION})")
+    if bundle.get("kind") not in ("device_sweep", "host_test"):
+        raise ValueError(f"unknown bundle kind {bundle.get('kind')!r}")
+    return bundle

@@ -1,0 +1,196 @@
+"""obs CLI: ``python -m madsim_tpu.obs replay ...``.
+
+Replays a failing seed and exports its timeline — the device analog of
+re-running a reference test with ``MADSIM_TEST_SEED`` pinned and
+``MADSIM_LOG`` on, except the whole recipe can ride in a repro bundle:
+
+    # a seed from SweepResult.failing_seeds, explicit config
+    python -m madsim_tpu.obs replay --seed 17234 --actor raft \\
+        --actor-config '{"n": 3, "buggy_double_vote": true}' \\
+        --out trace.json
+
+    # a bundle written by a failing sweep/@test (obs/bundle.py)
+    python -m madsim_tpu.obs replay --bundle repro.json --out trace.json
+
+Device bundles re-trace the seed through the same actor/config/schedule
+and write Chrome trace-event JSON (``--format text`` for a terminal
+rendering); host-test bundles re-import the recorded test entry point
+and re-run it under the bundle's pinned ``MADSIM_TEST_*`` environment.
+Exit codes: 0 = replay ran (and reproduced the recorded failure, when
+one was recorded), 1 = a recorded failure did NOT reproduce, 2 = usage.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from .bundle import load_bundle
+from .timeline import dump_chrome, render_text, trace_to_chrome
+
+
+def _actor_registry() -> Dict[str, tuple]:
+    from ..engine import (PBActor, PBDeviceConfig, RaftActor,
+                          RaftDeviceConfig, TPCActor, TPCDeviceConfig)
+
+    return {
+        "raft": (RaftActor, RaftDeviceConfig),
+        "pb": (PBActor, PBDeviceConfig),
+        "tpc": (TPCActor, TPCDeviceConfig),
+    }
+
+
+def _replay_device(seed: int, actor_name: str, actor_config: Dict[str, Any],
+                   engine_config: Dict[str, Any], faults,
+                   max_steps: int, out: Optional[str], fmt: str,
+                   expect_bug: Optional[bool]) -> int:
+    import numpy as np
+
+    from ..engine import DeviceEngine, EngineConfig
+
+    registry = _actor_registry()
+    if actor_name not in registry:
+        print(f"obs replay: unknown actor {actor_name!r} "
+              f"(known: {sorted(registry)})", file=sys.stderr)
+        return 2
+    actor_cls, acfg_cls = registry[actor_name]
+    acfg = acfg_cls(**(actor_config or {}))
+    actor = actor_cls(acfg)
+    cfg = EngineConfig(**(engine_config or {"n_nodes": acfg.n}))
+    frows = None if faults is None else np.asarray(faults, np.int32)
+    eng = DeviceEngine(actor, cfg)
+    trace = eng.trace(int(seed), max_steps=max_steps, faults=frows)
+    bug_seen = any(e.get("bug_raised") for e in trace)
+    if fmt == "text":
+        text = render_text(trace)
+        if out:
+            with open(out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+    else:
+        doc = trace_to_chrome(trace, seed=int(seed),
+                              label=f"{actor_name} seed {seed}")
+        if out:
+            dump_chrome(doc, out)
+        else:
+            json.dump(doc, sys.stdout, indent=1)
+            print()
+    print(f"obs replay: seed {seed} ({actor_name}): {len(trace)} events, "
+          f"invariant {'RAISED' if bug_seen else 'held'}"
+          + (f", wrote {out}" if out else ""), file=sys.stderr)
+    if expect_bug and not bug_seen:
+        print("obs replay: bundle recorded a failure but the invariant "
+              "held on replay — config/schedule drift?", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _load_test_module(mod_name: str, test_file: Optional[str]):
+    """Import the bundle's test module by name, falling back to loading
+    its recorded source file — a test defined in a directly-run script
+    records module ``__main__``, which only the file path can resolve."""
+    if mod_name != "__main__":
+        try:
+            return importlib.import_module(mod_name)
+        except ImportError:
+            if not test_file:
+                raise
+    if not test_file:
+        raise ImportError(
+            f"bundle test module {mod_name!r} is not importable and no "
+            "test_file was recorded")
+    spec = importlib.util.spec_from_file_location("_madsim_repro_target",
+                                                  test_file)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _replay_host_test(bundle: Dict[str, Any]) -> int:
+    test_id = bundle.get("test")
+    if not test_id or ":" not in test_id:
+        print("obs replay: host_test bundle has no importable test id "
+              f"({test_id!r})", file=sys.stderr)
+        return 2
+    mod_name, qualname = test_id.split(":", 1)
+    # The bundle's env block IS the repro recipe — apply it verbatim
+    # (this process exists only to replay; no restore needed).
+    for k, v in (bundle.get("env") or {}).items():
+        os.environ[k] = str(v)
+    mod = _load_test_module(mod_name, bundle.get("test_file"))
+    fn = mod
+    for part in qualname.split("."):
+        fn = getattr(fn, part)
+    recorded = bundle.get("error")
+    try:
+        fn()
+    except BaseException as exc:  # noqa: BLE001 — the failure is the point
+        got = f"{type(exc).__name__}: {exc}"
+        if recorded is None or got.split(":")[0] == recorded.split(":")[0]:
+            print(f"obs replay: reproduced {got!r} "
+                  f"(bundle recorded {recorded!r})", file=sys.stderr)
+            return 0
+        print(f"obs replay: raised {got!r} but the bundle recorded "
+              f"{recorded!r}", file=sys.stderr)
+        return 1
+    if recorded is None:
+        print("obs replay: test passed (no error was recorded)",
+              file=sys.stderr)
+        return 0
+    print(f"obs replay: test PASSED but the bundle recorded {recorded!r} "
+          "— failure did not reproduce", file=sys.stderr)
+    return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="madsim_tpu.obs",
+        description="observability tools: replay failing seeds, export "
+                    "timelines (docs/observability.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("replay", help="replay a seed / repro bundle and "
+                                       "export its timeline")
+    rp.add_argument("--bundle", help="repro bundle JSON (obs/bundle.py)")
+    rp.add_argument("--seed", type=int, help="seed to replay (without a "
+                                             "bundle)")
+    rp.add_argument("--actor", help="actor family: raft | pb | tpc")
+    rp.add_argument("--actor-config", default=None,
+                    help="JSON dict of actor-config overrides")
+    rp.add_argument("--engine-config", default=None,
+                    help="JSON dict of EngineConfig fields (n_nodes, ...)")
+    rp.add_argument("--faults", default=None,
+                    help="JSON (F, 4) fault rows [time_us, op, a, b]")
+    rp.add_argument("--max-steps", type=int, default=None)
+    rp.add_argument("--out", default=None, help="output file (default: "
+                                                "stdout)")
+    rp.add_argument("--format", choices=("chrome", "text"), default="chrome")
+    args = ap.parse_args(argv)
+
+    if args.bundle:
+        bundle = load_bundle(args.bundle)
+        if bundle["kind"] == "host_test":
+            return _replay_host_test(bundle)
+        return _replay_device(
+            seed=bundle["seed"], actor_name=bundle["actor"],
+            actor_config=bundle.get("actor_config") or {},
+            engine_config=bundle.get("engine_config") or {},
+            faults=bundle.get("faults"),
+            max_steps=args.max_steps or int(bundle.get("max_steps", 2_000)),
+            out=args.out, fmt=args.format,
+            expect_bug=bundle.get("error") is not None)
+    if args.seed is None or not args.actor:
+        ap.error("replay needs --bundle, or --seed and --actor")
+    return _replay_device(
+        seed=args.seed, actor_name=args.actor,
+        actor_config=json.loads(args.actor_config) if args.actor_config
+        else {},
+        engine_config=json.loads(args.engine_config) if args.engine_config
+        else None,
+        faults=json.loads(args.faults) if args.faults else None,
+        max_steps=args.max_steps or 2_000, out=args.out, fmt=args.format,
+        expect_bug=None)

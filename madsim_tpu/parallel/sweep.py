@@ -300,18 +300,44 @@ class SweepResult:
     # scalar_fetches, retire_fetches, loop_wall_s, superstep_max,
     # chunk_steps.
     loop_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Fault-schedule fingerprint (sha256 over the padded rows, or of
+    # b"none"): rides the result so repro banners and bundles can assert
+    # the replay used the same schedule — a seed alone does not pin the
+    # trajectory when schedules vary per run.
+    faults_sha256: Optional[str] = None
 
     @property
     def failing_seeds(self) -> List[int]:
         return [int(s) for s in self.seeds[self.bug]]
 
+    @property
+    def metrics(self) -> Optional[Dict[str, Any]]:
+        """Simulation metrics frames (docs/observability.md), or ``None``
+        when the sweep ran metrics-off: ``{"per_seed": {field: (n, ...)
+        array}, "aggregate": {field: int | [int]}}``. Per-seed rows are
+        attributed through the same slot→seed machinery as every other
+        observation, so they survive recycling/compaction; the aggregate
+        is the fleet sum (``bench.py`` records it as ``sim_metrics``)."""
+        from ..obs.metrics import aggregate_metrics, metrics_from_observations
+
+        per_seed = metrics_from_observations(self.observations)
+        if per_seed is None:
+            return None
+        return {"per_seed": per_seed, "aggregate": aggregate_metrics(per_seed)}
+
     def repro_banner(self) -> Optional[str]:
         """The failing-seed reproduction hint (`runtime/mod.rs:192-199`)."""
         if not self.failing_seeds:
             return None
-        return ("note: run with environment variable "
-                f"MADSIM_TEST_SEED={self.failing_seeds[0]} to reproduce "
-                f"this failure ({len(self.failing_seeds)} failing seeds total)")
+        banner = ("note: run with environment variable "
+                  f"MADSIM_TEST_SEED={self.failing_seeds[0]} to reproduce "
+                  f"this failure ({len(self.failing_seeds)} failing seeds "
+                  "total)")
+        if self.faults_sha256 is not None:
+            banner += (f"\nnote: fault-schedule sha256: "
+                       f"{self.faults_sha256[:16]} (replay must use the "
+                       "same schedule)")
+        return banner
 
 
 def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = None,
@@ -851,7 +877,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                        n_active_history=np.asarray(n_active_hist, np.int64),
                        world_utilization=util,
                        n_active_chunks=np.asarray(n_active_chunk, np.int64),
-                       loop_stats=loop_stats)
+                       loop_stats=loop_stats,
+                       faults_sha256=(seeds_meta["faults_sha256"]
+                                      if faults is not None else None))
 
 
 def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:

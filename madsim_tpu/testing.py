@@ -71,6 +71,13 @@ class Builder:
         if batch is not None and batch < 1:
             raise ValueError("batch must be >= 1")
         self.batch = batch
+        # ``module:qualname`` of the decorated test, when driven through
+        # @test/@main — repro bundles (obs/bundle.py) record it so the
+        # CLI can re-import and re-run the exact entry point. test_file
+        # is the source path fallback for tests whose module is not
+        # importable by name (scripts run as __main__).
+        self.test_id: Optional[str] = None
+        self.test_file: Optional[str] = None
 
     @staticmethod
     def from_env() -> "Builder":
@@ -142,8 +149,8 @@ class Builder:
         def run_seed(seed: int) -> Any:
             try:
                 return self._run_one(seed, make_coro)
-            except BaseException:
-                self._print_banner(seed)
+            except BaseException as exc:
+                self._print_banner(seed, error=exc)
                 raise
 
         if self.jobs == 1:
@@ -159,13 +166,52 @@ class Builder:
                     result = fut.result()
         return result
 
-    def _print_banner(self, seed: int) -> None:
+    def _print_banner(self, seed: int,
+                      error: Optional[BaseException] = None) -> None:
+        import hashlib
+        import json
+
         config = self.config if self.config is not None else Config()
+        # The fault-model digest (net loss/latency + fs latency knobs):
+        # unlike the whole-config hash it names exactly the schedule a
+        # replay must match, so drift in unrelated config is visible as
+        # "hash differs, fault digest same".
+        cfg_dict = config.to_dict()
+        fault_digest = hashlib.sha256(json.dumps(
+            {"net": cfg_dict["net"], "fs": cfg_dict["fs"]},
+            sort_keys=True).encode()).hexdigest()[:16]
+        # Backend knobs ride the banner too: a bridge-backend failure is
+        # only reproducible under the same backend + batch width, and the
+        # defaults depend on the invoking environment.
+        env_line = f"MADSIM_TEST_BACKEND={self.backend}"
+        if self.batch is not None:
+            env_line += f" MADSIM_TEST_BATCH={self.batch}"
         banner = (
             "note: run with environment variable "
             f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"
-            f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}"
+            f"note: config hash: MADSIM_CONFIG_HASH={config.hash()}\n"
+            f"note: fault-schedule digest: MADSIM_FAULT_SHA={fault_digest}\n"
+            f"note: backend: {env_line}"
         )
+        repro_dir = os.environ.get("MADSIM_REPRO_DIR")
+        if repro_dir:
+            try:
+                from .obs.bundle import write_test_bundle
+
+                os.makedirs(repro_dir, exist_ok=True)
+                path = write_test_bundle(
+                    repro_dir, seed=seed, test_id=self.test_id,
+                    test_file=self.test_file,
+                    backend=self.backend, batch=self.batch,
+                    config=self.config, config_path=self.config_path,
+                    time_limit=self.time_limit,
+                    error=(f"{type(error).__name__}: {error}"
+                           if error is not None else None))
+                banner += (f"\nnote: repro bundle written: {path} "
+                           "(replay: python -m madsim_tpu.obs replay "
+                           f"--bundle {path})")
+            except OSError as exc:
+                banner += f"\nnote: repro bundle write failed: {exc}"
         if sys.flags.hash_randomization:
             # The reference seeds std's RandomState so HashMap
             # iteration is part of the deterministic world
@@ -208,7 +254,7 @@ class Builder:
         result: Any = None
         for outcome in outcomes:
             if outcome.error is not None:
-                self._print_banner(outcome.seed)
+                self._print_banner(outcome.seed, error=outcome.error)
                 raise outcome.error
             result = outcome.value
         return result
@@ -250,6 +296,11 @@ def test(fn: Optional[Callable] = None, *, seed: Optional[int] = None, count: Op
         def runner(*args, **kwargs):
             init_logger()
             b = Builder.from_env()
+            b.test_id = f"{async_fn.__module__}:{async_fn.__qualname__}"
+            try:
+                b.test_file = inspect.getfile(async_fn)
+            except TypeError:
+                b.test_file = None
             if seed is not None:
                 b.seed = seed
                 b.seed_from_walltime = False
@@ -286,7 +337,13 @@ def main(fn: Callable[..., Coroutine]) -> Callable:
     @functools.wraps(fn)
     def runner(*args, **kwargs):
         init_logger()
-        return Builder.from_env().run(lambda: fn(*args, **kwargs))
+        b = Builder.from_env()
+        b.test_id = f"{fn.__module__}:{fn.__qualname__}"
+        try:
+            b.test_file = inspect.getfile(fn)
+        except TypeError:
+            b.test_file = None
+        return b.run(lambda: fn(*args, **kwargs))
 
     return runner
 

@@ -1,0 +1,17 @@
+"""Golden fixture: wall-clock *decode* calls in a timeline/export path.
+
+Timeline timestamps must come from virtual time (obs/timeline.py): the
+no-operand decode forms read the host clock and make two replays of one
+seed render different bytes — DET001. The explicit-operand forms are
+pure converters and stay clean.
+"""
+import time
+
+
+def render_header(virtual_us: int):
+    stamp = time.ctime()              # reads the wall clock
+    local = time.localtime()          # reads the wall clock
+    label = time.strftime("%H:%M")    # 1-arg form defaults to "now"
+    ok = time.ctime(virtual_us / 1e6)                    # pure conversion
+    ok2 = time.strftime("%H:%M", time.gmtime(virtual_us / 1e6))  # pure
+    return stamp, local, label, ok, ok2

@@ -1,0 +1,11 @@
+"""A deliberately failing @madsim_tpu.test, used by tests/test_obs.py to
+round-trip a repro bundle through `python -m madsim_tpu.obs replay`."""
+import madsim_tpu as ms
+
+
+@ms.test
+async def always_fails():
+    from madsim_tpu import time as simtime
+
+    await simtime.sleep(0.01)
+    raise RuntimeError("obs bundle fixture failure")

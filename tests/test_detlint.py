@@ -16,6 +16,7 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "detlint")
 # fixture -> {rule code: expected finding count} (golden findings).
 GOLDEN = {
     "bad_wallclock.py": {"DET001": 3},
+    "bad_timeline.py": {"DET001": 3},
     "bad_entropy.py": {"DET002": 4},
     "bad_threads.py": {"DET003": 3},
     "bad_hostinfo.py": {"DET004": 2},
@@ -101,6 +102,36 @@ def test_self_scan_is_clean():
     allow = Allowlist.load(os.path.join(REPO, "detlint-allow.txt"))
     findings = run_lint(REPO, ["madsim_tpu", "tools"], allow)
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_self_scan_covers_obs_package():
+    """The observability package is inside the default scan surface AND
+    clean WITHOUT any allowlist — timeline/bundle code must never read
+    the wall clock (timestamps are virtual time; DET001 + the
+    clock-default decode extension)."""
+    from madsim_tpu.analysis.escape import iter_py_files
+
+    files = iter_py_files(REPO, ["madsim_tpu"])
+    for rel in ("madsim_tpu/obs/timeline.py", "madsim_tpu/obs/metrics.py",
+                "madsim_tpu/obs/bundle.py", "madsim_tpu/obs/cli.py"):
+        assert rel in files, f"{rel} escaped the default lint surface"
+    findings = run_lint(REPO, ["madsim_tpu/obs"], Allowlist.empty())
+    findings = [f for f in findings if f.path.startswith("madsim_tpu/obs")]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_clock_default_decode_calls_flag_only_defaulted_operands():
+    """The DET001 decode extension: no-operand forms escape, explicit
+    virtual-time operands are pure conversions and stay clean."""
+    flagged = scan_source("import time\nx = time.localtime()\n", "x.py")
+    assert [f.rule for f in flagged] == ["DET001"]
+    assert scan_source("import time\nx = time.localtime(12.5)\n",
+                       "x.py") == []
+    assert scan_source(
+        "import time\nx = time.strftime('%H', time.gmtime(3))\n",
+        "x.py") == []
+    (f,) = scan_source("import time\nx = time.strftime('%H')\n", "x.py")
+    assert f.rule == "DET001"
 
 
 # -- pass 2: sim/real parity ------------------------------------------------

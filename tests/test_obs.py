@@ -1,0 +1,374 @@
+"""Observability subsystem (PR "Flight recorder"): device-resident
+metrics, timeline export, and repro bundles (docs/observability.md).
+
+The load-bearing contract under test is **bitwise invisibility**:
+``EngineConfig(metrics=True)`` rides a write-only pytree leaf alongside
+``WorldState``, so a metrics-on sweep walks bit-identical trajectories
+to metrics-off — for every actor family, across the plain, recycled and
+pipelined orchestration modes — while metrics-off compiles the exact
+pre-metrics program (the op budget in tests/test_queue_insert.py is the
+other half of that gate).
+"""
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import (
+    DeviceEngine,
+    EngineConfig,
+    FAULT_KILL,
+    FAULT_RESTART,
+    FAULT_RESUME,
+    PBActor,
+    PBDeviceConfig,
+    RaftActor,
+    RaftDeviceConfig,
+    TPCActor,
+    TPCDeviceConfig,
+)
+from madsim_tpu.obs import (
+    NUM_FAULT_KINDS,
+    MetricsBlock,
+    render_text,
+    trace_to_chrome,
+)
+from madsim_tpu.obs.bundle import (
+    load_bundle,
+    write_sweep_bundle,
+    write_test_bundle,
+)
+from madsim_tpu.obs.cli import main as obs_main
+from madsim_tpu.parallel.sweep import sweep
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+RAFT_FAULTS = np.array([[300_000, FAULT_KILL, 0, 0],
+                        [700_000, FAULT_RESTART, 0, 0]], np.int32)
+
+_FAMILIES = {
+    "raft": (lambda: RaftActor(RaftDeviceConfig(n=3, n_proposals=2,
+                                                buggy_double_vote=True)),
+             EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                          t_limit_us=1_500_000),
+             RAFT_FAULTS),
+    "pb": (lambda: PBActor(PBDeviceConfig(n=3, n_writes=4)),
+           EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                        t_limit_us=1_200_000, loss_rate=0.05),
+           None),
+    "tpc": (lambda: TPCActor(TPCDeviceConfig(n=4, n_txns=4,
+                                             buggy_presumed_commit=True)),
+            EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                         t_limit_us=1_200_000, loss_rate=0.1),
+            None),
+}
+
+_MODES = {
+    "plain": dict(pipeline=False),
+    "recycled": dict(recycle=True, batch_worlds=16, pipeline=True),
+    "pipelined": dict(pipeline=True),
+}
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """One metrics-off + one metrics-on engine per family, shared across
+    the mode matrix (engine builds dominate this module's runtime)."""
+    out = {}
+    for name, (make_actor, cfg, faults) in _FAMILIES.items():
+        out[name] = (
+            DeviceEngine(make_actor(), cfg),
+            DeviceEngine(make_actor(),
+                         dataclasses.replace(cfg, metrics=True)),
+            faults,
+        )
+    return out
+
+
+def test_fault_hist_width_matches_engine_op_range():
+    # obs/metrics.py must not import the engine (the engine imports it),
+    # so the histogram width is pinned by this assertion instead.
+    assert NUM_FAULT_KINDS == FAULT_RESUME + 1
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: bitwise invisibility across families x orchestration modes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+@pytest.mark.parametrize("mode", sorted(_MODES))
+def test_metrics_on_sweep_bitwise_identical(engines, family, mode):
+    eng_off, eng_on, faults = engines[family]
+    seeds = np.arange(40)
+    kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+              **_MODES[mode])
+    res_off = sweep(None, eng_off.cfg, seeds, engine=eng_off, **kw)
+    res_on = sweep(None, eng_on.cfg, seeds, engine=eng_on, **kw)
+    # Every non-metrics observation bitwise equal, same occupancy story.
+    assert not any(k.startswith("m_") for k in res_off.observations)
+    for k, v in res_off.observations.items():
+        np.testing.assert_array_equal(v, res_on.observations[k], err_msg=k)
+    np.testing.assert_array_equal(res_off.n_active_history,
+                                  res_on.n_active_history)
+    assert res_off.failing_seeds == res_on.failing_seeds
+    assert res_off.steps_run == res_on.steps_run
+    # The metrics frames exist, attribute per seed, and are consistent
+    # with the engine's own counters.
+    assert res_off.metrics is None
+    m = res_on.metrics
+    assert set(m["per_seed"]) == set(MetricsBlock._fields)
+    obs = res_on.observations
+    ps = m["per_seed"]
+    np.testing.assert_array_equal(
+        ps["msgs_delivered"] + ps["timer_fires"], obs["delivered"])
+    np.testing.assert_array_equal(
+        ps["drop_stale"] + ps["drop_dead"], obs["dropped"])
+    np.testing.assert_array_equal(ps["vtime_us"], obs["now_us"])
+    np.testing.assert_array_equal(ps["kind_hist"].sum(axis=1),
+                                  obs["delivered"])
+    if faults is not None:
+        # Any world whose clock passed a fault row's time popped that
+        # row first (earliest-first pop order): its histogram bin is 1.
+        # Worlds frozen earlier (stop_on_bug) legitimately show 0.
+        past_kill = obs["now_us"] > 300_000
+        past_restart = obs["now_us"] > 700_000
+        assert (ps["fault_hist"][past_kill, FAULT_KILL] == 1).all()
+        assert (ps["fault_hist"][past_restart, FAULT_RESTART] == 1).all()
+        assert (ps["fault_hist"] <= 1).all()
+    # The aggregate frame is plain JSON (the bench sim_metrics contract).
+    # (No msgs_sent >= msgs_delivered identity: init-scheduled events —
+    # proposals, writes — deliver as messages without a send.)
+    json.dumps(m["aggregate"])
+    agg = m["aggregate"]
+    assert agg["msgs_sent"] > 0 and agg["timer_fires"] > 0
+    assert agg["drop_loss"] <= agg["msgs_sent"]
+
+
+def test_metrics_survive_checkpoint_resume(engines, tmp_path):
+    """The extra leaf rides the checkpoint format unchanged: a resumed
+    metrics-on sweep equals the unbroken run, counters included."""
+    _off, eng_on, faults = engines["raft"]
+    seeds = np.arange(24)
+    full = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
+                 max_steps=3_000, faults=faults)
+    path = str(tmp_path / "m.npz")
+    sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
+          max_steps=256, faults=faults, checkpoint_path=path,
+          checkpoint_every_chunks=1)
+    resumed = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
+                    max_steps=3_000, faults=faults, checkpoint_path=path,
+                    resume=True)
+    for k, v in full.observations.items():
+        np.testing.assert_array_equal(v, resumed.observations[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: trace truncation marker
+# ---------------------------------------------------------------------------
+
+def test_trace_truncation_marker_and_warning(engines):
+    # The clean PB world runs far past 20 steps: the cut must be marked.
+    pb_off, _on, _f = engines["pb"]
+    with pytest.warns(RuntimeWarning, match="truncated at max_steps"):
+        tr = pb_off.trace(0, max_steps=20)
+    assert tr[-1]["kind"] == "truncated"
+    assert tr[-1]["step"] == 20 and tr[-1]["bug_seen"] is False
+    # A completed world gets NO marker: the buggy raft config freezes on
+    # the invariant raise well inside the window.
+    eng_off, _on, _f = engines["raft"]
+    failing = _first_failing_seed(eng_off)
+    full = eng_off.trace(failing, max_steps=4_000)
+    assert full[-1]["kind"] != "truncated"
+    assert any(e.get("bug_raised") for e in full)
+
+
+def _first_failing_seed(eng) -> int:
+    res = sweep(None, eng.cfg, np.arange(128), engine=eng, chunk_steps=64,
+                max_steps=4_000)
+    assert res.failing_seeds, "buggy config found no failing seed"
+    return res.failing_seeds[0]
+
+
+# ---------------------------------------------------------------------------
+# Timeline export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_ends_at_invariant_raise(engines):
+    eng_off, _on, _f = engines["raft"]
+    seed = _first_failing_seed(eng_off)
+    tr = eng_off.trace(seed, max_steps=4_000)
+    doc = trace_to_chrome(tr, seed=seed)
+    blob = json.dumps(doc)  # must be valid JSON end to end
+    doc2 = json.loads(blob)
+    events = doc2["traceEvents"]
+    assert events[0]["ph"] == "M"
+    body = [e for e in events if e["ph"] == "i"]
+    assert len(body) >= len([e for e in tr if e["kind"] != "truncated"])
+    assert events[-1]["name"] == "invariant:raise"
+    # Timestamps are the virtual-time microseconds, monotone nondecreasing.
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts)
+    assert doc2["otherData"]["clock"] == "virtual_us"
+    text = render_text(tr)
+    assert "INVARIANT VIOLATION" in text
+    assert "truncated" not in text
+
+
+def test_text_renderer_marks_truncation(engines):
+    pb_off, _on, _f = engines["pb"]
+    with pytest.warns(RuntimeWarning):
+        tr = pb_off.trace(1, max_steps=15)
+    text = render_text(tr)
+    assert "trace truncated" in text and "bug never seen" in text
+    doc = trace_to_chrome(tr, seed=1)
+    assert doc["traceEvents"][-1]["name"] == "truncated"
+
+
+def test_polls_to_chrome_host_trace():
+    import madsim_tpu as ms
+    from madsim_tpu.obs import polls_to_chrome
+
+    rt = ms.Runtime(seed=3)
+    rt.task.trace = polls = []
+
+    async def body():
+        from madsim_tpu import time as simtime
+
+        await simtime.sleep(0.05)
+        return 7
+
+    assert rt.block_on(body()) == 7
+    assert polls, "host runtime recorded no polls"
+    doc = polls_to_chrome(polls, seed=3)
+    body_evs = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(body_evs) == len(polls)
+    assert body_evs[-1]["ts"] == pytest.approx(polls[-1][1] / 1_000.0)
+
+
+# ---------------------------------------------------------------------------
+# Repro bundles + CLI round trips
+# ---------------------------------------------------------------------------
+
+def test_device_bundle_round_trips_through_cli(engines, tmp_path, capsys):
+    eng_off, _on, _f = engines["raft"]
+    seed = _first_failing_seed(eng_off)
+    path = write_sweep_bundle(
+        str(tmp_path), seed=seed, actor="raft",
+        actor_config=eng_off.actor.rcfg, engine_config=eng_off.cfg,
+        max_steps=4_000, error="RaftInvariantViolation: double vote")
+    bundle = load_bundle(path)
+    assert bundle["kind"] == "device_sweep" and bundle["seed"] == seed
+    assert bundle["config_hash"]
+    out = str(tmp_path / "trace.json")
+    rc = obs_main(["replay", "--bundle", path, "--out", out])
+    assert rc == 0, capsys.readouterr().err
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"][-1]["name"] == "invariant:raise"
+
+
+def test_device_bundle_unreproduced_failure_exits_nonzero(tmp_path):
+    # A bundle claiming a failure on a CLEAN config must not silently
+    # "reproduce": the CLI exits 1 when the invariant holds.
+    path = write_sweep_bundle(
+        str(tmp_path), seed=0, actor="raft",
+        actor_config=RaftDeviceConfig(n=3),
+        engine_config=EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                                   t_limit_us=200_000),
+        max_steps=2_000, error="RaftInvariantViolation: double vote")
+    rc = obs_main(["replay", "--bundle", path,
+                   "--out", str(tmp_path / "t.json")])
+    assert rc == 1
+
+
+def test_failing_test_writes_bundle_and_cli_reproduces(tmp_path,
+                                                       monkeypatch):
+    """The acceptance round trip: a failing @test writes a repro bundle
+    (MADSIM_REPRO_DIR), and the CLI replays it to the same bug."""
+    monkeypatch.syspath_prepend(FIXTURES)
+    monkeypatch.setenv("MADSIM_TEST_SEED", "7")
+    monkeypatch.setenv("MADSIM_REPRO_DIR", str(tmp_path))
+    monkeypatch.delenv("MADSIM_TEST_BACKEND", raising=False)
+    import obs_failing_test
+
+    with pytest.raises(RuntimeError, match="obs bundle fixture failure"):
+        obs_failing_test.always_fails()
+    bundles = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(bundles) == 1, bundles
+    path = os.path.join(str(tmp_path), bundles[0])
+    bundle = load_bundle(path)
+    assert bundle["kind"] == "host_test"
+    assert bundle["test"] == "obs_failing_test:always_fails"
+    assert bundle["env"]["MADSIM_TEST_SEED"] == "7"
+    assert bundle["error"].startswith("RuntimeError")
+    # Stop the replayed failure from writing bundle-on-bundle into the
+    # assertion above's directory.
+    monkeypatch.delenv("MADSIM_REPRO_DIR")
+    rc = obs_main(["replay", "--bundle", path])
+    assert rc == 0
+
+
+def test_banner_carries_backend_batch_and_fault_digest(capsys,
+                                                       monkeypatch):
+    import madsim_tpu as ms
+
+    monkeypatch.delenv("MADSIM_REPRO_DIR", raising=False)
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = 0.25
+    b = ms.Builder(seed=11, backend="bridge", batch=4, config=cfg)
+    b._print_banner(11, error=RuntimeError("x"))
+    err = capsys.readouterr().err
+    assert "MADSIM_TEST_SEED=11" in err
+    assert "MADSIM_CONFIG_HASH=" in err
+    assert "MADSIM_FAULT_SHA=" in err
+    assert "MADSIM_TEST_BACKEND=bridge" in err
+    assert "MADSIM_TEST_BATCH=4" in err
+    # The fault digest tracks the fault model, not unrelated config.
+    import re
+
+    sha = re.search(r"MADSIM_FAULT_SHA=(\w+)", err).group(1)
+    b2 = ms.Builder(seed=11)  # default fault model
+    b2._print_banner(11)
+    sha2 = re.search(r"MADSIM_FAULT_SHA=(\w+)",
+                     capsys.readouterr().err).group(1)
+    assert sha != sha2
+
+
+def test_sweep_result_banner_names_fault_schedule(engines):
+    eng_off, _on, faults = engines["raft"]
+    res = sweep(None, eng_off.cfg, np.arange(64), engine=eng_off,
+                chunk_steps=64, max_steps=4_000, faults=faults)
+    banner = res.repro_banner()
+    assert banner and "fault-schedule sha256:" in banner
+    assert res.faults_sha256
+
+
+# ---------------------------------------------------------------------------
+# Bridge: the kernel's metrics block is trajectory-invisible too
+# ---------------------------------------------------------------------------
+
+def test_bridge_metrics_block_is_trajectory_invisible():
+    from madsim_tpu.bridge.runtime import _sweep_impl
+
+    async def world():
+        from madsim_tpu import time as simtime
+
+        for _ in range(4):
+            await simtime.sleep(0.01)
+        return 99
+
+    seeds = list(range(6))
+    plain_outs, plain_traces = _sweep_impl(world, seeds, trace=True)
+    profile: dict = {}
+    prof_outs, prof_traces = _sweep_impl(world, seeds, trace=True,
+                                         profile=profile)
+    assert [o.value for o in plain_outs] == [o.value for o in prof_outs]
+    assert plain_traces == prof_traces  # bit-identical poll sequences
+    sm = profile["sim_metrics"]
+    assert sm["timers_set"] >= 4 * len(seeds)
+    assert sm["events_fired"] >= 4 * len(seeds)
+    assert sm["vtime_ns"] > 0
+    assert sm["msgs_sent"] == 0 and sm["msgs_lost"] == 0

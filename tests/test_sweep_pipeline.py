@@ -277,6 +277,40 @@ def test_zero_step_budget_runs_no_chunks():
     assert pip.n_active_history.size == 0
 
 
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_loop_stats_schema_both_paths(raft_eng, pipeline):
+    """The documented ``loop_stats`` schema (docs/perf.md "Telemetry",
+    docs/observability.md) holds on BOTH orchestration paths, with sane
+    types and values — not just key presence on the default path."""
+    res = sweep(None, raft_eng.cfg, np.arange(48), engine=raft_eng,
+                chunk_steps=64, max_steps=2_048, pipeline=pipeline)
+    ls = res.loop_stats
+    documented = {"device_wait_s", "host_decision_s", "scalar_fetches",
+                  "retire_fetches", "dispatch_depth", "dispatches_per_seed",
+                  "pipelined", "superstep_max", "chunk_steps", "chunks",
+                  "dispatches", "chunks_per_dispatch", "dispatch_s",
+                  "retire_wait_s", "loop_wall_s"}
+    assert documented <= set(ls), sorted(ls)
+    assert ls["pipelined"] is pipeline
+    for key in ("device_wait_s", "host_decision_s", "dispatch_s",
+                "retire_wait_s", "loop_wall_s"):
+        assert isinstance(ls[key], float) and ls[key] >= 0.0, key
+    for key in ("scalar_fetches", "retire_fetches", "dispatch_depth",
+                "chunks", "dispatches", "superstep_max", "chunk_steps"):
+        assert isinstance(ls[key], int) and ls[key] >= 0, key
+    assert ls["chunks"] >= 1 and ls["dispatches"] >= 1
+    assert ls["scalar_fetches"] >= 1
+    assert ls["retire_fetches"] == 0       # plain sweep: nothing retires
+    assert ls["chunk_steps"] == 64
+    assert ls["superstep_max"] == (16 if pipeline else 1)
+    assert ls["dispatches_per_seed"] == pytest.approx(
+        ls["dispatches"] / 48, abs=1e-6)
+    # Dispatch-ahead runs exactly one superstep deep; the serial loop
+    # never dispatches ahead at all.
+    assert ls["dispatch_depth"] == (1 if pipeline else 0)
+    assert ls["loop_wall_s"] >= ls["host_decision_s"]
+
+
 def test_superstep_telemetry_fields(raft_eng):
     """SweepResult.loop_stats carries the bench contract fields
     (bench_results.json configs.*.sweep_loop, asserted by make smoke)."""
