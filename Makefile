@@ -9,7 +9,7 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint test smoke dryrun determinism dualmode native clean \
-        replay-demo
+        replay-demo bench-diff
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -59,7 +59,19 @@ smoke:
 	       'fault_hist','enqueued','vtime_us'}; \
 	assert all(isinstance(x,dict) and mneed<=set(x) for x in sm), \
 	    f'sim_metrics records missing/incomplete: {sm}'; \
+	cv=[d['configs'][k].get('coverage') for k in \
+	    ('time_to_first_bug','madraft_5node')]; \
+	assert all(isinstance(x,dict) and x.get('distinct_behaviors',0)>1 \
+	           for x in cv), f'coverage records missing/flat: {cv}'; \
 	print('bench_results.json ok:', d['metric'])"
+
+# Regression table between two bench rounds (tools/bench_diff.py):
+# compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls
+# and coverage. Default (--auto) diffs the newest BENCH_r*.json round
+# against bench_results.json when present, else the two newest rounds.
+# CI runs it after smoke whenever a previous round artifact exists.
+bench-diff:
+	$(PY) tools/bench_diff.py --auto
 
 # End-to-end repro-bundle workflow (docs/observability.md): sweep a known
 # buggy config, write a repro bundle for a failing seed, replay it through

@@ -609,6 +609,10 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     res_m = sweep(None, eng_m.cfg, np.arange(rec_w), faults=faults[:rec_w],
                   engine=eng_m, chunk_steps=16, max_steps=20_000)
     sim_metrics = {"n_worlds": rec_w, **res_m.metrics["aggregate"]}
+    # Behavior-coverage rollup of the same probe (docs/observability.md
+    # "reading the novelty curve"; `make smoke` asserts
+    # distinct_behaviors > 1).
+    coverage = res_m.coverage.to_json()
     del eng_m, res_m
 
     # Warmup compile on the SAME batch shape as the timed run (jit
@@ -646,7 +650,9 @@ def bench_madraft_5node(n_worlds: int) -> dict:
            "xla_cost": xla_cost,
            # Fleet-aggregate simulation metrics of the metrics-on probe
            # sweep (docs/observability.md; asserted by `make smoke`).
-           "sim_metrics": sim_metrics}
+           "sim_metrics": sim_metrics,
+           # Behavior-coverage ledger rollup of the same probe sweep.
+           "coverage": coverage}
     log(f"madraft_5node[{jax.default_backend()}]: {dt:.2f}s  {out}")
     return out
 
@@ -812,6 +818,7 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
     res_m = device_sweep(None, eng_m.cfg, np.arange(rec_w_m), engine=eng_m,
                          chunk_steps=64, max_steps=4_000)
     sim_metrics = {"n_worlds": rec_w_m, **res_m.metrics["aggregate"]}
+    coverage = res_m.coverage.to_json()
     del eng_m, res_m
 
     # Expected seeds to first bug = 1/rate; the device explores
@@ -842,6 +849,9 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         # Fleet-aggregate simulation metrics of the metrics-on probe
         # sweep (docs/observability.md; asserted by `make smoke`).
         "sim_metrics": sim_metrics,
+        # Behavior-coverage ledger rollup of the same probe sweep
+        # (docs/observability.md "reading the novelty curve").
+        "coverage": coverage,
         "recycled_hunt": recycled,
         # Orchestration breakdown of the recycled hunt's chunk loop
         # (docs/perf.md "Pipelined orchestration"): the acceptance axes
@@ -970,8 +980,10 @@ def bench_bridge_sweep(n_host: int, n_bridge: int) -> dict:
             for k in ("host_s", "pack_s", "dispatch_s", "settle_s")},
         "bridge_rounds": prof["rounds"],
         # The bridge kernel's device-resident observability block,
-        # aggregated over the fleet (docs/observability.md).
+        # aggregated over the fleet (docs/observability.md), plus the
+        # per-slot behavior-coverage sketch over the same counters.
         "sim_metrics": prof.get("sim_metrics"),
+        "coverage": prof.get("coverage"),
         "note": ("per-seed trajectories bit-identical to host "
                  "(tests/test_bridge.py); task bodies are serial Python, "
                  "so single-core speedup is Amdahl-bounded by the measured "

@@ -37,15 +37,17 @@ def main(n_worlds: int = 4096) -> None:
     faults = np.array([[600_000, FAULT_KILL, 1, 0],
                        [1_200_000, FAULT_RESTART, 1, 0]], np.int32)
 
+    # observe=: the live telemetry stream (docs/observability.md "The
+    # sweep observatory") — one JSONL record per superstep read, tailable
+    # while the sweep runs: python -m madsim_tpu.obs watch <file> --follow
     res = sweep(None, cfg, np.arange(n_worlds), faults=faults, engine=eng,
                 chunk_steps=512, max_steps=8_000,
                 checkpoint_path="/tmp/device_sweep.npz",
-                checkpoint_every_chunks=4)
-    n_bug = len(res.failing_seeds)
-    print(f"swept {n_worlds} worlds on {res.n_devices} device(s): "
-          f"{n_bug} seeds violate election safety "
-          f"(world utilization {res.world_utilization:.0%} over "
-          f"{res.n_active_history.size} chunks)")
+                checkpoint_every_chunks=4,
+                observe="/tmp/device_sweep_telemetry.jsonl")
+    # The one-paragraph operator rendering (seeds, bugs, utilization,
+    # coverage, top drop causes) — no dataclass-repr grepping.
+    print(res.summary())
     st = res.loop_stats
     print(f"orchestration: {st['chunks']} chunks in {st['dispatches']} host "
           f"dispatches ({st['chunks_per_dispatch']}x superstep fan-in); "
@@ -56,6 +58,15 @@ def main(n_worlds: int = 4096) -> None:
           f"{agg['msgs_delivered']} delivered, {agg['timer_fires']} timer "
           f"fires, {agg['drop_loss']} lost, "
           f"{sum(agg['fault_hist'])} faults injected")
+    cov = res.coverage
+    curve = cov.novelty_curve
+    print(f"coverage: {cov.distinct_behaviors} distinct behaviors in "
+          f"{cov.n_buckets} buckets (novelty "
+          f"{int(curve[0]) if curve.size else 0}->"
+          f"{int(curve[-1]) if curve.size else 0}; a still-rising curve "
+          f"means the hunt had not saturated)"
+          f"\ntelemetry: /tmp/device_sweep_telemetry.jsonl "
+          f"(python -m madsim_tpu.obs watch ...)")
     if not res.failing_seeds:
         print("no failing seeds in this sweep — try more worlds")
         return

@@ -151,7 +151,8 @@ def _looks_stdlib(head: str) -> bool:
     already in the table — and guards against flagging `self.time()` etc.,
     whose head is a local object, not a module)."""
     return head in ("time", "os", "random", "uuid", "secrets", "socket",
-                    "threading", "multiprocessing", "datetime", "concurrent")
+                    "threading", "multiprocessing", "datetime", "concurrent",
+                    "jax")
 
 
 def scan_source(source: str, path: str) -> List[Finding]:

@@ -37,6 +37,10 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          "use madsim_tpu.net (Endpoint/TcpStream) or the eventloop shim"),
     Rule("DET006", "id()/hash()-keyed ordering depends on allocation history",
          "sort by a stable field (node id, tag, name), never object identity"),
+    Rule("DET007", "device profiler / wall-clock capture inside sim code",
+         "profile from the observatory layer (madsim_tpu.obs.observatory "
+         "ProfilerWindow / sweep(profile_dir=...)) — step code must stay "
+         "free of host-time observation"),
     Rule("DET900", "stale pragma: allow[...] names a rule with no finding",
          "delete the pragma (or the code that made it necessary came back)"),
     Rule("PAR001", "sim/real API parity drift",
@@ -99,6 +103,13 @@ EXACT_CALLS.update({f"random.{fn}": "DET002" for fn in _RANDOM_GLOBALS})
 # Dotted-prefix matches (any call under the module escapes).
 PREFIX_CALLS: Dict[str, str] = {
     "secrets.": "DET002",
+    # DET007 — jax.profiler trace capture (and its wall-clock timeline)
+    # started from simulation/engine code: the capture observes HOST
+    # time and scheduling, so any code path that branches on it (or a
+    # trace accidentally left running across a step) is a sim-visible
+    # nondeterminism escape. The observatory's host-side emitter
+    # (obs/observatory.py) is the sanctioned site, pragma'd per line.
+    "jax.profiler.": "DET007",
 }
 
 # Clock-DEFAULT calls (DET001, decode-path extension for obs/ timeline

@@ -679,4 +679,13 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             # (docs/observability.md; bench.py records it under
             # configs.bridge_sweep.sim_metrics).
             profile["sim_metrics"] = {k: int(v.sum()) for k, v in mb.items()}
+            # Behavior-coverage sketch over the same block: the host-side
+            # twin of the device sweep's ledger (obs/coverage.py). Bridge
+            # counters are per SLOT and cumulative across recycled seeds
+            # (bridge/kernel.py BridgeMetrics), so this is per-slot
+            # coverage — one fold of the block pulled above, no extra
+            # device traffic.
+            from ..obs.coverage import coverage_of_counters
+
+            profile["coverage"] = coverage_of_counters(mb)
     return [o for o in outcomes], traces

@@ -696,7 +696,8 @@ class DeviceEngine:
 
     def _superstep_impl(self, state: WorldState, stop_threshold,
                         stop_on_bug, k_chunks, *, chunk_steps: int,
-                        k_max: int, reduce_sum, min_one: bool = False):
+                        k_max: int, reduce_sum, min_one: bool = False,
+                        cov=None, cov_fold=None):
         """Up to ``k_chunks`` chunk bodies under ONE ``lax.while_loop``.
 
         This is the device half of the pipelined sweep orchestration
@@ -733,7 +734,24 @@ class DeviceEngine:
         n_active, k_done, hist)`` where ``hist[j]`` is the active count
         measured after chunk ``j`` (-1 for chunks not run), exactly the
         per-chunk sequence the serial loop observed.
+
+        ``cov``/``cov_fold`` (obs/coverage.py, set together or not at
+        all): the retire-time coverage fold. ``cov`` is the behavior
+        ledger carried through the loop; after each chunk body the fold
+        callback receives ``(cov, pre_chunk_active, post_chunk_state)``
+        and scatters the signatures of the worlds whose active flag fell
+        during the chunk — each world folds exactly once, with no extra
+        carried bookkeeping, and the fold *sequence* matches the serial
+        loop's because both execute identical chunk bodies. Purely
+        read-only over the simulation state (the bitwise-invisibility
+        contract of ``MetricsBlock`` extends to it). With coverage on
+        the return grows to ``(..., hist, cov, cov_hist)`` where
+        ``cov_hist[j]`` is the cumulative distinct-behavior count after
+        chunk ``j`` (-1 beyond ``k_done``) — the novelty curve sampled
+        at exactly the ``hist`` cadence.
         """
+        from ..obs.coverage import distinct_count
+
         def measure(s):
             any_bug = reduce_sum(jnp.any(s.bug).astype(jnp.int32)) > 0
             n_active = reduce_sum(jnp.sum(s.active.astype(jnp.int32)))
@@ -744,9 +762,14 @@ class DeviceEngine:
         k_chunks = jnp.minimum(jnp.asarray(k_chunks, jnp.int32), k_max)
         any_bug0, n_active0 = measure(state)
         hist0 = jnp.full((k_max,), -1, jnp.int32)
+        with_cov = cov_fold is not None
+        # The coverage slots ride the carry ONLY when the fold is on, so
+        # the coverage-off superstep remains the exact pre-coverage
+        # program (None is an empty pytree: zero extra carry leaves).
+        cov_hist0 = jnp.full((k_max,), -1, jnp.int32) if with_cov else None
 
         def cond(carry):
-            _s, i, any_bug, n_active, _hist = carry
+            _s, i, any_bug, n_active, _hist, _cov, _ch = carry
             run_more = ((n_active > stop_threshold)
                         & ~(stop_on_bug & any_bug))
             if min_one:
@@ -754,15 +777,24 @@ class DeviceEngine:
             return (i < k_chunks) & run_more
 
         def body(carry):
-            s, i, _any_bug, _n_active, hist = carry
+            s, i, _any_bug, _n_active, hist, cv, ch = carry
+            act0 = s.active
             s = self._run_steps_impl(s, chunk_steps)
             any_bug, n_active = measure(s)
             hist = jax.lax.dynamic_update_index_in_dim(hist, n_active, i, 0)
-            return s, i + 1, any_bug, n_active, hist
+            if with_cov:
+                cv = cov_fold(cv, act0, s)
+                ch = jax.lax.dynamic_update_index_in_dim(
+                    ch, distinct_count(cv[0]), i, 0)
+            return s, i + 1, any_bug, n_active, hist, cv, ch
 
-        state, k_done, any_bug, n_active, hist = jax.lax.while_loop(
-            cond, body,
-            (state, jnp.int32(0), any_bug0, n_active0, hist0))
+        state, k_done, any_bug, n_active, hist, cov, cov_hist = \
+            jax.lax.while_loop(
+                cond, body,
+                (state, jnp.int32(0), any_bug0, n_active0, hist0,
+                 cov, cov_hist0))
+        if with_cov:
+            return state, any_bug, n_active, k_done, hist, cov, cov_hist
         return state, any_bug, n_active, k_done, hist
 
     def _run_impl(self, state: WorldState, max_steps: int) -> WorldState:

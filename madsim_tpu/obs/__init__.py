@@ -24,10 +24,25 @@ FoundationDB lineage):
    artifact (seed, config + hash, fault schedule, backend/batch knobs)
    that ``python -m madsim_tpu.obs replay`` re-runs verbatim.
 
-CLI: ``python -m madsim_tpu.obs replay --seed N --actor raft ...`` or
-``replay --bundle repro.json``. See docs/observability.md.
+Since the sweep observatory landed, the triad has a live fourth leg
+(docs/observability.md "The sweep observatory"): a behavior-coverage
+ledger folded on device at retire time (:mod:`.coverage` —
+``SweepResult.coverage`` with the per-chunk ``novelty_curve``), a
+telemetry stream piggybacking the loop's existing scalar fetch
+(:mod:`.observatory` — ``sweep(observe=...)``, Prometheus snapshots,
+``jax.profiler`` capture windows), and the matching ``watch`` CLI.
+
+CLI: ``python -m madsim_tpu.obs replay --seed N --actor raft ...``,
+``replay --bundle repro.json``, or ``watch telemetry.jsonl [--follow]``.
+See docs/observability.md.
 """
 from .bundle import load_bundle, write_sweep_bundle, write_test_bundle
+from .coverage import (
+    DEFAULT_BUCKETS,
+    SweepCoverage,
+    behavior_signature,
+    coverage_of_counters,
+)
 from .metrics import (
     BLOCK_FIELDS,
     NUM_FAULT_KINDS,
@@ -35,11 +50,22 @@ from .metrics import (
     aggregate_metrics,
     metrics_from_observations,
 )
+from .observatory import (
+    JsonlEmitter,
+    ProfilerWindow,
+    make_observer,
+    prometheus_text,
+    write_prometheus,
+)
 from .timeline import polls_to_chrome, render_text, trace_to_chrome
 
 __all__ = [
     "MetricsBlock", "NUM_FAULT_KINDS", "BLOCK_FIELDS",
     "aggregate_metrics", "metrics_from_observations",
+    "SweepCoverage", "DEFAULT_BUCKETS", "behavior_signature",
+    "coverage_of_counters",
+    "JsonlEmitter", "ProfilerWindow", "make_observer",
+    "prometheus_text", "write_prometheus",
     "trace_to_chrome", "polls_to_chrome", "render_text",
     "write_sweep_bundle", "write_test_bundle", "load_bundle",
 ]

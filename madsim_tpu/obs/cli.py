@@ -1,8 +1,17 @@
-"""obs CLI: ``python -m madsim_tpu.obs replay ...``.
+"""obs CLI: ``python -m madsim_tpu.obs replay|watch ...``.
 
-Replays a failing seed and exports its timeline — the device analog of
-re-running a reference test with ``MADSIM_TEST_SEED`` pinned and
-``MADSIM_LOG`` on, except the whole recipe can ride in a repro bundle:
+``replay`` re-runs a failing seed and exports its timeline — the device
+analog of re-running a reference test with ``MADSIM_TEST_SEED`` pinned
+and ``MADSIM_LOG`` on, except the whole recipe can ride in a repro
+bundle. ``watch`` tails or summarizes a live sweep telemetry stream
+(``sweep(observe="tele.jsonl")``, obs/observatory.py), optionally
+refreshing a Prometheus text snapshot:
+
+    python -m madsim_tpu.obs watch /tmp/tele.jsonl            # summary
+    python -m madsim_tpu.obs watch /tmp/tele.jsonl --follow \\
+        --prom /var/lib/node_exporter/madsim.prom
+
+Replay usage:
 
     # a seed from SweepResult.failing_seeds, explicit config
     python -m madsim_tpu.obs replay --seed 17234 --actor raft \\
@@ -169,8 +178,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp.add_argument("--out", default=None, help="output file (default: "
                                                 "stdout)")
     rp.add_argument("--format", choices=("chrome", "text"), default="chrome")
+    wp = sub.add_parser("watch", help="tail/summarize a sweep telemetry "
+                                      "JSONL stream (sweep(observe=...))")
+    wp.add_argument("file", help="telemetry JSONL written by "
+                                 "sweep(observe=<path>)")
+    wp.add_argument("--follow", action="store_true",
+                    help="tail the stream until its summary record lands")
+    wp.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval in seconds (--follow)")
+    wp.add_argument("--prom", default=None,
+                    help="also write a Prometheus text snapshot of the "
+                         "latest record to this path (atomic rewrite)")
     args = ap.parse_args(argv)
 
+    if args.cmd == "watch":
+        from .observatory import watch
+
+        return watch(args.file, follow=args.follow, prom=args.prom,
+                     interval=args.interval)
     if args.bundle:
         bundle = load_bundle(args.bundle)
         if bundle["kind"] == "host_test":

@@ -1,0 +1,318 @@
+"""The sweep observatory: live telemetry, Prometheus snapshots, profiler
+hooks, and the ``watch`` CLI.
+
+The sweep loop (parallel/sweep.py) learns a handful of scalars per
+superstep anyway — occupancy, bug flag, chunk count, the coverage
+ledger's distinct count. This module turns that already-fetched stream
+into operator-facing telemetry **without adding a single device→host
+sync** (the counted-``_fetch`` tier-1 test covers an ``observe=``-on
+sweep): a callback or JSONL emitter per host read, a Prometheus
+text-format snapshot writer, and ``python -m madsim_tpu.obs watch`` to
+tail or summarize the stream.
+
+Everything here is *host-side* observation of the orchestration loop —
+wall-clock reads and ``jax.profiler`` captures are exactly the calls
+detlint forbids in simulation code (DET001 / DET007), so this module is
+their one sanctioned home and carries the inline pragmas. Nothing in it
+feeds a simulation decision: telemetry-on sweeps are bitwise identical
+to telemetry-off (tier-1, tests/test_observatory.py).
+
+Record schema (``madsim.sweep.telemetry/1``): progress records carry
+``elapsed_s`` (monotonic seconds since loop start — never a wall-clock
+date), ``chunks``, ``steps``, ``batch_worlds``, ``n_active``,
+``occupancy``, ``seeds_total`` / ``seeds_admitted`` / ``seeds_done``,
+``seeds_per_s``, ``world_utilization`` (running lower bound),
+``dispatch_depth``, ``bug_seen``, ``eta_s`` (None while the rate is
+still 0), and — when the engine runs metrics — ``coverage_distinct`` /
+``coverage_buckets``. The final record has ``event: "summary"`` with
+``loop_stats`` and the coverage ledger rollup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional, Tuple
+
+# Every duration in the telemetry schema is MONOTONIC seconds (the
+# sweep's ``_clk`` = time.perf_counter, docs/perf.md "Telemetry units"),
+# never a wall-clock date: two runs of one seed must render identical
+# *virtual* timelines, and host clocks must never leak into them.
+_SCHEMA = "madsim.sweep.telemetry/1"
+
+
+class JsonlEmitter:
+    """Append one JSON line per telemetry record; flush per line so a
+    killed sweep leaves a readable stream (and ``watch --follow`` sees
+    records as they land)."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        self._f = open(self.path, "w", encoding="utf-8")
+
+    def emit(self, record: dict) -> None:
+        if self._f is None:
+            return
+        json.dump(record, self._f, separators=(",", ":"))
+        self._f.write("\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def make_observer(observe: Any
+                  ) -> Tuple[Optional[Callable[[dict], None]],
+                             Optional[Callable[[], None]]]:
+    """Normalize ``sweep(observe=...)`` into ``(emit, close)``.
+
+    ``None`` → no-op; a callable is used as-is (no close); a path string
+    becomes a :class:`JsonlEmitter` stream the ``watch`` CLI consumes.
+    """
+    if observe is None:
+        return None, None
+    if callable(observe):
+        return observe, None
+    if isinstance(observe, (str, os.PathLike)):
+        em = JsonlEmitter(observe)
+        return em.emit, em.close
+    raise TypeError(
+        f"observe must be a callable or a JSONL file path, got "
+        f"{type(observe).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format snapshots
+# ---------------------------------------------------------------------------
+
+def prometheus_text(record: dict, prefix: str = "madsim_sweep") -> str:
+    """Render one telemetry record's numeric fields as Prometheus text
+    exposition gauges (booleans as 0/1; nested/None/str fields skipped).
+    """
+    lines: List[str] = []
+    for k in sorted(record):
+        v = record[k]
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            continue
+        name = f"{prefix}_{k}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(record: dict, path: str,
+                     prefix: str = "madsim_sweep") -> None:
+    """Atomically (tmp+rename) write a Prometheus snapshot of one record
+    — the node-exporter-textfile-collector handoff shape, so a scraper
+    never reads a half-written file."""
+    text = prometheus_text(record, prefix=prefix)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture window
+# ---------------------------------------------------------------------------
+
+class ProfilerWindow:
+    """Wrap a window of sweep dispatches in ``jax.profiler`` capture.
+
+    ``window=(start, stop)`` counts loop dispatches: the capture starts
+    right before dispatch ``start`` and stops at the first blocking
+    scalar read at/after dispatch ``stop`` (so the device execution of
+    every in-window dispatch has completed inside the capture), or at
+    loop end. The device timeline lands under ``trace_dir`` — beside the
+    *virtual-time* timelines of obs/timeline.py, this is the sanctioned
+    wall-clock view of the same sweep. With ``trace_dir=None`` every
+    method is a no-op. Capture failures (profiler backends vary) are
+    recorded on ``self.error`` and never propagate into the sweep.
+    """
+
+    def __init__(self, trace_dir: Optional[str],
+                 window: Tuple[int, int] = (0, 4)):
+        self.trace_dir = os.fspath(trace_dir) if trace_dir else None
+        start, stop = int(window[0]), int(window[1])
+        if self.trace_dir is not None and not 0 <= start < stop:
+            raise ValueError(
+                f"profile_window must be (start, stop) dispatch indices "
+                f"with 0 <= start < stop; got {window!r}")
+        self.start, self.stop = start, stop
+        self.error: Optional[str] = None
+        self._dispatches = 0
+        self._reads = 0
+        self._active = False
+        self._done = self.trace_dir is None
+
+    def before_dispatch(self) -> None:
+        if not self._done and not self._active \
+                and self._dispatches >= self.start:
+            try:
+                import jax
+
+                os.makedirs(self.trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self.trace_dir)  # detlint: allow[DET007]
+                self._active = True
+            except Exception as exc:  # pragma: no cover — backend-specific
+                self.error = f"{type(exc).__name__}: {exc}"
+                self._done = True
+        self._dispatches += 1
+
+    def annotate(self, label: str):
+        """Context manager naming the enclosed dispatch on the captured
+        timeline; a null context while no capture is active."""
+        if self._active:
+            try:
+                import jax
+
+                return jax.profiler.TraceAnnotation(label)  # detlint: allow[DET007]
+            except Exception:  # pragma: no cover — backend-specific
+                pass
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def after_read(self) -> None:
+        """One blocking scalar read happened: device work up to the read
+        superstep is complete. Stop once the window is covered."""
+        self._reads += 1
+        if self._active and self._reads >= self.stop:
+            self.close()
+
+    def close(self) -> None:
+        """Idempotent; also the error-path stop (sweep's finally)."""
+        if self._active:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()  # detlint: allow[DET007]
+            except Exception as exc:  # pragma: no cover — backend-specific
+                self.error = f"{type(exc).__name__}: {exc}"
+            self._active = False
+        self._done = True
+
+
+# ---------------------------------------------------------------------------
+# `python -m madsim_tpu.obs watch` — tail/summarize a telemetry stream
+# ---------------------------------------------------------------------------
+
+def _load_records(path: str) -> List[dict]:
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # half-written tail of a live stream
+    return out
+
+
+def render_progress(rec: dict) -> str:
+    """One terminal line per progress record."""
+    occ = rec.get("occupancy")
+    cov = rec.get("coverage_distinct")
+    eta = rec.get("eta_s")
+    bits = [
+        f"t={rec.get('elapsed_s', 0):8.2f}s",
+        f"chunks={rec.get('chunks', 0):<5}",
+        f"active={rec.get('n_active', 0)}/{rec.get('batch_worlds', 0)}"
+        + (f" ({occ:.0%})" if isinstance(occ, (int, float)) else ""),
+        f"seeds {rec.get('seeds_done', 0)}/{rec.get('seeds_total', 0)}"
+        f" @ {rec.get('seeds_per_s', 0)}/s",
+    ]
+    if cov is not None:
+        bits.append(f"behaviors={cov}")
+    bits.append("eta=" + (f"{eta:.1f}s" if isinstance(eta, (int, float))
+                          else "?"))
+    if rec.get("bug_seen"):
+        bits.append("BUG")
+    return "  ".join(bits)
+
+
+def render_summary(records: List[dict]) -> str:
+    """Human summary of a whole stream (the non-follow ``watch`` mode)."""
+    if not records:
+        return "watch: empty telemetry stream"
+    progress = [r for r in records if r.get("event") != "summary"]
+    summary = next((r for r in records if r.get("event") == "summary"),
+                   None)
+    lines: List[str] = []
+    if progress:
+        lines.append(f"{len(progress)} progress records; last:")
+        lines.append("  " + render_progress(progress[-1]))
+        covs = [r["coverage_distinct"] for r in progress
+                if "coverage_distinct" in r]
+        if covs:
+            lines.append(
+                f"novelty curve: {covs[0]} -> {covs[-1]} distinct "
+                f"behaviors over {len(covs)} reads"
+                + (" (still growing at exit — the hunt had not "
+                   "saturated)" if len(covs) >= 2 and covs[-1] > covs[-2]
+                   else ""))
+    if summary is not None:
+        ls = summary.get("loop_stats") or {}
+        lines.append(
+            f"final: {summary.get('failing_seeds', '?')} failing of "
+            f"{summary.get('seeds_total', '?')} seeds in "
+            f"{summary.get('elapsed_s', '?')}s "
+            f"(utilization {summary.get('world_utilization', '?')}, "
+            f"{ls.get('chunks', '?')} chunks / "
+            f"{ls.get('dispatches', '?')} dispatches)")
+        cov = summary.get("coverage")
+        if cov:
+            lines.append(
+                f"coverage: {cov.get('distinct_behaviors')} distinct "
+                f"behaviors in {cov.get('n_buckets')} buckets "
+                f"({cov.get('worlds_folded')} worlds folded, novelty "
+                f"{cov.get('novelty_first')}->{cov.get('novelty_last')})")
+    else:
+        lines.append("no summary record yet (sweep still running?)")
+    return "\n".join(lines)
+
+
+def watch(path: str, follow: bool = False, prom: Optional[str] = None,
+          interval: float = 1.0, out=None) -> int:
+    """The ``watch`` subcommand body. Summarizes the stream (default) or
+    tails it (``follow=True``) until the summary record arrives; with
+    ``prom`` set, each new record refreshes a Prometheus snapshot file.
+    """
+    out = out or sys.stdout
+    if not os.path.exists(path):
+        print(f"watch: no such file: {path}", file=sys.stderr)
+        return 2
+    if not follow:
+        records = _load_records(path)
+        print(render_summary(records), file=out)
+        if prom and records:
+            write_prometheus(records[-1], prom)
+        return 0
+    # Follow mode: host-side tail of a host-side stream — the one place
+    # a real sleep belongs (this process never runs simulation code).
+    import time as _walltime
+
+    seen = 0
+    done = False
+    while not done:
+        records = _load_records(path)
+        for rec in records[seen:]:
+            if rec.get("event") == "summary":
+                print(render_summary(records), file=out)
+                done = True
+            else:
+                print(render_progress(rec), file=out)
+            if prom:
+                write_prometheus(rec, prom)
+        seen = len(records)
+        if not done:
+            _walltime.sleep(interval)  # detlint: allow[DET001]
+    return 0

@@ -31,12 +31,12 @@ kept as the equivalence reference and tier-1-tested against).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, NamedTuple, Optional
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
     from jax import shard_map
@@ -59,8 +59,17 @@ from .mesh import (
 _fetch = jax.device_get
 
 
+def _cov_reducers(mesh: Mesh):
+    """Mesh reductions for the coverage ledger: psum for bucket counts,
+    pmin for first-seen seed ids (obs/coverage.py fold_retired)."""
+    axes = tuple(mesh.axis_names)
+    return (lambda x: jax.lax.psum(x, axes),
+            lambda x: jax.lax.pmin(x, axes))
+
+
 def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
-                   donate: bool = False):
+                   donate: bool = False,
+                   coverage: Optional[int] = None):
     """Compile a chunk runner: state → (state, any_bug, n_active).
 
     The body is `shard_map`'d so each device advances only its world shard
@@ -75,32 +84,65 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
     is attached: the async checkpointer reads the pre-chunk state from a
     background thread, which donation would invalidate.
 
-    Runners are cached per (mesh, chunk_steps, donate) on the engine, so
-    repeated sweeps reuse the compiled program instead of paying a fresh
-    XLA compile for an identical closure.
+    ``coverage`` (bucket count, or None): the retire-time behavior fold
+    (obs/coverage.py). The runner signature widens to
+    ``(state, hits, first_seen, idx, n_real) → (state, any_bug,
+    n_active, hits, first_seen, distinct)``: after the chunk body, the
+    worlds whose active flag fell during the chunk scatter their
+    behavior signatures into the replicated K-bucket ledger (psum/pmin
+    over the mesh — the only additions; the chunk body itself is
+    untouched, so trajectories stay bitwise identical and with
+    ``coverage=None`` this compiles the exact pre-coverage program).
+
+    Runners are cached per (mesh, chunk_steps, donate, coverage) on the
+    engine, so repeated sweeps reuse the compiled program instead of
+    paying a fresh XLA compile for an identical closure.
     """
     cache = eng.__dict__.setdefault("_sharded_runner_cache", {})
-    key = (mesh, chunk_steps, donate)
+    key = (mesh, chunk_steps, donate, coverage)
     if key in cache:
         return cache[key]
     spec = world_spec(mesh)
     axes = tuple(mesh.axis_names)
     sp = scalar_spec()
 
-    def chunk(state: WorldState):
-        state = eng._run_steps_impl(state, chunk_steps)
-        any_bug = jax.lax.psum(
-            jnp.any(state.bug).astype(jnp.int32), axes) > 0
-        n_active = jax.lax.psum(
-            jnp.sum(state.active.astype(jnp.int32)), axes)
-        return state, any_bug, n_active
+    if coverage is None:
+        def chunk(state: WorldState):
+            state = eng._run_steps_impl(state, chunk_steps)
+            any_bug = jax.lax.psum(
+                jnp.any(state.bug).astype(jnp.int32), axes) > 0
+            n_active = jax.lax.psum(
+                jnp.sum(state.active.astype(jnp.int32)), axes)
+            return state, any_bug, n_active
+
+        in_specs, out_specs = (spec,), (spec, sp, sp)
+    else:
+        from ..obs.coverage import distinct_count, fold_retired
+
+        rsum, rmin = _cov_reducers(mesh)
+
+        def chunk(state: WorldState, hits, first, idx, n_real):
+            act0 = state.active
+            state = eng._run_steps_impl(state, chunk_steps)
+            any_bug = jax.lax.psum(
+                jnp.any(state.bug).astype(jnp.int32), axes) > 0
+            n_active = jax.lax.psum(
+                jnp.sum(state.active.astype(jnp.int32)), axes)
+            mask = act0 & ~state.active & (idx >= 0) & (idx < n_real)
+            hits, first = fold_retired(hits, first, state.metrics, mask,
+                                       idx, rsum, rmin)
+            return state, any_bug, n_active, hits, first, \
+                distinct_count(hits)
+
+        in_specs = (spec, sp, sp, spec, sp)
+        out_specs = (spec, sp, sp, sp, sp, sp)
 
     try:  # jax >= 0.8 renamed check_rep -> check_vma
-        mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
-                           out_specs=(spec, sp, sp), check_vma=False)
+        mapped = shard_map(chunk, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
     except TypeError:  # pragma: no cover — older jax
-        mapped = shard_map(chunk, mesh=mesh, in_specs=(spec,),
-                           out_specs=(spec, sp, sp), check_rep=False)
+        mapped = shard_map(chunk, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
     runner = jax.jit(mapped, donate_argnums=(0,) if donate else ())
     cache[key] = runner
     return runner
@@ -108,7 +150,8 @@ def sharded_engine(eng: DeviceEngine, mesh: Mesh, chunk_steps: int = 512,
 
 def sharded_superstep(eng: DeviceEngine, mesh: Mesh, chunk_steps: int,
                       k_max: int, donate: bool = False,
-                      min_one: bool = False):
+                      min_one: bool = False,
+                      coverage: Optional[int] = None):
     """Compile a superstep runner:
     ``(state, stop_threshold, stop_on_bug, k_chunks) → (state, any_bug,
     n_active, k_done, hist)``.
@@ -131,32 +174,110 @@ def sharded_superstep(eng: DeviceEngine, mesh: Mesh, chunk_steps: int,
     right after a refill/shrink — see ``_superstep_impl``). Donation
     follows :func:`sharded_engine` (on exactly when no checkpoint writer
     holds state references between dispatches).
+
+    ``coverage`` (bucket count, or None) threads the retire-time
+    behavior ledger (obs/coverage.py) through the on-device chunk loop:
+    the runner widens to ``(state, hits, first_seen, idx, n_real,
+    stop_threshold, stop_on_bug, k_chunks) → (state, any_bug, n_active,
+    k_done, hist, hits, first_seen, cov_hist)``, where ``cov_hist[j]``
+    is the cumulative distinct-behavior count after chunk ``j`` — the
+    novelty curve at exactly the ``hist`` cadence, riding the SAME
+    scalar fetch (zero extra device→host syncs). A pass-through
+    superstep (entry condition already false) folds nothing, which is
+    what keeps the ledger — like everything else — bitwise identical
+    between the dispatch-ahead and serial loops.
     """
     cache = eng.__dict__.setdefault("_sharded_superstep_cache", {})
-    key = (mesh, chunk_steps, k_max, donate, min_one)
+    key = (mesh, chunk_steps, k_max, donate, min_one, coverage)
     if key in cache:
         return cache[key]
     spec = world_spec(mesh)
     axes = tuple(mesh.axis_names)
     sp = scalar_spec()
+    rsum = lambda x: jax.lax.psum(x, axes)  # noqa: E731
 
-    def sstep(state: WorldState, stop_threshold, stop_on_bug, k_chunks):
-        return eng._superstep_impl(
-            state, stop_threshold, stop_on_bug, k_chunks,
-            chunk_steps=chunk_steps, k_max=k_max,
-            reduce_sum=lambda x: jax.lax.psum(x, axes), min_one=min_one)
+    if coverage is None:
+        def sstep(state: WorldState, stop_threshold, stop_on_bug, k_chunks):
+            return eng._superstep_impl(
+                state, stop_threshold, stop_on_bug, k_chunks,
+                chunk_steps=chunk_steps, k_max=k_max,
+                reduce_sum=rsum, min_one=min_one)
+
+        in_specs = (spec, sp, sp, sp)
+        out_specs = (spec, sp, sp, sp, sp)
+    else:
+        from ..obs.coverage import fold_retired
+
+        _, rmin = _cov_reducers(mesh)
+
+        def sstep(state: WorldState, hits, first, idx, n_real,
+                  stop_threshold, stop_on_bug, k_chunks):
+            def fold(cov, act0, s):
+                h, f = cov
+                mask = act0 & ~s.active & (idx >= 0) & (idx < n_real)
+                return fold_retired(h, f, s.metrics, mask, idx, rsum, rmin)
+
+            state, any_bug, n_active, k_done, hist, (hits, first), ch = \
+                eng._superstep_impl(
+                    state, stop_threshold, stop_on_bug, k_chunks,
+                    chunk_steps=chunk_steps, k_max=k_max,
+                    reduce_sum=rsum, min_one=min_one,
+                    cov=(hits, first), cov_fold=fold)
+            return state, any_bug, n_active, k_done, hist, hits, first, ch
+
+        in_specs = (spec, sp, sp, spec, sp, sp, sp, sp)
+        out_specs = (spec, sp, sp, sp, sp, sp, sp, sp)
 
     try:  # jax >= 0.8 renamed check_rep -> check_vma
-        mapped = shard_map(sstep, mesh=mesh, in_specs=(spec, sp, sp, sp),
-                           out_specs=(spec, sp, sp, sp, sp),
-                           check_vma=False)
+        mapped = shard_map(sstep, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
     except TypeError:  # pragma: no cover — older jax
-        mapped = shard_map(sstep, mesh=mesh, in_specs=(spec, sp, sp, sp),
-                           out_specs=(spec, sp, sp, sp, sp),
-                           check_rep=False)
+        mapped = shard_map(sstep, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
     runner = jax.jit(mapped, donate_argnums=(0,) if donate else ())
     cache[key] = runner
     return runner
+
+
+def _cov_endfolder(eng: DeviceEngine, mesh: Mesh):
+    """Compile (and cache per engine) the boundary coverage fold.
+
+    One shard_mapped program folding the worlds whose ``active`` flag
+    equals ``fold_active`` into the ledger: the sweep runs it with
+    ``fold_active=False`` on resume (worlds that retired before the
+    checkpoint carry frozen histograms but will never transition
+    active→inactive in THIS call) and with ``fold_active=True`` at sweep
+    end (worlds still live at exit — a truncated behavior is a behavior
+    too). Because ``hits``/``first_seen`` are fold-order invariant
+    (counts and minima), a resumed sweep's final ledger is bit-identical
+    to an unbroken run's (tests/test_obs.py). Shapes key jit's own
+    retrace cache, so one entry serves every batch width.
+    """
+    cache = eng.__dict__.setdefault("_cov_endfolder_cache", {})
+    if mesh in cache:
+        return cache[mesh]
+    from ..obs.coverage import fold_retired
+
+    spec = world_spec(mesh)
+    sp = scalar_spec()
+    rsum, rmin = _cov_reducers(mesh)
+
+    def fold_end(state, hits, first, idx, n_real, fold_active):
+        mask = (state.active == fold_active) & (idx >= 0) & (idx < n_real)
+        return fold_retired(hits, first, state.metrics, mask, idx,
+                            rsum, rmin)
+
+    in_specs = (spec, sp, sp, spec, sp, sp)
+    out_specs = (sp, sp)
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        mapped = shard_map(fold_end, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        mapped = shard_map(fold_end, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+    fn = jax.jit(mapped)
+    cache[mesh] = fn
+    return fn
 
 
 class _Flight(NamedTuple):
@@ -171,6 +292,7 @@ class _Flight(NamedTuple):
     w: int                # batch width at dispatch time
     epoch: int            # occupancy epoch at dispatch time
     out_state: Any        # output state ref — kept ONLY for the writer
+    cov_hist: Any = None  # per-chunk novelty-curve lane (coverage on)
 
 
 class _AsyncCheckpointer:
@@ -305,6 +427,12 @@ class SweepResult:
     # the replay used the same schedule — a seed alone does not pin the
     # trajectory when schedules vary per run.
     faults_sha256: Optional[str] = None
+    # Behavior-coverage ledger (obs/coverage.py SweepCoverage), present
+    # when the engine ran ``EngineConfig(metrics=True)``: per-bucket hit
+    # counts, lowest-seed-per-bucket attribution, and the per-chunk
+    # ``novelty_curve`` (cumulative distinct behaviors, aligned
+    # entrywise with ``n_active_history``/``n_active_chunks``).
+    coverage: Optional[Any] = None
 
     @property
     def failing_seeds(self) -> List[int]:
@@ -325,11 +453,45 @@ class SweepResult:
             return None
         return {"per_seed": per_seed, "aggregate": aggregate_metrics(per_seed)}
 
+    def summary(self) -> str:
+        """One human paragraph of what the sweep did — seeds, bugs,
+        utilization, coverage, top drop causes — so operators read prose
+        instead of grepping a dataclass repr (examples/device_sweep.py
+        and the repro banner both print it)."""
+        n = len(self.seeds)
+        n_bug = len(self.failing_seeds)
+        parts = [f"swept {n} seed{'s' if n != 1 else ''} on "
+                 f"{self.n_devices} device(s) in {self.steps_run} issued "
+                 f"steps: {n_bug} failing"]
+        if self.n_active_history.size:
+            parts.append(f"world utilization "
+                         f"{self.world_utilization:.0%} over "
+                         f"{self.n_active_history.size} chunks")
+        if self.coverage is not None:
+            cov = self.coverage
+            curve = cov.novelty_curve
+            tail = (f" (novelty {int(curve[0])}→{int(curve[-1])} "
+                    f"across the run)" if curve.size else "")
+            parts.append(f"{cov.distinct_behaviors} distinct behaviors "
+                         f"in {cov.n_buckets} buckets{tail}")
+        m = self.metrics
+        if m is not None:
+            agg = m["aggregate"]
+            drops = sorted(((k, v) for k, v in agg.items()
+                            if k.startswith("drop_") and isinstance(v, int)
+                            and v > 0), key=lambda kv: -kv[1])
+            if drops:
+                parts.append("top drop causes: " + ", ".join(
+                    f"{k[5:]}={v}" for k, v in drops[:3]))
+        return "; ".join(parts) + "."
+
     def repro_banner(self) -> Optional[str]:
-        """The failing-seed reproduction hint (`runtime/mod.rs:192-199`)."""
+        """The failing-seed reproduction hint (`runtime/mod.rs:192-199`),
+        prefixed with the human :meth:`summary` paragraph."""
         if not self.failing_seeds:
             return None
-        banner = ("note: run with environment variable "
+        banner = self.summary() + "\n"
+        banner += ("note: run with environment variable "
                   f"MADSIM_TEST_SEED={self.failing_seeds[0]} to reproduce "
                   f"this failure ({len(self.failing_seeds)} failing seeds "
                   "total)")
@@ -351,7 +513,11 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           recycle: bool = False,
           batch_worlds: Optional[int] = None,
           pipeline: bool = True,
-          superstep_max: int = 16) -> SweepResult:
+          superstep_max: int = 16,
+          observe: Any = None,
+          profile_dir: Optional[str] = None,
+          profile_window: Tuple[int, int] = (0, 4),
+          coverage_buckets: Optional[int] = None) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     The loop is a slot-occupancy model: the device batch is a fixed set of
@@ -439,6 +605,33 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     (live-world steps / issued slot-steps, mesh padding included), and
     ``loop_stats`` (the dispatch-count / host-stall breakdown of the
     orchestration loop).
+
+    Observatory knobs (docs/observability.md "The sweep observatory"):
+
+    ``observe``: a live telemetry sink — a callable receiving one dict
+    per host read of the loop's scalars (per chunk on the serial path,
+    per superstep when pipelined), or a file path for a JSONL stream
+    (``python -m madsim_tpu.obs watch <file>`` tails/summarizes it).
+    Records are built ONLY from values the loop already fetched plus
+    host counters — zero extra device syncs (counted-``_fetch`` tested)
+    — and cover seeds/s, occupancy, utilization, coverage growth,
+    dispatch depth, and ETA.
+
+    ``profile_dir`` + ``profile_window``: wrap a window of the loop's
+    dispatches (by dispatch index, ``[start, stop)``) in
+    ``jax.profiler`` trace capture, so a device timeline lands in
+    ``profile_dir`` next to the virtual-time timelines of
+    obs/timeline.py. Purely host-side observation: trajectories and the
+    dispatch schedule are unchanged.
+
+    ``coverage_buckets``: bucket count of the behavior-coverage ledger
+    (obs/coverage.py; default ``DEFAULT_BUCKETS`` when the engine runs
+    ``EngineConfig(metrics=True)``). The ledger folds each retiring
+    world's metrics histograms into a device-resident K-bucket sketch —
+    psum'd across the mesh inside the chunk/superstep programs, zero
+    host pulls mid-loop — and lands on ``SweepResult.coverage`` with the
+    per-chunk ``novelty_curve``. Requires metrics; passing an explicit
+    value with a metrics-off engine raises ``ValueError``.
     """
     from ..engine import checkpoint as ckpt
 
@@ -455,6 +648,23 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             "resumed sweep could not re-attribute recycled slots")
     if superstep_max < 1:
         raise ValueError("superstep_max must be >= 1")
+
+    # Behavior-coverage ledger (obs/coverage.py): on exactly when the
+    # engine carries the MetricsBlock — signatures are hashes of it.
+    from ..obs.coverage import (
+        DEFAULT_BUCKETS,
+        coverage_from_device,
+        ledger_zeros,
+    )
+    cov_on = bool(eng.cfg.metrics)
+    if coverage_buckets is not None and not cov_on:
+        raise ValueError(
+            "coverage_buckets requires EngineConfig(metrics=True): the "
+            "behavior ledger hashes the MetricsBlock histograms of "
+            "retiring worlds")
+    cov_k = int(coverage_buckets) if coverage_buckets else DEFAULT_BUCKETS
+    if cov_on and cov_k < 1:
+        raise ValueError("coverage_buckets must be >= 1")
 
     # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
     # once; recycled sweeps hold batch_worlds slots and stream the rest.
@@ -520,6 +730,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         "faults_sha256": hashlib.sha256(faults_key).hexdigest(),
     }
 
+    resumed = False
     if resume and checkpoint_path and os.path.exists(checkpoint_path):
         state = ckpt.load(eng, checkpoint_path, expect_extra=seeds_meta)
         if np.asarray(state.now).shape[0] != seeds_p.shape[0]:
@@ -527,6 +738,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 f"checkpoint holds {np.asarray(state.now).shape[0]} worlds, "
                 f"sweep expects {seeds_p.shape[0]} (seeds + mesh padding)")
         state = shard_worlds(state, mesh)
+        resumed = True
     else:
         state = shard_worlds(
             eng.init(seeds_p[:w0], faults=batch_faults(np.arange(w0))), mesh)
@@ -565,6 +777,69 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             "retire_wait_s": 0.0, "scalar_fetches": 0, "retire_fetches": 0,
             "dispatches": 0, "dispatch_depth": 0}
     t_loop0 = _clk()
+
+    # -- observatory hooks (docs/observability.md) ------------------------
+    # Telemetry emitter + profiler window are host-side observation only:
+    # every record is built from scalars the loop already fetched, so the
+    # sync discipline (one _fetch per superstep) is unchanged.
+    from ..obs import observatory as _obsy
+
+    emit_telemetry, close_telemetry = _obsy.make_observer(observe)
+    prof = _obsy.ProfilerWindow(profile_dir, profile_window)
+    novelty_hist: List[int] = []       # cumulative distinct, per chunk
+    cov_hits = cov_first = n_real_dev = None
+    if cov_on:
+        cov_hits, cov_first = jax.device_put(
+            ledger_zeros(cov_k), NamedSharding(mesh, scalar_spec()))
+        n_real_dev = jnp.int32(n)
+        if resumed:
+            # Resume pre-pass: worlds that retired before the checkpoint
+            # carry frozen histograms but will never transition
+            # active→inactive in THIS call — fold them up front. The
+            # ledger is fold-order invariant (counts + minima), so the
+            # final hits/first_seen equal an unbroken run's bit for bit.
+            cov_hits, cov_first = _cov_endfolder(eng, mesh)(
+                state, cov_hits, cov_first, idx, n_real_dev,
+                jnp.asarray(False))
+
+    def emit_point(n_act: int, bug_seen: bool, depth: int) -> None:
+        """One live-telemetry record per host read of the loop scalars
+        (host data only — never a device pull)."""
+        if emit_telemetry is None:
+            return
+        elapsed = _clk() - t_loop0
+        done = int(min(max(cursor - n_act, 0), n))
+        rate = done / elapsed if elapsed > 0 else 0.0
+        remaining = n - done
+        rec = {
+            "schema": "madsim.sweep.telemetry/1",
+            "elapsed_s": round(elapsed, 6),
+            "chunks": int(chunks),
+            "steps": int(steps),
+            "batch_worlds": int(w_cur),
+            "n_active": int(n_act),
+            "occupancy": round(n_act / w_cur, 4) if w_cur else 0.0,
+            "seeds_total": int(n),
+            "seeds_admitted": int(min(cursor, n)),
+            "seeds_done": done,
+            "seeds_per_s": round(rate, 2),
+            # Running lower bound: retired-tail attribution lands at the
+            # next retirement pull, so mid-loop utilization trails the
+            # final SweepResult.world_utilization slightly.
+            "world_utilization": (round(
+                live_world_steps / issued_slot_steps, 4)
+                if issued_slot_steps else 0.0),
+            "dispatch_depth": int(depth),
+            "bug_seen": bool(bug_seen),
+            "eta_s": (round(remaining / rate, 3) if rate > 0
+                      and remaining > 0 else
+                      (0.0 if remaining == 0 else None)),
+        }
+        if cov_on:
+            rec["coverage_distinct"] = (int(novelty_hist[-1])
+                                        if novelty_hist else 0)
+            rec["coverage_buckets"] = cov_k
+        emit_telemetry(rec)
 
     def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray) -> None:
         """Record final observations for rows leaving the batch (dead
@@ -667,7 +942,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 execute, so the budget must treat them as spent or a
                 binding ``max_steps`` overruns the serial loop's
                 ``c_max`` chunk ceiling."""
-                nonlocal state, inflight, epoch_fresh
+                nonlocal state, inflight, epoch_fresh, cov_hits, cov_first
                 budget = c_max - chunks - reserve
                 k = max(1, min(k_cur, budget, superstep_max))
                 if writer is not None and checkpoint_every_chunks:
@@ -681,19 +956,32 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 # compiled runner, not a compile key.
                 if epoch_fresh:
                     k = 1
-                runner = sharded_superstep(eng, mesh, chunk_steps,
-                                           superstep_max, donate,
-                                           min_one=epoch_fresh)
+                runner = sharded_superstep(
+                    eng, mesh, chunk_steps, superstep_max, donate,
+                    min_one=epoch_fresh,
+                    coverage=cov_k if cov_on else None)
                 epoch_fresh = False
                 t0 = _clk()
-                state, any_bug, n_active, k_done, hist = runner(
-                    state, jnp.int32(threshold()),
-                    jnp.asarray(bool(stop_on_first_bug)), jnp.int32(k))
+                prof.before_dispatch()
+                with prof.annotate("madsim:superstep"):
+                    if cov_on:
+                        (state, any_bug, n_active, k_done, hist, cov_hits,
+                         cov_first, cov_h) = runner(
+                            state, cov_hits, cov_first, idx, n_real_dev,
+                            jnp.int32(threshold()),
+                            jnp.asarray(bool(stop_on_first_bug)),
+                            jnp.int32(k))
+                    else:
+                        cov_h = None
+                        state, any_bug, n_active, k_done, hist = runner(
+                            state, jnp.int32(threshold()),
+                            jnp.asarray(bool(stop_on_first_bug)),
+                            jnp.int32(k))
                 perf["dispatch_s"] += _clk() - t0
                 perf["dispatches"] += 1
                 inflight = _Flight(
                     any_bug, n_active, k_done, hist, k, w_cur, epoch,
-                    state if writer is not None else None)
+                    state if writer is not None else None, cov_h)
 
             # max_steps <= 0 means a zero-chunk budget: the serial loop
             # never enters its body, so the pipelined loop must not
@@ -710,10 +998,20 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 if not stop and chunks + prev.planned < c_max:
                     dispatch(reserve=prev.planned)
                 t0 = _clk()
-                bug_h, n_act_h, k_done_h, hist_h = _fetch(
-                    (prev.any_bug, prev.n_active, prev.k_done, prev.hist))
+                if cov_on:
+                    # The novelty lane rides the SAME scalar batch — one
+                    # _fetch per superstep either way (tier-1-counted).
+                    bug_h, n_act_h, k_done_h, hist_h, cov_h = _fetch(
+                        (prev.any_bug, prev.n_active, prev.k_done,
+                         prev.hist, prev.cov_hist))
+                else:
+                    cov_h = None
+                    bug_h, n_act_h, k_done_h, hist_h = _fetch(
+                        (prev.any_bug, prev.n_active, prev.k_done,
+                         prev.hist))
                 perf["device_wait_s"] += _clk() - t0
                 perf["scalar_fetches"] += 1
+                prof.after_read()
                 perf["dispatch_depth"] = max(
                     perf["dispatch_depth"], 1 if inflight is not None else 0)
                 # Retirement pulls deferred from earlier refills/shrinks:
@@ -724,9 +1022,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 k_done = int(k_done_h)
                 n_act = int(n_act_h)
                 hist_np = np.asarray(hist_h)
+                cov_np = np.asarray(cov_h) if cov_on else None
                 for j in range(k_done):
                     n_active_hist.append(int(hist_np[j]))
                     n_active_chunk.append(chunks + j)
+                    if cov_on:
+                        novelty_hist.append(int(cov_np[j]))
                 chunks += k_done
                 steps = chunks * chunk_steps
                 issued_slot_steps += prev.w * chunk_steps * k_done
@@ -768,6 +1069,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                             epoch += 1
                             epoch_fresh = True
                 perf["host_decision_s"] += _clk() - t0
+                emit_point(n_act, bool(bug_h),
+                           1 if inflight is not None else 0)
                 if stop:
                     break
                 if inflight is None and chunks < c_max:
@@ -776,10 +1079,19 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 fetch_retire(pending_retires.pop(0))
         else:
             # -- serial per-chunk reference loop ---------------------------
-            runner = sharded_engine(eng, mesh, chunk_steps, donate=donate)
+            runner = sharded_engine(eng, mesh, chunk_steps, donate=donate,
+                                    coverage=cov_k if cov_on else None)
             while steps < max_steps:
                 t0 = _clk()
-                state, any_bug, n_active = runner(state)
+                prof.before_dispatch()
+                with prof.annotate("madsim:chunk"):
+                    if cov_on:
+                        (state, any_bug, n_active, cov_hits, cov_first,
+                         distinct) = runner(state, cov_hits, cov_first,
+                                            idx, n_real_dev)
+                    else:
+                        distinct = None
+                        state, any_bug, n_active = runner(state)
                 perf["dispatch_s"] += _clk() - t0
                 perf["dispatches"] += 1
                 steps += chunk_steps
@@ -792,11 +1104,19 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     writer.submit(state)
                     submitted_chunks = chunks
                 t0 = _clk()
-                n_act_h, bug_h = _fetch((n_active, any_bug))
+                if cov_on:
+                    n_act_h, bug_h, dist_h = _fetch(
+                        (n_active, any_bug, distinct))
+                else:
+                    n_act_h, bug_h = _fetch((n_active, any_bug))
                 perf["device_wait_s"] += _clk() - t0
                 perf["scalar_fetches"] += 1
-                t0 = _clk()
+                prof.after_read()
                 n_act = int(n_act_h)
+                if cov_on:
+                    novelty_hist.append(int(dist_h))
+                emit_point(n_act, bool(bug_h), 0)
+                t0 = _clk()
                 n_active_hist.append(n_act)
                 n_active_chunk.append(chunks - 1)
                 more_seeds = cursor < n_ids
@@ -825,11 +1145,26 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             writer.flush_and_close()
             writer = None
     finally:
+        prof.close()  # idempotent; stops a capture left open by an error
         if writer is not None:  # exception path: don't mask it
             writer.flush_and_close(suppress_errors=True)
 
+    if cov_on:
+        # End-of-sweep fold: worlds still live at exit (max_steps /
+        # stop_on_first_bug truncation) contribute their partial-behavior
+        # signatures, so distinct_behaviors accounts every admitted seed
+        # exactly once. Identical between loops: both exit on the same
+        # state (tier-1 bitwise contract).
+        cov_hits, cov_first = _cov_endfolder(eng, mesh)(
+            state, cov_hits, cov_first, idx, n_real_dev, jnp.asarray(True))
+
     obs_live = eng.observe(state)
-    idx_h = np.asarray(_fetch(idx))
+    if cov_on:
+        # The ledger rides the final slot-index pull — still ONE _fetch.
+        idx_h, cov_hits_h, cov_first_h = (
+            np.asarray(x) for x in _fetch((idx, cov_hits, cov_first)))
+    else:
+        idx_h = np.asarray(_fetch(idx))
     live_keep = idx_h >= 0
     live_world_steps += int(np.asarray(obs_live["steps"])[live_keep].sum())
     # Scatter whenever the live batch does not cover the full id space in
@@ -872,14 +1207,35 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         "retire_fetches": int(perf["retire_fetches"]),
         "loop_wall_s": round(_clk() - t_loop0, 6),
     }
-    return SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
-                       steps_run=steps, n_devices=n_dev,
-                       n_active_history=np.asarray(n_active_hist, np.int64),
-                       world_utilization=util,
-                       n_active_chunks=np.asarray(n_active_chunk, np.int64),
-                       loop_stats=loop_stats,
-                       faults_sha256=(seeds_meta["faults_sha256"]
-                                      if faults is not None else None))
+    coverage = (coverage_from_device(cov_k, cov_hits_h, cov_first_h,
+                                     novelty_hist) if cov_on else None)
+    result = SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
+                         steps_run=steps, n_devices=n_dev,
+                         n_active_history=np.asarray(n_active_hist,
+                                                     np.int64),
+                         world_utilization=util,
+                         n_active_chunks=np.asarray(n_active_chunk,
+                                                    np.int64),
+                         loop_stats=loop_stats,
+                         faults_sha256=(seeds_meta["faults_sha256"]
+                                        if faults is not None else None),
+                         coverage=coverage)
+    if emit_telemetry is not None:
+        final = {
+            "schema": "madsim.sweep.telemetry/1",
+            "event": "summary",
+            "elapsed_s": loop_stats["loop_wall_s"],
+            "seeds_total": int(n),
+            "failing_seeds": len(result.failing_seeds),
+            "world_utilization": round(util, 4),
+            "loop_stats": loop_stats,
+        }
+        if coverage is not None:
+            final["coverage"] = coverage.to_json()
+        emit_telemetry(final)
+    if close_telemetry is not None:
+        close_telemetry()
+    return result
 
 
 def _compact_bucket(n_active: int, w_cur: int, n_dev: int) -> int:

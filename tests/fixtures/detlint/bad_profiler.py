@@ -1,0 +1,17 @@
+"""Golden fixture: DET007 — jax.profiler capture (and its wall-clock
+telemetry companions) started from engine/step code. Profiling belongs
+to the observatory layer (obs/observatory.py ProfilerWindow /
+sweep(profile_dir=...)), never inside simulation code where the capture
+observes host time and scheduling."""
+import jax
+from time import perf_counter
+
+
+def step(state):
+    jax.profiler.start_trace("/tmp/steptrace")          # DET007
+    t0 = perf_counter()                                 # DET001
+    out = state + 1
+    with jax.profiler.TraceAnnotation("hot-step"):      # DET007
+        out = out * 2
+    jax.profiler.stop_trace()                           # DET007
+    return out, perf_counter() - t0                     # DET001

@@ -22,6 +22,7 @@ GOLDEN = {
     "bad_hostinfo.py": {"DET004": 2},
     "bad_socket.py": {"DET005": 2},
     "bad_idhash.py": {"DET006": 2},
+    "bad_profiler.py": {"DET007": 3, "DET001": 2},
     "bad_stale_pragma.py": {"DET900": 1},
 }
 

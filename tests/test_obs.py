@@ -149,20 +149,146 @@ def test_metrics_on_sweep_bitwise_identical(engines, family, mode):
 
 def test_metrics_survive_checkpoint_resume(engines, tmp_path):
     """The extra leaf rides the checkpoint format unchanged: a resumed
-    metrics-on sweep equals the unbroken run, counters included."""
+    metrics-on sweep equals the unbroken run — every MetricsBlock
+    counter bit-identical per seed, and the coverage ledger's
+    fold-order-invariant halves (hits, first_seen) too. The interrupted
+    run retires worlds BEFORE the checkpoint; the resumed call folds
+    them through its resume pre-pass (parallel/sweep.py), so ledger
+    identity is the property actually under test."""
     _off, eng_on, faults = engines["raft"]
     seeds = np.arange(24)
     full = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
                  max_steps=3_000, faults=faults)
     path = str(tmp_path / "m.npz")
-    sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
-          max_steps=256, faults=faults, checkpoint_path=path,
-          checkpoint_every_chunks=1)
+    interrupted = sweep(None, eng_on.cfg, seeds, engine=eng_on,
+                        chunk_steps=128, max_steps=256, faults=faults,
+                        checkpoint_path=path, checkpoint_every_chunks=1)
     resumed = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
                     max_steps=3_000, faults=faults, checkpoint_path=path,
                     resume=True)
     for k, v in full.observations.items():
         np.testing.assert_array_equal(v, resumed.observations[k], err_msg=k)
+    # Explicitly: the per-seed MetricsBlock frames, counter for counter.
+    mf, mr = full.metrics["per_seed"], resumed.metrics["per_seed"]
+    assert set(mf) == set(MetricsBlock._fields)
+    for k in mf:
+        np.testing.assert_array_equal(mf[k], mr[k], err_msg=f"m_{k}")
+    # Coverage ledger: hits and first_seen are counts/minima over the
+    # folded set, so the resumed run's ledger equals the unbroken one's
+    # bit for bit (novelty_curve is per-call history by design).
+    cf, cr = full.coverage, resumed.coverage
+    assert cf is not None and cr is not None
+    np.testing.assert_array_equal(cf.hits, cr.hits)
+    np.testing.assert_array_equal(cf.first_seen_seed, cr.first_seen_seed)
+    assert cf.distinct_behaviors == cr.distinct_behaviors
+    # Sanity that the scenario is non-trivial: some worlds really did
+    # retire before the checkpoint cut.
+    assert interrupted.n_active_history.size >= 1
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: the behavior-coverage ledger (obs/coverage.py)
+# ---------------------------------------------------------------------------
+
+def test_coverage_novelty_curve_contract(engines):
+    """SweepResult.coverage acceptance axes: the novelty curve is
+    monotone non-decreasing, rides the n_active_history cadence, and is
+    bit-deterministic across pipeline on/off; every real seed folds into
+    the ledger exactly once (hits sum == n), with first-seen-seed
+    attribution consistent with occupancy."""
+    _off, eng_on, faults = engines["raft"]
+    seeds = np.arange(40)
+    kw = dict(chunk_steps=64, max_steps=3_000, faults=faults)
+    pip = sweep(None, eng_on.cfg, seeds, engine=eng_on, pipeline=True, **kw)
+    ser = sweep(None, eng_on.cfg, seeds, engine=eng_on, pipeline=False, **kw)
+    rec = sweep(None, eng_on.cfg, seeds, engine=eng_on, pipeline=True,
+                recycle=True, batch_worlds=16, **kw)
+    for res in (pip, ser, rec):
+        cov = res.coverage
+        assert cov is not None
+        curve = cov.novelty_curve
+        assert curve.shape == res.n_active_history.shape
+        assert (np.diff(curve) >= 0).all()
+        assert cov.distinct_behaviors >= int(curve[-1])
+        assert int(cov.hits.sum()) == len(seeds)  # each seed folded once
+        # Bucket attribution: empty buckets carry -1, hit buckets a real
+        # seed id (the LOWEST folded in — fold-order invariant).
+        fs = cov.first_seen_seed
+        assert ((fs == -1) == (cov.hits == 0)).all()
+        assert fs[fs >= 0].max(initial=0) < len(seeds)
+        assert (np.asarray(cov.new_behaviors_per_chunk).sum()
+                == int(curve[-1]) if curve.size else True)
+    # Deterministic across orchestration modes: same folded set, same
+    # ledger — pipelined == serial == recycled, curve included for the
+    # two same-cadence loops.
+    np.testing.assert_array_equal(pip.coverage.novelty_curve,
+                                  ser.coverage.novelty_curve)
+    for a, b in ((pip, ser), (pip, rec)):
+        np.testing.assert_array_equal(a.coverage.hits, b.coverage.hits)
+        np.testing.assert_array_equal(a.coverage.first_seen_seed,
+                                      b.coverage.first_seen_seed)
+    # And under an early stop, where the pipelined loop's in-flight
+    # superstep must be a ledger pass-through (zero chunks → zero folds)
+    # and truncated still-live worlds fold at exit in BOTH loops.
+    stop_kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+                   stop_on_first_bug=True)
+    sp = sweep(None, eng_on.cfg, seeds, engine=eng_on, pipeline=True,
+               **stop_kw)
+    ss = sweep(None, eng_on.cfg, seeds, engine=eng_on, pipeline=False,
+               **stop_kw)
+    np.testing.assert_array_equal(sp.coverage.hits, ss.coverage.hits)
+    np.testing.assert_array_equal(sp.coverage.first_seen_seed,
+                                  ss.coverage.first_seen_seed)
+    np.testing.assert_array_equal(sp.coverage.novelty_curve,
+                                  ss.coverage.novelty_curve)
+    assert int(sp.coverage.hits.sum()) == len(seeds)
+
+
+def test_coverage_ledger_matches_on_multihost_mesh(engines):
+    """The ledger's mesh reductions (psum for hits, pmin for first-seen)
+    span ALL axes of a 2-D DCN×ICI mesh, so the fleet-scale topology
+    (ROADMAP item 1) reports the identical ledger."""
+    from madsim_tpu.parallel import multihost_mesh
+
+    _off, eng_on, faults = engines["raft"]
+    seeds = np.arange(32)
+    kw = dict(chunk_steps=64, max_steps=2_048, faults=faults)
+    flat = sweep(None, eng_on.cfg, seeds, engine=eng_on, **kw)
+    grid = sweep(None, eng_on.cfg, seeds, engine=eng_on,
+                 mesh=multihost_mesh(n_hosts=2), **kw)
+    np.testing.assert_array_equal(flat.coverage.hits, grid.coverage.hits)
+    np.testing.assert_array_equal(flat.coverage.first_seen_seed,
+                                  grid.coverage.first_seen_seed)
+    np.testing.assert_array_equal(flat.coverage.novelty_curve,
+                                  grid.coverage.novelty_curve)
+
+
+def test_coverage_distinguishes_faulted_sweep(engines):
+    """The novelty signal means something: the same seed set under a
+    kill/restart schedule exhibits STRICTLY more distinct behaviors than
+    the fault-free run (fault histogram + drop causes hash to fresh
+    buckets)."""
+    _off, eng_on, faults = engines["raft"]
+    seeds = np.arange(40)
+    faulted = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=64,
+                    max_steps=3_000, faults=faults)
+    clean = sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=64,
+                  max_steps=3_000)
+    assert clean.coverage.distinct_behaviors >= 1
+    assert (faulted.coverage.distinct_behaviors
+            > clean.coverage.distinct_behaviors)
+
+
+def test_coverage_requires_metrics(engines):
+    eng_off, _on, _f = engines["raft"]
+    with pytest.raises(ValueError, match="metrics=True"):
+        sweep(None, eng_off.cfg, np.arange(8), engine=eng_off,
+              chunk_steps=64, max_steps=256, coverage_buckets=64)
+    # Metrics-off sweeps simply report no coverage (and compile the
+    # unchanged pre-coverage programs — the op-budget gate's other half).
+    res = sweep(None, eng_off.cfg, np.arange(8), engine=eng_off,
+                chunk_steps=64, max_steps=256)
+    assert res.coverage is None
 
 
 # ---------------------------------------------------------------------------
@@ -372,3 +498,11 @@ def test_bridge_metrics_block_is_trajectory_invisible():
     assert sm["events_fired"] >= 4 * len(seeds)
     assert sm["vtime_ns"] > 0
     assert sm["msgs_sent"] == 0 and sm["msgs_lost"] == 0
+    # The per-slot coverage sketch rides the same one-time metrics pull
+    # (obs/coverage.py coverage_of_counters over BridgeMetrics).
+    cov = profile["coverage"]
+    assert cov["worlds_folded"] == len(seeds)
+    assert 1 <= cov["distinct_behaviors"] <= len(seeds)
+    import json as _json
+
+    _json.dumps(cov)  # plain JSON: the bench sim_metrics sibling record

@@ -1,0 +1,293 @@
+"""Sweep observatory (docs/observability.md "The sweep observatory"):
+live telemetry stream, Prometheus snapshots, profiler capture windows,
+the `watch` CLI, and the bench_diff regression tool.
+
+The load-bearing contracts: telemetry/profiling are host-side
+observation only (observe-on and profile-on sweeps are bitwise
+identical to plain ones), and the telemetry stream adds ZERO device→host
+syncs — every record is built from the scalar batch the loop fetched
+anyway (counted via the sweep module's ``_fetch`` hook, exactly like
+tests/test_sweep_pipeline.py's sync-discipline test).
+"""
+import dataclasses
+import importlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+sweep_mod = importlib.import_module("madsim_tpu.parallel.sweep")
+from madsim_tpu.engine import (
+    DeviceEngine,
+    EngineConfig,
+    FAULT_KILL,
+    FAULT_RESTART,
+    RaftActor,
+    RaftDeviceConfig,
+)
+from madsim_tpu.obs import observatory
+from madsim_tpu.obs.cli import main as obs_main
+from madsim_tpu.parallel.sweep import sweep
+
+RAFT_FAULTS = np.array([[300_000, FAULT_KILL, 0, 0],
+                        [700_000, FAULT_RESTART, 0, 0]], np.int32)
+
+# The documented progress-record schema (docs/observability.md).
+TELEMETRY_KEYS = {
+    "schema", "elapsed_s", "chunks", "steps", "batch_worlds", "n_active",
+    "occupancy", "seeds_total", "seeds_admitted", "seeds_done",
+    "seeds_per_s", "world_utilization", "dispatch_depth", "bug_seen",
+    "eta_s",
+}
+
+
+@pytest.fixture(scope="module")
+def eng_on():
+    rcfg = RaftDeviceConfig(n=3, n_proposals=2, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=1_500_000, metrics=True)
+    return DeviceEngine(RaftActor(rcfg), cfg)
+
+
+@pytest.fixture(scope="module")
+def eng_off():
+    rcfg = RaftDeviceConfig(n=3, n_proposals=2, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=1_500_000)
+    return DeviceEngine(RaftActor(rcfg), cfg)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: telemetry schema on both orchestration paths (the
+# test_loop_stats_schema_both_paths sibling for the observatory layer)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_telemetry_schema_both_paths(eng_on, pipeline):
+    records = []
+    res = sweep(None, eng_on.cfg, np.arange(24), engine=eng_on,
+                chunk_steps=64, max_steps=2_048, faults=RAFT_FAULTS,
+                pipeline=pipeline, observe=records.append)
+    progress = [r for r in records if r.get("event") != "summary"]
+    summary = [r for r in records if r.get("event") == "summary"]
+    # One progress record per host read, plus exactly one summary.
+    assert len(progress) == res.loop_stats["scalar_fetches"]
+    assert len(summary) == 1
+    for rec in progress:
+        assert TELEMETRY_KEYS <= set(rec), sorted(rec)
+        assert rec["schema"] == "madsim.sweep.telemetry/1"
+        assert isinstance(rec["elapsed_s"], float) and rec["elapsed_s"] >= 0
+        for key in ("chunks", "steps", "batch_worlds", "n_active",
+                    "seeds_total", "seeds_admitted", "seeds_done",
+                    "dispatch_depth"):
+            assert isinstance(rec[key], int) and rec[key] >= 0, key
+        assert 0.0 <= rec["occupancy"] <= 1.0
+        assert rec["seeds_done"] <= rec["seeds_total"] == 24
+        assert rec["eta_s"] is None or rec["eta_s"] >= 0.0
+        # Coverage riders (metrics engine): distinct count + bucket width.
+        assert rec["coverage_buckets"] == 256
+        assert 0 <= rec["coverage_distinct"] <= 256
+    # elapsed_s is monotonic within the stream (perf_counter-based).
+    els = [r["elapsed_s"] for r in progress]
+    assert els == sorted(els)
+    # Progress coverage_distinct matches the result's novelty curve tail.
+    assert progress[-1]["coverage_distinct"] == int(
+        res.coverage.novelty_curve[-1])
+    s = summary[0]
+    assert s["loop_stats"] == res.loop_stats
+    assert s["failing_seeds"] == len(res.failing_seeds)
+    assert s["coverage"]["distinct_behaviors"] == \
+        res.coverage.distinct_behaviors
+    json.dumps(records)  # the whole stream is plain JSON
+
+
+def test_telemetry_adds_zero_fetches_and_is_invisible(eng_on, monkeypatch):
+    """Tier-1 sync discipline, observatory edition: with coverage AND a
+    telemetry observer on, the loop still performs exactly one scalar
+    _fetch per superstep (the novelty lane rides the same batch) plus
+    the single final merge pull — and the observed sweep's results are
+    bitwise identical to an unobserved one."""
+    plain = sweep(None, eng_on.cfg, np.arange(40), engine=eng_on,
+                  chunk_steps=64, max_steps=3_000, faults=RAFT_FAULTS)
+    calls = []
+    real_fetch = sweep_mod._fetch
+
+    def counting_fetch(tree):
+        out = real_fetch(tree)
+        import jax
+        calls.append(sum(np.asarray(x).nbytes
+                         for x in jax.tree.leaves(out)))
+        return out
+
+    monkeypatch.setattr(sweep_mod, "_fetch", counting_fetch)
+    records = []
+    res = sweep(None, eng_on.cfg, np.arange(40), engine=eng_on,
+                chunk_steps=64, max_steps=3_000, faults=RAFT_FAULTS,
+                observe=records.append)
+    st = res.loop_stats
+    assert len(calls) == st["scalar_fetches"] + 1  # + final merge pull
+    # Steady-state pulls stay a few hundred bytes even with the novelty
+    # lane aboard — never a per-world array.
+    assert max(calls[:-1]) <= 320, calls
+    assert len(records) == st["scalar_fetches"] + 1  # + summary record
+    for k, v in plain.observations.items():
+        np.testing.assert_array_equal(v, res.observations[k], err_msg=k)
+    np.testing.assert_array_equal(plain.coverage.hits, res.coverage.hits)
+
+
+# ---------------------------------------------------------------------------
+# Emitters: JSONL stream, watch CLI, Prometheus snapshots
+# ---------------------------------------------------------------------------
+
+def test_jsonl_stream_watch_cli_and_prometheus(eng_on, tmp_path, capsys):
+    stream = str(tmp_path / "tele.jsonl")
+    res = sweep(None, eng_on.cfg, np.arange(24), engine=eng_on,
+                chunk_steps=64, max_steps=3_000, faults=RAFT_FAULTS,
+                observe=stream)
+    lines = [json.loads(ln) for ln in open(stream)]
+    assert lines[-1]["event"] == "summary"
+    assert len(lines) == res.loop_stats["scalar_fetches"] + 1
+
+    # Summary mode of the CLI.
+    prom = str(tmp_path / "snap.prom")
+    rc = obs_main(["watch", stream, "--prom", prom])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "distinct behaviors" in out and "failing" in out
+    text = open(prom).read()
+    assert "# TYPE madsim_sweep_elapsed_s gauge" in text
+    assert f"madsim_sweep_seeds_total {24}" in text
+
+    # Follow mode over a completed stream: tails every record, prints
+    # the summary, and returns without blocking.
+    buf = io.StringIO()
+    rc = observatory.watch(stream, follow=True, interval=0.01, out=buf)
+    assert rc == 0
+    tail = buf.getvalue()
+    assert tail.count("chunks=") >= res.loop_stats["scalar_fetches"]
+    assert "behaviors=" in tail
+
+    # Missing file → usage-style exit.
+    assert observatory.watch(str(tmp_path / "nope.jsonl")) == 2
+
+
+def test_make_observer_contract(tmp_path):
+    assert observatory.make_observer(None) == (None, None)
+    sink = []
+    emit, close = observatory.make_observer(sink.append)
+    emit({"x": 1})
+    assert sink == [{"x": 1}] and close is None
+    with pytest.raises(TypeError, match="observe"):
+        observatory.make_observer(42)
+    path = tmp_path / "s.jsonl"
+    emit, close = observatory.make_observer(str(path))
+    emit({"a": True})
+    close()
+    assert json.loads(path.read_text()) == {"a": True}
+
+
+def test_prometheus_text_shape():
+    text = observatory.prometheus_text(
+        {"seeds_per_s": 12.5, "bug_seen": True, "note": "skip-me",
+         "eta_s": None, "loop_stats": {"nested": 1}})
+    assert "madsim_sweep_seeds_per_s 12.5" in text
+    assert "madsim_sweep_bug_seen 1" in text
+    assert "note" not in text and "nested" not in text
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture window
+# ---------------------------------------------------------------------------
+
+def test_profile_dir_captures_and_stays_invisible(eng_off, tmp_path):
+    """sweep(profile_dir=...) lands a device-timeline capture under the
+    directory and changes nothing about the results (bitwise) or the
+    dispatch schedule."""
+    plain = sweep(None, eng_off.cfg, np.arange(24), engine=eng_off,
+                  chunk_steps=64, max_steps=2_048)
+    pdir = str(tmp_path / "prof")
+    prof = sweep(None, eng_off.cfg, np.arange(24), engine=eng_off,
+                 chunk_steps=64, max_steps=2_048, profile_dir=pdir,
+                 profile_window=(0, 2))
+    files = [os.path.join(r, fn) for r, _d, fns in os.walk(pdir)
+             for fn in fns]
+    assert files, "profiler window captured nothing"
+    for k, v in plain.observations.items():
+        np.testing.assert_array_equal(v, prof.observations[k], err_msg=k)
+    assert plain.loop_stats["dispatches"] == prof.loop_stats["dispatches"]
+
+
+def test_profile_window_validation(eng_off, tmp_path):
+    with pytest.raises(ValueError, match="profile_window"):
+        sweep(None, eng_off.cfg, np.arange(8), engine=eng_off,
+              chunk_steps=64, max_steps=256,
+              profile_dir=str(tmp_path / "p"), profile_window=(3, 3))
+    # window is ignored entirely when no profile_dir is given.
+    observatory.ProfilerWindow(None, (9, 9)).before_dispatch()
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_diff.py — the regression table
+# ---------------------------------------------------------------------------
+
+def _bench_doc(seeds_per_sec, flops, distinct=8):
+    return {
+        "metric": "madraft_3node_1s_seeds_per_sec",
+        "value": seeds_per_sec, "unit": "seeds/s", "vs_baseline": 100.0,
+        "configs": {
+            "madraft_5node": {
+                "seeds_per_sec": seeds_per_sec / 10,
+                "world_utilization": 0.9,
+                "xla_cost": {"flops_per_world_step": flops},
+                "sweep_loop": {"chunks_per_dispatch": 4.0,
+                               "host_decision_s": 0.01,
+                               "loop_wall_s": 1.0},
+                "coverage": {"distinct_behaviors": distinct},
+            },
+        },
+    }
+
+
+def test_bench_diff_table_and_regression_gate(tmp_path, capsys):
+    import tools.bench_diff as bd
+
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_bench_doc(100_000.0, 8_000.0)))
+    # Faster headline, but a flop regression past any threshold.
+    new.write_text(json.dumps(_bench_doc(120_000.0, 16_000.0)))
+    rc = bd.main([str(old), str(new)])
+    out = capsys.readouterr().out
+    assert rc == 0  # informational by default
+    assert "headline seeds/s" in out and "+20.0%" in out
+    assert "REGRESSED" in out  # flops doubled, lower-is-better
+    rc = bd.main([str(old), str(new), "--fail-on-regress", "50"])
+    assert rc == 1  # the 100% flop regression trips the gate
+    rc = bd.main([str(old), str(new), "--fail-on-regress", "150"])
+    assert rc == 0  # within tolerance
+
+
+def test_bench_diff_loads_wrapper_shapes(tmp_path):
+    import tools.bench_diff as bd
+
+    doc = _bench_doc(50_000.0, 7_000.0)
+    raw = tmp_path / "bench_results.json"
+    raw.write_text(json.dumps(doc))
+    assert bd.load_round(str(raw))["value"] == 50_000.0
+    wrapped = tmp_path / "BENCH_r09.json"
+    wrapped.write_text(json.dumps({"n": 9, "rc": 0, "parsed": doc}))
+    assert bd.load_round(str(wrapped))["value"] == 50_000.0
+    # parsed=null with the result's JSON line surviving in the tail.
+    tail = tmp_path / "BENCH_r10.json"
+    tail.write_text(json.dumps(
+        {"n": 10, "rc": 0, "parsed": None,
+         "tail": "noise\n" + json.dumps(doc) + "\n"}))
+    assert bd.load_round(str(tail))["value"] == 50_000.0
+    # Unrecoverable (head-truncated) tail → a clear error.
+    bad = tmp_path / "BENCH_r11.json"
+    bad.write_text(json.dumps({"n": 11, "parsed": None,
+                               "tail": "…cut} {also-not-json"}))
+    with pytest.raises(ValueError, match="no parsable result"):
+        bd.load_round(str(bad))

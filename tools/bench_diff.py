@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""bench_diff — regression table between two bench rounds.
+
+Compares the metrics that gate this repo's performance story — headline
+seeds/s, per-config seeds/s and world utilization, the XLA cost model
+(flops/bytes per world-step, peak-over-state), the sweep loop's stall
+profile (host share of loop wall, superstep fan-in), bridge throughput,
+and behavior coverage — between two bench artifacts, and prints an
+aligned table with per-metric deltas and regression markers.
+
+Accepted inputs (auto-detected per file):
+
+- ``bench_results.json`` — the raw result ``bench.py`` writes;
+- ``BENCH_r*.json`` — the driver wrapper ``{n, cmd, rc, tail, parsed}``
+  (``parsed`` may be null when the run's stdout was truncated; the last
+  JSON line of ``tail`` is tried as a fallback).
+
+Usage::
+
+    python tools/bench_diff.py OLD.json NEW.json [--fail-on-regress PCT]
+    python tools/bench_diff.py --auto     # newest round vs bench_results
+
+``--fail-on-regress PCT`` exits 1 when any tracked metric moves against
+its better-direction by more than PCT percent — the CI hook (`make
+bench-diff` runs after `make smoke` whenever a previous round artifact
+exists). Without it the tool always exits 0 on a successful comparison:
+the table is the product.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (label, dotted path into the result dict, higher_is_better).
+# Paths resolve leniently: a missing leg renders "-" instead of failing,
+# so old rounds without newer fields still diff cleanly.
+METRICS: List[Tuple[str, str, bool]] = [
+    ("headline seeds/s", "value", True),
+    ("headline vs_baseline", "vs_baseline", True),
+    ("5node seeds/s", "configs.madraft_5node.seeds_per_sec", True),
+    ("5node utilization", "configs.madraft_5node.world_utilization", True),
+    ("5node flops/world-step",
+     "configs.madraft_5node.xla_cost.flops_per_world_step", False),
+    ("5node bytes/step",
+     "configs.madraft_5node.xla_cost.bytes_accessed_per_step", False),
+    ("5node peak/state",
+     "configs.madraft_5node.xla_cost.peak_over_state", False),
+    ("5node chunks/dispatch",
+     "configs.madraft_5node.sweep_loop.chunks_per_dispatch", True),
+    ("5node host stall s",
+     "configs.madraft_5node.sweep_loop.host_decision_s", False),
+    ("5node loop wall s",
+     "configs.madraft_5node.sweep_loop.loop_wall_s", False),
+    ("5node distinct behaviors",
+     "configs.madraft_5node.coverage.distinct_behaviors", True),
+    ("ttfb device seeds/s",
+     "configs.time_to_first_bug.device_seeds_per_sec", True),
+    ("ttfb flops/world-step",
+     "configs.time_to_first_bug.xla_cost.flops_per_world_step", False),
+    ("ttfb peak/state",
+     "configs.time_to_first_bug.xla_cost.peak_over_state", False),
+    ("ttfb hunt utilization",
+     "configs.time_to_first_bug.recycled_hunt.world_utilization", True),
+    ("ttfb chunks/dispatch",
+     "configs.time_to_first_bug.sweep_loop.chunks_per_dispatch", True),
+    ("ttfb distinct behaviors",
+     "configs.time_to_first_bug.coverage.distinct_behaviors", True),
+    ("bridge seeds/s", "configs.bridge_sweep.bridge_seeds_per_sec", True),
+    ("bridge vs host", "configs.bridge_sweep.bridge_vs_host", True),
+    ("host engine seeds/s", "configs.host_engine.seeds_per_sec", True),
+]
+
+
+def load_round(path: str) -> dict:
+    """A bench result dict from either artifact shape (see module doc)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "metric" in doc and "configs" in doc:
+        return doc  # bench_results.json shape
+    if "parsed" in doc:  # BENCH_r wrapper
+        if isinstance(doc.get("parsed"), dict):
+            return doc["parsed"]
+        # Truncated-stdout rounds: the tail's last JSON-looking line.
+        for line in reversed((doc.get("tail") or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        raise ValueError(f"{path}: wrapper has no parsable result "
+                         "(parsed is null and no JSON line in tail)")
+    raise ValueError(f"{path}: not a bench artifact (neither "
+                     "bench_results.json nor a BENCH_r wrapper)")
+
+
+def dig(doc: Any, path: str) -> Optional[float]:
+    cur = doc
+    for leg in path.split("."):
+        if not isinstance(cur, dict) or leg not in cur:
+            return None
+        cur = cur[leg]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def _auto_pair() -> Tuple[str, str]:
+    """--auto: newest *parsable* BENCH_r*.json round vs
+    bench_results.json (if it exists), else the two newest parsable
+    rounds. Rounds whose stdout was truncated past recovery (no
+    ``parsed``, no JSON tail line) are skipped with a note — exactly the
+    failure mode that motivated the durable bench_results.json."""
+    rounds = sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"BENCH_r(\d+)", p).group(1)))
+    parsable = []
+    for p in reversed(rounds):
+        try:
+            load_round(p)
+            parsable.append(p)
+        except (ValueError, OSError) as exc:
+            print(f"bench_diff: skipping {os.path.basename(p)}: {exc}",
+                  file=sys.stderr)
+        if len(parsable) >= 2:
+            break
+    current = os.path.join(REPO, "bench_results.json")
+    if parsable and os.path.exists(current):
+        return parsable[0], current
+    if len(parsable) >= 2:
+        return parsable[1], parsable[0]
+    raise SystemExit(
+        "bench_diff --auto: need a parsable BENCH_r*.json plus "
+        "bench_results.json (or two parsable rounds) — run `make smoke` "
+        "first")
+
+
+def diff_table(old: dict, new: dict, old_name: str, new_name: str,
+               fail_pct: Optional[float] = None) -> Tuple[str, List[str]]:
+    w_label = max(len(m[0]) for m in METRICS)
+    header = (f"{'metric':<{w_label}}  {old_name:>14}  {new_name:>14}  "
+              f"{'Δ%':>8}  ")
+    lines = [header, "-" * len(header)]
+    regressions: List[str] = []
+    for label, path, higher_better in METRICS:
+        a, b = dig(old, path), dig(new, path)
+        if a is None and b is None:
+            continue
+
+        def fmt(v):
+            if v is None:
+                return "-"
+            return f"{v:,.4g}" if abs(v) < 1000 else f"{v:,.0f}"
+
+        if a is None or b is None or a == 0:
+            delta_s, mark = "-", "  (new)" if a is None else "  (gone)"
+        else:
+            pct = (b - a) / abs(a) * 100.0
+            improved = pct >= 0 if higher_better else pct <= 0
+            delta_s = f"{pct:+.1f}%"
+            mark = "" if abs(pct) < 0.05 else ("  ok" if improved
+                                               else "  REGRESSED")
+            if not improved and fail_pct is not None \
+                    and abs(pct) > fail_pct:
+                regressions.append(f"{label}: {fmt(a)} -> {fmt(b)} "
+                                   f"({delta_s})")
+        lines.append(f"{label:<{w_label}}  {fmt(a):>14}  {fmt(b):>14}  "
+                     f"{delta_s:>8}{mark}")
+    return "\n".join(lines), regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="regression table between two bench rounds")
+    ap.add_argument("old", nargs="?", help="older artifact "
+                                           "(BENCH_r*.json or "
+                                           "bench_results.json)")
+    ap.add_argument("new", nargs="?", help="newer artifact")
+    ap.add_argument("--auto", action="store_true",
+                    help="newest BENCH round vs bench_results.json")
+    ap.add_argument("--fail-on-regress", type=float, default=None,
+                    metavar="PCT",
+                    help="exit 1 if any metric regresses more than PCT%%")
+    args = ap.parse_args(argv)
+
+    if args.auto:
+        old_path, new_path = _auto_pair()
+    elif args.old and args.new:
+        old_path, new_path = args.old, args.new
+    else:
+        ap.error("give OLD and NEW artifacts, or --auto")
+    old = load_round(old_path)
+    new = load_round(new_path)
+    table, regressions = diff_table(
+        old, new, os.path.basename(old_path)[:14],
+        os.path.basename(new_path)[:14],
+        fail_pct=args.fail_on_regress)
+    print(f"bench_diff: {old_path} -> {new_path}")
+    print(table)
+    if regressions:
+        print(f"\n{len(regressions)} metric(s) regressed past "
+              f"{args.fail_on_regress}%:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
