@@ -9,7 +9,7 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint test smoke dryrun determinism dualmode native clean \
-        replay-demo bench-diff
+        replay-demo bench-diff chaos chaos-full
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -64,6 +64,20 @@ smoke:
 	assert all(isinstance(x,dict) and x.get('distinct_behaviors',0)>1 \
 	           for x in cv), f'coverage records missing/flat: {cv}'; \
 	print('bench_results.json ok:', d['metric'])"
+
+# Fleet chaos matrix (docs/fleet.md): worker kills, lease expiries +
+# re-issues, duplicated completions, SIGTERM preemptions, torn
+# checkpoints — asserting the merged SweepResult stays bitwise identical
+# to a crash-free fleet AND a single-host sweep, for raft/pb/tpc on the
+# CPU mesh. CI runs this after smoke; `make test` covers the same
+# contract via tests/test_fleet.py. chaos-full adds the multiprocess
+# leg (real worker processes + SIGKILL; slower — each worker re-imports
+# JAX).
+chaos:
+	$(CPU_ENV) $(PY) tools/chaos_matrix.py
+
+chaos-full:
+	$(CPU_ENV) $(PY) tools/chaos_matrix.py --process
 
 # Regression table between two bench rounds (tools/bench_diff.py):
 # compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls

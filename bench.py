@@ -657,6 +657,59 @@ def bench_madraft_5node(n_worlds: int) -> dict:
     return out
 
 
+def bench_fleet_sweep(n_worlds: int) -> dict:
+    """2-worker local fleet fabric vs single-host sweep on the same
+    seeds (docs/fleet.md): measures the fabric's orchestration overhead
+    — lease RPCs, heartbeats, per-range dispatch — so bench_diff tracks
+    it round over round. The bitwise contract (fleet == single-host on
+    ids/bugs/observations) is asserted inline; this bench exists for
+    the RATE delta, the tier-1 chaos matrix owns the contract."""
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig
+    from madsim_tpu.fleet import fleet_sweep
+    from madsim_tpu.parallel.sweep import sweep
+
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_500_000)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(n_worlds)
+    kw = dict(chunk_steps=64, max_steps=100_000)
+    n_ranges = 8
+
+    # Warmup compiles both paths on the real shapes.
+    single = sweep(None, cfg, seeds, engine=eng, **kw)
+    fleet = fleet_sweep(None, cfg, seeds, engine=eng, n_workers=2,
+                        range_size=-(-n_worlds // n_ranges), **kw)
+    assert np.array_equal(single.bug, fleet.bug), \
+        "fleet result diverged from single-host (bitwise contract)"
+
+    t0 = walltime.perf_counter()
+    single = sweep(None, cfg, seeds, engine=eng, **kw)
+    dt_single = walltime.perf_counter() - t0
+    t0 = walltime.perf_counter()
+    fleet = fleet_sweep(None, cfg, seeds, engine=eng, n_workers=2,
+                        range_size=-(-n_worlds // n_ranges), **kw)
+    dt_fleet = walltime.perf_counter() - t0
+
+    stats = fleet.loop_stats["fleet"]
+    out = {"n_worlds": n_worlds,
+           "n_workers": 2,
+           "n_ranges": stats["ranges"],
+           "single_seeds_per_sec": round(n_worlds / dt_single, 2),
+           "fleet_seeds_per_sec": round(n_worlds / dt_fleet, 2),
+           # >0 = the fabric costs throughput vs one big batch (smaller
+           # per-range batches + lease bookkeeping); the tracked number.
+           "fabric_overhead_frac": round(1 - dt_single / dt_fleet, 4),
+           "leases_issued": stats["leases_issued"],
+           "heartbeats": stats["heartbeats"],
+           "fabric_ticks": stats["fabric_ticks"]}
+    log(f"fleet_sweep[{jax.default_backend()}]: single {dt_single:.2f}s "
+        f"fleet {dt_fleet:.2f}s  {out}")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Cross-engine validation: TPU<->CPU bit-exactness
 # ---------------------------------------------------------------------------
@@ -1021,6 +1074,8 @@ _CONFIGS = [
          device_worlds=1_024 if a.smoke else 65_536)),
     ("5node", "madraft_5node",
      lambda a: bench_madraft_5node(256 if a.smoke else 100_000)),
+    ("fleet", "fleet_sweep",
+     lambda a: bench_fleet_sweep(128 if a.smoke else 4_096)),
     ("bridge", "bridge_sweep",
      lambda a: bench_bridge_sweep(n_host=16 if a.smoke else 64,
                                   n_bridge=64 if a.smoke else 512)),
@@ -1103,7 +1158,8 @@ def main() -> None:
     ap.add_argument("--host-seeds", type=int, default=None)
     ap.add_argument("--only", type=str, default=None,
                     help="comma list: 3node,rpc,rpc_real,grpc,postgres,"
-                         "5node,crosscheck,bug,bridge (3node = the headline)")
+                         "5node,fleet,crosscheck,bug,bridge "
+                         "(3node = the headline)")
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "
                          "failure isolation keeps the headline alive")

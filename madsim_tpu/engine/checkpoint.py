@@ -25,6 +25,13 @@ import numpy as np
 
 FORMAT_VERSION = 1
 
+# npz entry prefix for auxiliary arrays (sweep-level state riding beside
+# the WorldState leaves: slot->seed index, refill cursor, retired
+# observations, coverage ledger — see parallel/sweep.py recycled
+# checkpointing). Aux entries are opt-in per save and invisible to loads
+# that do not ask for them, so pre-aux checkpoints stay readable.
+_AUX_PREFIX = "aux_"
+
 
 class CheckpointError(RuntimeError):
     pass
@@ -39,10 +46,18 @@ def _config_fingerprint(engine) -> str:
 
 
 def save(engine, state, path: Union[str, Path],
-         extra_meta: Optional[Dict[str, str]] = None) -> None:
+         extra_meta: Optional[Dict[str, str]] = None,
+         extra_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
     """Write a WorldState (any world count) to ``path`` (npz), atomically:
     a preemption mid-write must never destroy the previous checkpoint, so
-    the bytes land in a temp file that os.replace()s onto ``path``.
+    the bytes land in a temp file that is fsync'd and then os.replace()d
+    onto ``path`` — without the fsync, a crash between write and rename
+    can publish a name pointing at unflushed (torn) bytes.
+
+    ``extra_arrays``: named host arrays saved beside the state leaves
+    (``aux_<name>`` entries) — sweep-level bookkeeping such as the
+    slot→seed index and refill cursor of a recycled sweep. Read back via
+    ``load(..., with_aux=True)``.
 
     Scope: single-process (all shards addressable from this host) — any
     mesh within one process, including the virtual multihost one. Real
@@ -63,12 +78,15 @@ def save(engine, state, path: Union[str, Path],
     host_leaves, now = jax.device_get((leaves, state.now))
     arrays = {f"leaf_{i:05d}": np.asarray(leaf)
               for i, leaf in enumerate(host_leaves)}
+    aux = {f"{_AUX_PREFIX}{k}": np.asarray(v)
+           for k, v in (extra_arrays or {}).items()}
     meta = {
         "version": FORMAT_VERSION,
         "n_leaves": len(leaves),
         "n_worlds": int(now.shape[0]) if now.ndim else 0,
         "config": _config_fingerprint(engine),
         "extra": dict(extra_meta or {}),
+        "aux": sorted(extra_arrays or {}),
     }
     path = Path(path)
     tmp = path.with_name(path.name + ".tmp")
@@ -79,12 +97,38 @@ def save(engine, state, path: Union[str, Path],
         # overlaps the next chunk under the async writer. np.load reads
         # both formats, so old compressed checkpoints keep resuming.
         np.savez(f, meta=np.frombuffer(
-            json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+            json.dumps(meta).encode(), dtype=np.uint8), **arrays, **aux)
+        # Durability before visibility: os.replace only swaps the NAME.
+        # If the data blocks are still in the page cache when the rename
+        # lands and the host dies, the published path holds a torn npz —
+        # exactly the crash window the atomic-rename dance exists to
+        # close. flush+fsync first, so the rename never points at
+        # unflushed bytes.
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
+def _corrupt(path, exc: BaseException) -> CheckpointError:
+    """Wrap a low-level decode failure in an actionable CheckpointError.
+
+    Raw ``zipfile.BadZipFile`` / numpy internals say nothing about WHICH
+    file broke or what to do about it; a resuming sweep must report both
+    (the fleet's crash-recovery path hits this whenever a host died
+    mid-write on a pre-fsync kernel or the disk itself tore the file).
+    """
+    return CheckpointError(
+        f"corrupt or truncated checkpoint {os.fspath(path)!r}: "
+        f"{type(exc).__name__}: {exc}\n"
+        "recovery options: delete the file (or run with resume=False) to "
+        "restart this range from its seeds — re-execution is "
+        "deterministic, so nothing but time is lost — or point at an "
+        "older intact checkpoint")
+
+
 def load(engine, path: Union[str, Path],
-         expect_extra: Optional[Dict[str, str]] = None):
+         expect_extra: Optional[Dict[str, str]] = None,
+         with_aux: bool = False):
     """Read a WorldState saved by :func:`save` back onto the device.
 
     The pytree structure comes from the engine (one-world init template —
@@ -93,28 +137,63 @@ def load(engine, path: Union[str, Path],
     ``expect_extra``: key/value pairs that must match the checkpoint's
     extra metadata (e.g. a seed-vector hash, so results can never be
     attributed to the wrong seeds).
+
+    ``with_aux=True`` returns ``(state, aux)`` where ``aux`` maps the
+    names passed to ``save(extra_arrays=...)`` to host arrays (``{}`` for
+    checkpoints written without aux).
+
+    Truncated or corrupt files (crash mid-write, torn disk) raise
+    :class:`CheckpointError` naming the path and the recovery options —
+    never a bare ``zipfile``/numpy internal error.
     """
-    with np.load(Path(path)) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        if meta.get("version") != FORMAT_VERSION:
-            raise CheckpointError(
-                f"unsupported checkpoint version {meta.get('version')}")
-        fp = _config_fingerprint(engine)
-        if meta["config"] != fp:
-            raise CheckpointError(
-                "checkpoint was written by a different engine config:\n"
-                f"  checkpoint: {meta['config']}\n  this engine: {fp}")
-        stored_extra = meta.get("extra", {})
-        for key, value in (expect_extra or {}).items():
-            if stored_extra.get(key) != value:
+    try:
+        with np.load(Path(path)) as z:
+            try:
+                meta = json.loads(bytes(z["meta"]).decode())
+            except Exception as exc:
+                raise _corrupt(path, exc) from exc
+            if meta.get("version") != FORMAT_VERSION:
                 raise CheckpointError(
-                    f"checkpoint metadata mismatch for {key!r}: "
-                    f"checkpoint has {stored_extra.get(key)!r}, "
-                    f"caller expects {value!r}")
-        leaves = [z[f"leaf_{i:05d}"] for i in range(meta["n_leaves"])]
+                    f"unsupported checkpoint version {meta.get('version')}")
+            fp = _config_fingerprint(engine)
+            if meta["config"] != fp:
+                raise CheckpointError(
+                    "checkpoint was written by a different engine config:\n"
+                    f"  checkpoint: {meta['config']}\n  this engine: {fp}")
+            stored_extra = meta.get("extra", {})
+            for key, value in (expect_extra or {}).items():
+                if stored_extra.get(key) != value:
+                    raise CheckpointError(
+                        f"checkpoint metadata mismatch for {key!r}: "
+                        f"checkpoint has {stored_extra.get(key)!r}, "
+                        f"caller expects {value!r}")
+            leaves = [z[f"leaf_{i:05d}"] for i in range(meta["n_leaves"])]
+            aux = {name: z[f"{_AUX_PREFIX}{name}"]
+                   for name in meta.get("aux", [])}
+    except CheckpointError:
+        raise
+    except Exception as exc:
+        # np.load raises zipfile.BadZipFile on garbage, OSError/EOFError
+        # on truncation, KeyError/ValueError on missing or half-written
+        # members — all the same operational fact: this file cannot be
+        # resumed from.
+        raise _corrupt(path, exc) from exc
     treedef = jax.tree.structure(engine.init(np.zeros(1, np.uint64)))
     if treedef.num_leaves != len(leaves):
         raise CheckpointError(
             f"checkpoint has {len(leaves)} leaves, engine state has "
             f"{treedef.num_leaves} — incompatible engine version")
-    return jax.tree.unflatten(treedef, [jax.numpy.asarray(a) for a in leaves])
+    state = jax.tree.unflatten(treedef,
+                               [jax.numpy.asarray(a) for a in leaves])
+    return (state, aux) if with_aux else state
+
+
+def read_meta(path: Union[str, Path]) -> Dict[str, object]:
+    """The checkpoint's meta header alone (no state decode) — cheap
+    inspection for coordinators deciding whether a released lease
+    checkpoint is worth handing to the next worker."""
+    try:
+        with np.load(Path(path)) as z:
+            return json.loads(bytes(z["meta"]).decode())
+    except Exception as exc:
+        raise _corrupt(path, exc) from exc

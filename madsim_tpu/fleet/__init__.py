@@ -1,0 +1,54 @@
+"""Resilient fleet sweep fabric: leased seed ranges + crash-identical
+recovery (docs/fleet.md).
+
+The step from "one process's device set" (parallel/sweep.py) toward the
+ROADMAP's always-on, millions-of-seeds/s hunting service: a coordinator
+splits the seed vector into contiguous ranges and leases them (with
+expiry) to workers; each worker runs its leased slice through the
+pipelined device sweep and heartbeats progress; expired or released
+leases re-issue to surviving workers. Because every range sweep is
+bit-deterministic from its seeds, failure recovery is *replay*: a
+crashed worker's range re-executes identically elsewhere, a preempted
+worker's checkpoint resumes bit-exactly, and a double-completed range
+is resolved by asserting bitwise equality — redundancy becomes a free
+cross-execution determinism check instead of a conflict.
+
+The contract (tier-1 chaos matrix, tests/test_fleet.py + ``make
+chaos``): a fleet sweep under injected worker kills, lease expiries,
+duplicate completions, SIGTERM preemptions, and torn checkpoints
+returns seed ids, bug flags, per-seed observations/metrics, and a
+coverage ledger bitwise identical to a crash-free fleet AND to a
+single-host ``sweep()`` over the same seeds.
+
+Entry point: :func:`fleet_sweep` (inline deterministic fabric by
+default; ``spawn="process"`` for real OS workers with pipes+signals).
+"""
+from .chaos import ChaosConfig, ChaosPolicy
+from .coordinator import Coordinator, FLEET_SCHEMA
+from .fabric import FleetStalledError, LocalFabric, fleet_sweep
+from .lease import Lease, LeaseTable, SeedRange, split_ranges
+from .merge import (
+    FleetIntegrityError,
+    contract_mismatches,
+    merge_range_results,
+)
+from .rpc import (
+    InlineTransport,
+    RealClock,
+    RetryExhausted,
+    RetryPolicy,
+    RpcError,
+    VirtualClock,
+    call_with_retry,
+)
+from .worker import LeaseLost, LeasePreempted, Worker, WorkerKilled
+
+__all__ = [
+    "ChaosConfig", "ChaosPolicy", "Coordinator", "FLEET_SCHEMA",
+    "FleetIntegrityError", "FleetStalledError", "InlineTransport",
+    "Lease", "LeaseLost", "LeasePreempted", "LeaseTable", "LocalFabric",
+    "RealClock", "RetryExhausted", "RetryPolicy", "RpcError",
+    "SeedRange", "VirtualClock", "Worker", "WorkerKilled",
+    "call_with_retry", "contract_mismatches", "fleet_sweep",
+    "merge_range_results", "split_ranges",
+]

@@ -1,0 +1,186 @@
+"""The fleet coordinator: lease issue/expiry/re-issue, completion dedup,
+and per-worker fabric telemetry.
+
+One coordinator owns one hunt: the global seed vector, the range split,
+the lease table, and the accumulating per-range results. Its public
+surface is the four ``rpc_*`` methods workers reach through a transport
+(inline dispatch or a process pipe) — everything else is local state.
+The coordinator never touches a device: results arrive as host-side
+``SweepResult`` payloads, and the only "validation" it ever performs is
+the one determinism makes possible — bitwise equality of independent
+executions (fleet/merge.py).
+
+Telemetry: every protocol event emits one
+``madsim.fleet.telemetry/1`` record into the same observe-sink shape
+the sweep observatory uses (callable or JSONL path; docs/fleet.md lists
+the event vocabulary), so ``python -m madsim_tpu.obs watch`` machinery
+and operators get per-worker lease/retry/re-lease visibility without a
+second pipeline.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..parallel.sweep import SweepResult
+from .lease import LeaseTable, SeedRange, split_ranges
+from .merge import crosscheck_duplicate, merge_range_results
+
+FLEET_SCHEMA = "madsim.fleet.telemetry/1"
+
+
+class Coordinator:
+    """Lease-table owner + result accumulator for one fleet sweep.
+
+    ``clock`` follows fleet/rpc.py (virtual ticks inline, monotonic
+    seconds under processes); ``lease_ttl`` is in clock units. ``emit``
+    is an optional telemetry callable (one dict per protocol event).
+    """
+
+    def __init__(self, seeds, range_size: int, lease_ttl: float, clock,
+                 emit=None, n_devices: int = 1):
+        self.seeds = np.asarray(seeds, np.uint64)
+        self.ranges: List[SeedRange] = split_ranges(
+            self.seeds.shape[0], range_size)
+        self.table = LeaseTable(self.ranges, ttl=lease_ttl)
+        self.clock = clock
+        self.n_devices = n_devices
+        self._emit = emit
+        self.results: Dict[int, SweepResult] = {}
+        self.stats: Dict[str, int] = {
+            "ranges": len(self.ranges),
+            "leases_issued": 0,
+            "leases_reissued": 0,
+            "leases_expired": 0,
+            "leases_released": 0,
+            "heartbeats": 0,
+            "heartbeats_lost": 0,
+            "completions": 0,
+            "duplicate_completions": 0,
+            "duplicates_crosschecked": 0,
+        }
+
+    # -- telemetry -------------------------------------------------------
+    def emit(self, event: str, **fields) -> None:
+        if self._emit is None:
+            return
+        rec = {"schema": FLEET_SCHEMA, "event": event,
+               "t": self.clock.now()}
+        rec.update(fields)
+        self._emit(rec)
+
+    # -- the RPC surface -------------------------------------------------
+    def rpc_acquire(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        """Hand the next pending range to ``worker_id`` (None: nothing
+        pending — all ranges leased out or done; idle and retry)."""
+        self._reap()
+        lease = self.table.issue(worker_id, self.clock.now())
+        if lease is None:
+            return None
+        self.stats["leases_issued"] += 1
+        if lease.generation > 0:
+            self.stats["leases_reissued"] += 1
+        self.emit("lease_issued", worker=worker_id,
+                  lease_id=lease.lease_id, range_id=lease.range.range_id,
+                  lo=lease.range.lo, hi=lease.range.hi,
+                  generation=lease.generation,
+                  reissued=lease.generation > 0,
+                  resume_checkpoint=lease.checkpoint)
+        return {
+            "lease_id": lease.lease_id,
+            "range_id": lease.range.range_id,
+            "lo": lease.range.lo,
+            "hi": lease.range.hi,
+            "generation": lease.generation,
+            "expires_at": lease.expires_at,
+            "checkpoint": lease.checkpoint,
+        }
+
+    def rpc_heartbeat(self, worker_id: str, lease_id: int,
+                      progress: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """Extend a lease. ``ok=False`` tells the worker the lease is
+        LOST (expired and possibly re-issued): abandon the range — the
+        fabric guarantees someone (re-)runs it, and if the worker's own
+        run completes anyway the dedup layer absorbs it."""
+        self._reap()
+        ok = self.table.heartbeat(lease_id, worker_id, self.clock.now(),
+                                  progress)
+        self.stats["heartbeats" if ok else "heartbeats_lost"] += 1
+        self.emit("heartbeat", worker=worker_id, lease_id=lease_id,
+                  ok=ok, **(progress or {}))
+        return {"ok": ok}
+
+    def rpc_release(self, worker_id: str, lease_id: int,
+                    checkpoint: Optional[str] = None) -> Dict[str, Any]:
+        """SIGTERM-preemption give-back: the range re-queues immediately,
+        carrying the released checkpoint so its next holder resumes
+        bit-exactly instead of replaying from step zero."""
+        ok = self.table.release(lease_id, worker_id, checkpoint)
+        if ok:
+            self.stats["leases_released"] += 1
+        self.emit("lease_released", worker=worker_id, lease_id=lease_id,
+                  ok=ok, checkpoint=checkpoint)
+        return {"ok": ok}
+
+    def rpc_complete(self, worker_id: str, lease_id: int, range_id: int,
+                     result: SweepResult) -> Dict[str, Any]:
+        """Accept a range result. Duplicates (an expired lease's two
+        holders both finishing, retransmitted completions) resolve by
+        bitwise crosscheck against
+        the accepted result — a mismatch raises FleetIntegrityError
+        rather than silently picking a winner."""
+        self._reap()
+        first, _was_live = self.table.complete(range_id, lease_id)
+        if first:
+            self.results[range_id] = result
+            self.stats["completions"] += 1
+        else:
+            self.stats["duplicate_completions"] += 1
+            crosscheck_duplicate(range_id, self.results[range_id], result)
+            self.stats["duplicates_crosschecked"] += 1
+        self.emit("completion", worker=worker_id, lease_id=lease_id,
+                  range_id=range_id, duplicate=not first,
+                  crosschecked=not first,
+                  n_seeds=int(np.asarray(result.seeds).shape[0]),
+                  failing=len(result.failing_seeds))
+        return {"accepted": True, "duplicate": not first}
+
+    def rpc_poll_done(self, worker_id: str) -> Dict[str, Any]:
+        """Is the hunt over? Idle workers (acquire returned None because
+        every pending range is leased to someone else) poll this to
+        decide between waiting for a possible re-issue and exiting."""
+        del worker_id
+        return {"done": self.done()}
+
+    # -- scheduler-side --------------------------------------------------
+    def _reap(self) -> None:
+        for lease in self.table.expire(self.clock.now()):
+            self.stats["leases_expired"] += 1
+            self.emit("lease_expired", worker=lease.worker_id,
+                      lease_id=lease.lease_id,
+                      range_id=lease.range.range_id,
+                      generation=lease.generation,
+                      had_checkpoint=lease.checkpoint is not None)
+
+    def tick(self) -> None:
+        """One scheduling round: reap expired leases even when no RPC
+        arrives (a fleet whose only live worker is mid-sweep must still
+        notice a dead peer's lease)."""
+        self._reap()
+
+    def done(self) -> bool:
+        return len(self.results) == len(self.ranges)
+
+    def finalize(self, fleet_stats: Optional[Dict[str, Any]] = None
+                 ) -> SweepResult:
+        """Merge all range results into the fleet SweepResult and emit
+        the summary telemetry record."""
+        stats = dict(self.stats)
+        stats.update(fleet_stats or {})
+        result = merge_range_results(self.seeds, self.ranges, self.results,
+                                     self.n_devices, fleet_stats=stats)
+        self.emit("fleet_summary", seeds_total=int(self.seeds.shape[0]),
+                  failing=len(result.failing_seeds), **stats)
+        return result

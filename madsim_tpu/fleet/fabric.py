@@ -1,0 +1,213 @@
+"""The fleet fabric: deterministic scheduling of coordinator + workers.
+
+``fleet_sweep()`` is the one-call entry point — the fleet counterpart
+of ``parallel.sweep.sweep()`` — and :class:`LocalFabric` is the engine
+behind its default ``spawn="inline"`` mode: a single-threaded, round-
+robin scheduler that runs every worker's quantum in a fixed order on a
+virtual tick clock. No threads, no wall clock, no OS scheduler — which
+is exactly why the chaos matrix can be tier-1: a fabric execution is a
+pure function of (seeds, config, ChaosConfig), replayable like a seed.
+
+The inline fabric is not a toy: workers run REAL pipelined device
+sweeps over their leases (sharing one process's mesh — including the
+2-D DCN×ICI ``multihost_mesh``), the coordinator runs the REAL lease
+protocol, and every failure mode (kill, expiry, re-issue, duplicate,
+preemption, torn checkpoint, RPC retry) takes the same code path a
+multiprocess fleet takes. ``spawn="process"`` (fleet/process.py) swaps
+the scheduler for real OS processes + pipes + signals, changing the
+clock and the transport but not one line of protocol.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import observatory as _obsy
+from ..parallel.mesh import seed_mesh
+from ..parallel.sweep import SweepResult
+from .chaos import ChaosConfig, ChaosPolicy
+from .coordinator import Coordinator
+from .lease import split_ranges
+from .rpc import InlineTransport, RetryPolicy, VirtualClock
+from .worker import Worker
+
+
+class FleetStalledError(RuntimeError):
+    """The fabric cannot make progress: every worker is permanently dead
+    (restarts disabled) or the scheduling round budget ran out with
+    ranges still outstanding. Carries the coordinator's stats so the
+    post-mortem starts with data."""
+
+
+class LocalFabric:
+    """Deterministic in-process fabric: round-robin worker quanta on a
+    shared virtual clock, one tick per scheduling round (plus one per
+    heartbeat inside the sweeps)."""
+
+    def __init__(self, coordinator: Coordinator, workers: List[Worker],
+                 clock: VirtualClock, chaos: Optional[ChaosPolicy] = None,
+                 max_rounds: int = 100_000):
+        self.coordinator = coordinator
+        self.workers = workers
+        self.clock = clock
+        self.chaos = chaos
+        self.max_rounds = max_rounds
+
+    def run(self) -> SweepResult:
+        rounds = 0
+        while not self.coordinator.done():
+            rounds += 1
+            if rounds > self.max_rounds:
+                raise FleetStalledError(
+                    f"no convergence after {self.max_rounds} scheduling "
+                    f"rounds; outstanding ranges: "
+                    f"{self.coordinator.table.outstanding()}; "
+                    f"stats: {self.coordinator.stats}")
+            alive = 0
+            for w in self.workers:
+                if w.dead:
+                    if self.chaos is not None and self.chaos.restart_due(
+                            w.died_at, self.clock.now()):
+                        w.restart()
+                        self.coordinator.emit(
+                            "worker_restarted", worker=w.worker_id,
+                            after_preemption=w.preempted)
+                    continue
+                alive += 1
+                w.run_once()
+            if alive == 0 and not (self.chaos is not None
+                                   and self.chaos.restarts_enabled):
+                raise FleetStalledError(
+                    "all workers dead with restarts disabled; "
+                    f"outstanding ranges: "
+                    f"{self.coordinator.table.outstanding()}")
+            # The scheduler's own tick: even an all-idle round moves
+            # fabric time, so a dead worker's lease always expires and a
+            # downed worker's restart timer always fires.
+            self.clock.advance(1)
+            self.coordinator.tick()
+        stats = self._fleet_stats()
+        return self.coordinator.finalize(fleet_stats=stats)
+
+    def _fleet_stats(self) -> Dict[str, Any]:
+        agg: Dict[str, Any] = {"n_workers": len(self.workers),
+                               "fabric_ticks": int(self.clock.now()),
+                               "spawn": "inline"}
+        per_worker = {}
+        for w in self.workers:
+            per_worker[w.worker_id] = dict(w.stats)
+        agg["workers"] = per_worker
+        for key in ("kills", "preemptions", "rpc_retries",
+                    "heartbeats_dropped", "checkpoints_recovered",
+                    "checkpoints_discarded"):
+            agg[key] = sum(w.stats[key] for w in self.workers)
+        return agg
+
+
+def fleet_sweep(actor: Any, cfg, seeds, *,
+                n_workers: int = 2,
+                range_size: Optional[int] = None,
+                faults: Optional[np.ndarray] = None,
+                mesh=None,
+                engine=None,
+                lease_ttl: float = 8.0,
+                chaos: Optional[ChaosConfig] = None,
+                observe: Any = None,
+                checkpoint_dir: Optional[str] = None,
+                checkpoint_every_chunks: int = 4,
+                retry: Optional[RetryPolicy] = None,
+                max_rounds: int = 100_000,
+                spawn: str = "inline",
+                **sweep_kwargs) -> SweepResult:
+    """Distribute a seed sweep over a resilient coordinator/worker fleet.
+
+    The fleet analog of :func:`madsim_tpu.parallel.sweep.sweep`: the
+    seed vector splits into contiguous ranges (``range_size``; default
+    two ranges per worker), a coordinator leases ranges to ``n_workers``
+    workers with expiry ``lease_ttl`` (fabric clock units), each worker
+    runs the leased slice through the pipelined ``sweep()`` (all
+    ``sweep_kwargs`` — chunk_steps, recycle/batch_worlds, superstep_max
+    — pass through uniformly), and completed ranges merge into one
+    ``SweepResult``.
+
+    The resilience contract (tier-1, tests/test_fleet.py): with ANY
+    ``chaos`` mix of worker kills, lease expiries, duplicated
+    completions, preemptions, and torn checkpoints, the merged result's
+    seed ids, bug flags, per-seed observations/metrics, and coverage
+    ledger are bitwise identical to a crash-free fleet's AND to a
+    single-host ``sweep()`` over the same seeds — crashes cost wall
+    time, never results. Double-reported ranges are resolved by
+    asserting bitwise equality (:mod:`madsim_tpu.fleet.merge`), so
+    redundancy doubles as a cross-execution determinism check.
+
+    ``checkpoint_dir`` enables per-lease checkpointing: preempted
+    workers (SIGTERM → checkpoint + lease release) and crashed workers
+    leave resumable snapshots the range's next holder continues from
+    bit-exactly. ``observe`` receives the fleet telemetry stream
+    (``madsim.fleet.telemetry/1`` records — lease/heartbeat/retry/
+    re-lease/completion events; a path writes JSONL beside the sweep
+    observatory's format, docs/fleet.md).
+
+    ``spawn="inline"`` (default): deterministic single-threaded fabric,
+    workers sharing this process's mesh — any mesh, including the 2-D
+    DCN×ICI ``multihost_mesh``. ``spawn="process"`` runs workers as OS
+    processes with pipe transports and real SIGTERM preemption
+    (fleet/process.py) — the deployment shape, minus the determinism of
+    the scheduler (results are still bitwise identical; schedules are
+    not).
+    """
+    from ..engine.core import DeviceEngine
+
+    seeds = np.asarray(seeds, np.uint64)
+    n = int(seeds.shape[0])
+    if n == 0:
+        raise ValueError("fleet_sweep needs a non-empty seed vector")
+    if n_workers < 1:
+        raise ValueError("n_workers must be >= 1")
+    if range_size is None:
+        range_size = max(1, -(-n // (2 * n_workers)))
+    if spawn == "process":
+        from .process import process_fleet_sweep
+
+        return process_fleet_sweep(
+            actor, cfg, seeds, n_workers=n_workers, range_size=range_size,
+            faults=faults, lease_ttl=lease_ttl, observe=observe,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every_chunks=checkpoint_every_chunks,
+            retry=retry, **sweep_kwargs)
+    if spawn != "inline":
+        raise ValueError(f"spawn must be 'inline' or 'process', "
+                         f"got {spawn!r}")
+
+    eng = engine if engine is not None else DeviceEngine(actor, cfg)
+    mesh = mesh if mesh is not None else seed_mesh()
+    clock = VirtualClock()
+    emit, close = _obsy.make_observer(observe)
+    policy = ChaosPolicy(chaos) if chaos is not None else None
+    coordinator = Coordinator(seeds, range_size=range_size,
+                              lease_ttl=lease_ttl, clock=clock, emit=emit,
+                              n_devices=mesh.devices.size)
+    transport = InlineTransport(coordinator, chaos=policy)
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+    retry = retry or RetryPolicy()
+    workers = [
+        Worker(f"w{i}", eng, seeds, transport, clock, faults=faults,
+               mesh=mesh, retry=retry, chaos=policy, emit=emit,
+               checkpoint_dir=checkpoint_dir,
+               checkpoint_every_chunks=checkpoint_every_chunks,
+               sweep_kwargs=sweep_kwargs)
+        for i in range(n_workers)]
+    fabric = LocalFabric(coordinator, workers, clock, chaos=policy,
+                         max_rounds=max_rounds)
+    try:
+        return fabric.run()
+    finally:
+        if close is not None:
+            close()
+
+
+__all__ = ["LocalFabric", "FleetStalledError", "fleet_sweep",
+           "split_ranges"]

@@ -1,0 +1,324 @@
+"""The fleet worker: acquire a lease, sweep it, heartbeat, report.
+
+A worker is a thin loop around PR 4's pipelined ``sweep()``: one lease =
+one sweep over the leased seed slice, run to completion with the same
+engine, mesh, and sweep knobs every other worker uses (that uniformity
+is what the merge layer's bitwise contract rides on). Heartbeats piggy-
+back on the sweep's own telemetry cadence — the ``observe=`` callback
+fires once per host scalar read, so lease liveness costs ZERO extra
+device syncs — and the heartbeat boundary doubles as the fabric's
+preemption point: chaos kills, SIGTERM preemption, and lease-lost
+aborts all land there, between supersteps, where the sweep's own
+exception path already flushes the async checkpoint writer.
+
+Failure handling per the ISSUE contract:
+
+- **kill** (crash): the sweep aborts mid-flight, nothing is released;
+  the lease expires at the coordinator and re-issues. If the dead
+  worker had checkpointed, the re-issued lease carries the path and the
+  next holder resumes bit-exactly (crash recovery == resume).
+- **SIGTERM preemption**: ``request_preemption()`` (wired to the signal
+  by :func:`install_sigterm_handler`) makes the next heartbeat raise;
+  the worker releases the lease WITH its checkpoint and exits its
+  quantum cleanly — resume on restart, per the satellite.
+- **corrupt checkpoint** (torn file from a crashed writer): the
+  hardened loader (engine/checkpoint.py) raises ``CheckpointError``;
+  the worker deletes the file and re-runs the range fresh — losing only
+  time, never correctness, because re-execution is deterministic.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..engine.checkpoint import CheckpointError
+from .chaos import DELAY, DROP, KILL, PREEMPT
+from .rpc import RetryExhausted, RetryPolicy, call_with_retry
+
+
+class WorkerKilled(BaseException):
+    """Chaos crash: aborts the in-flight sweep at a heartbeat boundary.
+    BaseException so no recovery handler inside the sweep path can
+    accidentally swallow the 'crash'. (Python ``finally`` blocks still
+    run — so an async checkpoint writer flushes its last COMPLETED
+    snapshot, equivalent to dying just after a finished write; the
+    torn-file crash is injected separately via
+    ``ChaosConfig.tear_checkpoint_on_kill``.)"""
+
+
+class LeasePreempted(Exception):
+    """SIGTERM-style preemption: stop at the next heartbeat, release the
+    lease with the checkpoint, survive."""
+
+
+class LeaseLost(Exception):
+    """The coordinator declared this lease expired/superseded: abandon
+    the range (someone else owns it now; determinism makes any late
+    completion of ours a harmless crosschecked duplicate)."""
+
+
+class Worker:
+    """One fleet worker. ``run_once()`` is the scheduling quantum the
+    fabric drives: acquire one lease, sweep it, report it.
+
+    ``sweep_kwargs`` are the uniform per-lease sweep knobs
+    (chunk_steps, superstep_max, recycle/batch_worlds, ...);
+    ``checkpoint_dir`` enables per-lease checkpointing (preemption
+    survival + crash recovery); ``checkpoint_every_chunks`` its cadence.
+    """
+
+    def __init__(self, worker_id: str, engine, seeds, transport, clock,
+                 faults: Optional[np.ndarray] = None, mesh=None,
+                 retry: Optional[RetryPolicy] = None,
+                 chaos=None, emit=None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every_chunks: int = 4,
+                 sweep_kwargs: Optional[Dict[str, Any]] = None):
+        self.worker_id = worker_id
+        self.engine = engine
+        self.seeds = np.asarray(seeds, np.uint64)
+        self.faults = faults
+        self.mesh = mesh
+        self.transport = transport
+        self.clock = clock
+        self.retry = retry or RetryPolicy()
+        self.chaos = chaos
+        self._emit = emit
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_chunks = checkpoint_every_chunks
+        self.sweep_kwargs = dict(sweep_kwargs or {})
+        self.dead = False
+        self.died_at: float = 0.0
+        self.preempted = False
+        self._preempt_requested = False
+        self._lease: Optional[Dict[str, Any]] = None
+        self._delayed_progress: Optional[Dict[str, Any]] = None
+        self._hb_count = 0
+        self.stats = {"leases_run": 0, "completions": 0, "kills": 0,
+                      "preemptions": 0, "leases_lost": 0,
+                      "heartbeats_sent": 0, "heartbeats_dropped": 0,
+                      "heartbeats_delayed": 0, "rpc_retries": 0,
+                      "checkpoints_recovered": 0,
+                      "checkpoints_discarded": 0}
+
+    # -- preemption ------------------------------------------------------
+    def request_preemption(self) -> None:
+        """Ask the worker to stop at the next heartbeat, checkpoint, and
+        release its lease (the SIGTERM handler's body; also callable
+        directly, which is how the inline chaos harness models
+        preemption)."""
+        self._preempt_requested = True
+
+    def install_sigterm_handler(self) -> None:
+        """Route SIGTERM to :meth:`request_preemption` — for worker
+        processes under a preempting scheduler (k8s, borg, spot VMs).
+        Must run on the main thread of the worker process."""
+        import signal
+
+        signal.signal(signal.SIGTERM,
+                      lambda _sig, _frm: self.request_preemption())
+
+    def restart(self) -> None:
+        """Revive after a kill/preemption (the fabric's restart path).
+        All lease state was lost with the 'process'; the engine and its
+        jit caches survive because inline workers share the host
+        process — a real restart would recompile, changing nothing
+        about results."""
+        self.dead = False
+        self.preempted = False
+        self._preempt_requested = False
+        self._lease = None
+        self._delayed_progress = None
+
+    # -- telemetry -------------------------------------------------------
+    def emit(self, event: str, **fields) -> None:
+        if self._emit is None:
+            return
+        rec = {"schema": "madsim.fleet.telemetry/1", "event": event,
+               "t": self.clock.now(), "worker": self.worker_id}
+        rec.update(fields)
+        self._emit(rec)
+
+    # -- RPC helpers (all retried with deterministic backoff) ------------
+    def _call(self, method: str, **kw):
+        def on_retry(attempt, delay, exc):
+            self.stats["rpc_retries"] += 1
+            self.emit("rpc_retry", method=method, attempt=attempt,
+                      delay=round(float(delay), 3), error=str(exc))
+
+        return call_with_retry(
+            lambda: self.transport.call(method, self.worker_id, **kw),
+            self.retry, self.clock, tag=f"{self.worker_id}:{method}",
+            on_retry=on_retry)
+
+    # -- the scheduling quantum ------------------------------------------
+    def run_once(self) -> bool:
+        """Acquire + run + report ONE lease. Returns True if any work
+        happened (False: idle — nothing pending, or acquire failed and
+        will be retried next round)."""
+        if self.dead:
+            return False
+        try:
+            lease = self._call("acquire")
+        except RetryExhausted as exc:
+            self.emit("acquire_abandoned", error=str(exc))
+            return False
+        if lease is None:
+            return False
+        self.stats["leases_run"] += 1
+        self._lease = lease
+        try:
+            result = self._run_lease(lease)
+        except WorkerKilled:
+            self.dead = True
+            self.died_at = self.clock.now()
+            self.stats["kills"] += 1
+            self.emit("worker_killed", lease_id=lease["lease_id"],
+                      range_id=lease["range_id"])
+            self._maybe_tear_checkpoint(lease)
+            return True
+        except LeasePreempted:
+            ck = self._lease_checkpoint(lease)
+            ck = ck if ck and os.path.exists(ck) else None
+            try:
+                self._call("release", lease_id=lease["lease_id"],
+                           checkpoint=ck)
+            except RetryExhausted:
+                pass  # expiry will re-queue the range; ck rides the table
+            self.dead = True
+            self.preempted = True
+            self.died_at = self.clock.now()
+            self.stats["preemptions"] += 1
+            self.emit("worker_preempted", lease_id=lease["lease_id"],
+                      range_id=lease["range_id"], checkpoint=ck)
+            return True
+        except LeaseLost:
+            self.stats["leases_lost"] += 1
+            self.emit("lease_lost", lease_id=lease["lease_id"],
+                      range_id=lease["range_id"])
+            return True
+        finally:
+            self._lease = None
+        try:
+            self._call("complete", lease_id=lease["lease_id"],
+                       range_id=lease["range_id"], result=result)
+            self.stats["completions"] += 1
+        except RetryExhausted as exc:
+            # Abandon: the lease expires, the range re-issues, and the
+            # re-execution (or our own retry on a later lease of the
+            # same range) reproduces the identical result.
+            self.emit("complete_abandoned", lease_id=lease["lease_id"],
+                      range_id=lease["range_id"], error=str(exc))
+        return True
+
+    # -- lease execution -------------------------------------------------
+    def _lease_checkpoint(self, lease) -> Optional[str]:
+        if self.checkpoint_dir is None:
+            return None
+        return os.path.join(self.checkpoint_dir,
+                            f"range_{lease['range_id']:05d}.npz")
+
+    def _maybe_tear_checkpoint(self, lease) -> None:
+        """Chaos follow-up to a kill: tear the dead worker's lease
+        checkpoint, simulating a crash that corrupted the file (the
+        pre-fsync failure mode) so the next holder exercises the
+        corrupt-checkpoint recovery path."""
+        if self.chaos is None or \
+                not self.chaos.config.tear_checkpoint_on_kill:
+            return
+        ck = self._lease_checkpoint(lease)
+        if ck and os.path.exists(ck):
+            from .chaos import tear_file
+
+            tear_file(ck)
+            self.emit("checkpoint_torn", range_id=lease["range_id"],
+                      path=ck)
+
+    def _run_lease(self, lease) -> Any:
+        from ..parallel.sweep import sweep
+
+        lo, hi = lease["lo"], lease["hi"]
+        seeds = self.seeds[lo:hi]
+        faults = self.faults
+        if faults is not None and np.asarray(faults).ndim == 3:
+            faults = np.asarray(faults)[lo:hi]
+        kwargs = dict(self.sweep_kwargs)
+        ck = self._lease_checkpoint(lease)
+        if ck is not None:
+            # resume=True: if a previous holder (crashed or preempted)
+            # left a checkpoint at this range's path, continue from it
+            # bit-exactly; otherwise start fresh and write our own.
+            kwargs.update(checkpoint_path=lease.get("checkpoint") or ck,
+                          checkpoint_every_chunks=self.checkpoint_every_chunks,
+                          resume=True)
+            if lease.get("checkpoint") and os.path.exists(lease["checkpoint"]):
+                self.stats["checkpoints_recovered"] += 1
+                self.emit("lease_resumed", range_id=lease["range_id"],
+                          checkpoint=lease["checkpoint"])
+        self._hb_count = 0
+        run = lambda: sweep(  # noqa: E731
+            None, self.engine.cfg, seeds, faults=faults, engine=self.engine,
+            mesh=self.mesh, observe=self._heartbeat, **kwargs)
+        try:
+            return run()
+        except CheckpointError as exc:
+            # Torn/corrupt resume artifact: discard and re-run fresh —
+            # the loader's message names the path and this exact
+            # recovery option. Deterministic re-execution means the
+            # retry costs time, never correctness.
+            self.stats["checkpoints_discarded"] += 1
+            path = kwargs.get("checkpoint_path", ck)
+            self.emit("checkpoint_corrupt", range_id=lease["range_id"],
+                      path=path, error=str(exc).splitlines()[0])
+            if path and os.path.exists(path):
+                os.remove(path)
+            return run()
+
+    # -- the heartbeat boundary ------------------------------------------
+    def _heartbeat(self, record: Dict[str, Any]) -> None:
+        """sweep(observe=...) callback: one call per host scalar read.
+        This is the fabric's preemption point — chaos and SIGTERM land
+        here, between supersteps, where the sweep's exception path
+        flushes the checkpoint writer before unwinding."""
+        if record.get("event") == "summary":
+            return  # final sweep record, not a liveness beat
+        self._hb_count += 1
+        self.clock.advance(1)
+        action = (self.chaos.heartbeat_action(self.worker_id)
+                  if self.chaos is not None else "ok")
+        if action == KILL:
+            raise WorkerKilled(self.worker_id)
+        if action == PREEMPT or self._preempt_requested:
+            raise LeasePreempted(self.worker_id)
+        progress = {"seeds_done": record.get("seeds_done"),
+                    "chunks": record.get("chunks"),
+                    "n_active": record.get("n_active")}
+        if action == DROP:
+            self.stats["heartbeats_dropped"] += 1
+            self.emit("heartbeat_dropped", lease_id=self._lease["lease_id"])
+            return
+        if action == DELAY:
+            # Deferred, not lost: delivered before the NEXT beat — the
+            # lease sees a late extension instead of a gap.
+            self.stats["heartbeats_delayed"] += 1
+            self._delayed_progress = progress
+            return
+        if self._delayed_progress is not None:
+            self._send_heartbeat(self._delayed_progress)
+            self._delayed_progress = None
+        self._send_heartbeat(progress)
+
+    def _send_heartbeat(self, progress: Dict[str, Any]) -> None:
+        try:
+            resp = self._call("heartbeat",
+                              lease_id=self._lease["lease_id"],
+                              progress=progress)
+        except RetryExhausted:
+            # Transport down: keep sweeping — the lease may expire, in
+            # which case a later beat (or the completion) learns it.
+            return
+        self.stats["heartbeats_sent"] += 1
+        if not resp.get("ok"):
+            raise LeaseLost(self._lease["lease_id"])

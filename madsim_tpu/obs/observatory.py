@@ -41,6 +41,15 @@ from typing import Any, Callable, List, Optional, Tuple
 # *virtual* timelines, and host clocks must never leak into them.
 _SCHEMA = "madsim.sweep.telemetry/1"
 
+# The fleet fabric (madsim_tpu.fleet, docs/fleet.md) emits its protocol
+# events — lease_issued/expired/released, heartbeats, rpc_retry,
+# completions (with duplicate-crosscheck flags), worker
+# kill/restart/preemption — into the SAME observe sink as one-line
+# records under this schema, so one JSONL stream carries both the
+# sweep's progress and the fabric's lease churn and ``watch`` can
+# summarize either.
+_FLEET_SCHEMA = "madsim.fleet.telemetry/1"
+
 
 class JsonlEmitter:
     """Append one JSON line per telemetry record; flush per line so a
@@ -239,14 +248,54 @@ def render_progress(rec: dict) -> str:
     return "  ".join(bits)
 
 
+def render_fleet_event(rec: dict) -> str:
+    """One terminal line per fleet-fabric record (lease churn, worker
+    life cycle, retries) — keyed by worker so an operator can eyeball a
+    sick host in the stream."""
+    bits = [f"t={rec.get('t', 0):>6}", f"[{rec.get('worker', '?')}]",
+            rec.get("event", "?")]
+    for k in ("range_id", "lease_id", "generation", "reissued",
+              "duplicate", "crosschecked", "attempt", "exitcode"):
+        if k in rec and rec[k] not in (None, False):
+            bits.append(f"{k}={rec[k]}")
+    if rec.get("error"):
+        bits.append(f"error={rec['error']}")
+    return "  ".join(str(b) for b in bits)
+
+
+def render_fleet_summary(fleet: List[dict]) -> List[str]:
+    """Aggregate lines for the fleet records in a stream: event counts
+    plus the resilience headline (expiries, re-leases, crosschecked
+    duplicates)."""
+    if not fleet:
+        return []
+    counts: dict = {}
+    for r in fleet:
+        counts[r.get("event", "?")] = counts.get(r.get("event", "?"), 0) + 1
+    lines = ["fleet: " + ", ".join(f"{k}={v}"
+                                   for k, v in sorted(counts.items()))]
+    summary = next((r for r in fleet if r.get("event") == "fleet_summary"),
+                   None)
+    if summary is not None:
+        lines.append(
+            f"fleet summary: {summary.get('completions', '?')} ranges "
+            f"completed ({summary.get('leases_expired', 0)} leases "
+            f"expired, {summary.get('leases_reissued', 0)} re-issued, "
+            f"{summary.get('duplicates_crosschecked', 0)} duplicate "
+            "completions crosschecked bitwise)")
+    return lines
+
+
 def render_summary(records: List[dict]) -> str:
     """Human summary of a whole stream (the non-follow ``watch`` mode)."""
     if not records:
         return "watch: empty telemetry stream"
+    fleet = [r for r in records if r.get("schema") == _FLEET_SCHEMA]
+    records = [r for r in records if r.get("schema") != _FLEET_SCHEMA]
     progress = [r for r in records if r.get("event") != "summary"]
     summary = next((r for r in records if r.get("event") == "summary"),
                    None)
-    lines: List[str] = []
+    lines: List[str] = render_fleet_summary(fleet)
     if progress:
         lines.append(f"{len(progress)} progress records; last:")
         lines.append("  " + render_progress(progress[-1]))
@@ -275,7 +324,7 @@ def render_summary(records: List[dict]) -> str:
                 f"behaviors in {cov.get('n_buckets')} buckets "
                 f"({cov.get('worlds_folded')} worlds folded, novelty "
                 f"{cov.get('novelty_first')}->{cov.get('novelty_last')})")
-    else:
+    elif not fleet:
         lines.append("no summary record yet (sweep still running?)")
     return "\n".join(lines)
 
@@ -308,6 +357,8 @@ def watch(path: str, follow: bool = False, prom: Optional[str] = None,
             if rec.get("event") == "summary":
                 print(render_summary(records), file=out)
                 done = True
+            elif rec.get("schema") == _FLEET_SCHEMA:
+                print(render_fleet_event(rec), file=out)
             else:
                 print(render_progress(rec), file=out)
             if prom:

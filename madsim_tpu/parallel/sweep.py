@@ -293,6 +293,12 @@ class _Flight(NamedTuple):
     epoch: int            # occupancy epoch at dispatch time
     out_state: Any        # output state ref — kept ONLY for the writer
     cov_hist: Any = None  # per-chunk novelty-curve lane (coverage on)
+    # Ledger refs paired with out_state (writer + coverage only): the
+    # loop's cov_hits/cov_first globals advance with dispatch-ahead, so
+    # a checkpoint must snapshot the refs matching the state it writes —
+    # else a resume would restore a ledger one superstep AHEAD of the
+    # state and double-fold the replayed chunk's retirees.
+    out_cov: Any = None
 
 
 class _AsyncCheckpointer:
@@ -326,12 +332,17 @@ class _AsyncCheckpointer:
             target=self._run, name="madsim-checkpointer", daemon=True)
         self._thread.start()
 
-    def submit(self, state) -> None:
+    def submit(self, state, aux=None) -> None:
+        """Queue a snapshot. ``aux`` (recycled sweeps) is a dict of
+        sweep-level values saved beside the state: device arrays (the
+        slot→seed index, the coverage ledger) are pulled by the writer
+        thread, lists of host arrays (retired observation batches) are
+        concatenated there — the loop thread never blocks on either."""
         with self._cond:
             if self._error is not None:
                 err, self._error = self._error, None
                 raise err
-            self._pending = state
+            self._pending = (state, aux)
             self._cond.notify_all()
 
     def _run(self) -> None:
@@ -345,16 +356,24 @@ class _AsyncCheckpointer:
                     self._cond.wait()
                 if self._pending is None:
                     return
-                state, self._pending = self._pending, None
+                (state, aux), self._pending = self._pending, None
                 self._busy = True
             try:
                 # Pull to host FIRST and drop the device reference: holding
                 # the device pytree through the disk write would pin up to
                 # a full extra state of HBM while the sweep runs ahead.
-                host_state = _jax.device_get(state)
-                state = None
+                host_state, host_aux = _jax.device_get((state, aux))
+                state = aux = None
+                extra_arrays = None
+                if host_aux is not None:
+                    extra_arrays = {
+                        k: (np.concatenate([np.asarray(a) for a in v],
+                                           axis=0)
+                            if isinstance(v, list) else np.asarray(v))
+                        for k, v in host_aux.items()}
                 ckpt.save(self._eng, host_state, self._path,
-                          extra_meta=self._meta)
+                          extra_meta=self._meta,
+                          extra_arrays=extra_arrays)
                 exc = None
             except BaseException as e:  # noqa: BLE001 — surfaced at submit/flush
                 exc = e
@@ -595,9 +614,23 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     (tested). This is the shape for open-ended hunts —
     ``stop_on_first_bug`` sweeps over huge seed spaces on a bounded
     memory footprint. On an early stop, seeds never admitted report
-    zeroed observations (``bug=False``). Incompatible with
-    checkpointing: the seed cursor and retired observations are host
-    state a resume could not re-attribute (raises ``ValueError``).
+    zeroed observations (``bug=False``).
+
+    Recycled sweeps CAN checkpoint (the hunt config a long-running fleet
+    actually uses): the checkpoint carries, beside the world state, the
+    device-resident slot→seed index, the refill cursor, the retired
+    observations recorded so far, and (metrics on) the coverage ledger
+    — everything a resume needs to re-attribute recycled slots. Resume
+    requires the same ``batch_worlds`` (the padded-seed hash already
+    pins seeds/faults; the slot width is checked explicitly — a
+    shrunk-compacted state cannot resume into the full-shape contract
+    and raises ``ValueError``). While a writer is attached the dry-
+    cursor shrink fallback stays OFF (the tail runs at the full batch
+    width) so every snapshot written is resumable. A resumed recycled
+    sweep's per-seed observations, bug flags, and coverage ledger equal
+    an unbroken run's exactly; refill *timing* after the resume point
+    may differ by one chunk, so occupancy histories are telemetry, not
+    part of the contract.
 
     Occupancy telemetry rides the result: ``SweepResult.n_active_history``
     (per-chunk active counts, with ``n_active_chunks`` recording the
@@ -641,11 +674,6 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     seeds = np.asarray(seeds, np.uint64)
     n = seeds.shape[0]
 
-    if recycle and checkpoint_path:
-        raise ValueError(
-            "recycle=True cannot be combined with checkpointing: the seed "
-            "cursor and retired observations live on the host, so a "
-            "resumed sweep could not re-attribute recycled slots")
     if superstep_max < 1:
         raise ValueError("superstep_max must be >= 1")
 
@@ -731,11 +759,37 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     }
 
     resumed = False
+    resume_aux: Dict[str, np.ndarray] = {}
     if resume and checkpoint_path and os.path.exists(checkpoint_path):
-        state = ckpt.load(eng, checkpoint_path, expect_extra=seeds_meta)
-        if np.asarray(state.now).shape[0] != seeds_p.shape[0]:
+        state, resume_aux = ckpt.load(eng, checkpoint_path,
+                                      expect_extra=seeds_meta, with_aux=True)
+        w_file = int(np.asarray(state.now).shape[0])
+        if recycle:
+            # Recycled checkpoints carry the sweep-level aux (cursor,
+            # slot→seed index, retired observations) — without it the
+            # file is a plain full-batch snapshot this mode cannot
+            # re-attribute.
+            if "cursor" not in resume_aux:
+                raise ckpt.CheckpointError(
+                    f"checkpoint {checkpoint_path!r} was written by a "
+                    "non-recycled sweep (no slot->seed aux): resume it "
+                    "with recycle=False, or delete it to start the "
+                    "recycled hunt fresh")
+            if w_file != w0:
+                raise ValueError(
+                    f"cannot resume recycled sweep: checkpoint holds "
+                    f"{w_file} world slots but batch_worlds implies {w0} "
+                    "— a shrunk-compacted or differently-batched state "
+                    "cannot resume into the full-shape contract; rerun "
+                    "with the original batch_worlds")
+        elif "cursor" in resume_aux:
             raise ckpt.CheckpointError(
-                f"checkpoint holds {np.asarray(state.now).shape[0]} worlds, "
+                f"checkpoint {checkpoint_path!r} was written by a "
+                "recycled sweep: pass recycle=True (and the original "
+                "batch_worlds) to resume it")
+        elif w_file != seeds_p.shape[0]:
+            raise ckpt.CheckpointError(
+                f"checkpoint holds {w_file} worlds, "
                 f"sweep expects {seeds_p.shape[0]} (seeds + mesh padding)")
         state = shard_worlds(state, mesh)
         resumed = True
@@ -755,9 +809,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     c_max = -(-max_steps // chunk_steps)  # serial loop's chunk budget
     # Chunk counter at the last writer submission — a counter, not an
     # object ref: a pytree ref here would pin a full extra device state
-    # between checkpoints. Chunk-count identity implies state identity
-    # under a writer, because recycle is rejected and compact disabled
-    # whenever one is attached (no state change without a chunk).
+    # between checkpoints. Compact stays disabled under a writer; a
+    # recycled refill CAN change state without running a chunk, but every
+    # snapshot is self-consistent (state+idx+cursor+retired captured
+    # together), and a post-submit refill with no subsequent chunk simply
+    # re-derives deterministically on resume — so chunk-count identity
+    # remains a sound skip condition for the final submit.
     submitted_chunks = -1
     w_cur = w0                         # current batch width (slot count)
     cursor = w0                        # next seed id the stream admits
@@ -769,6 +826,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     reordered = False                  # batch rows still == seed order?
     retired: Dict[str, list] = {}      # field → retired observation batches
     retired_rows: List[np.ndarray] = []
+    if resumed and recycle:
+        # Rehydrate the sweep-level bookkeeping the checkpoint carried:
+        # the slot→seed index (device-resident again), the refill
+        # cursor, and the observations of every world retired before the
+        # snapshot. With these restored, the continuation re-attributes
+        # recycled slots exactly as the unbroken run would have.
+        cursor = int(np.asarray(resume_aux["cursor"]))
+        idx = shard_worlds(
+            jnp.asarray(np.asarray(resume_aux["idx"], np.int32)), mesh)
+        reordered = True
+        if "ret_rows" in resume_aux:
+            retired_rows.append(np.asarray(resume_aux["ret_rows"]))
+            for key in resume_aux:
+                if key.startswith("ret_") and key != "ret_rows":
+                    retired[key[4:]] = [np.asarray(resume_aux[key])]
     n_active_hist: List[int] = []
     n_active_chunk: List[int] = []     # chunk index each entry measured at
     issued_slot_steps = 0              # sum over chunks of width*chunk_steps
@@ -792,7 +864,17 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         cov_hits, cov_first = jax.device_put(
             ledger_zeros(cov_k), NamedSharding(mesh, scalar_spec()))
         n_real_dev = jnp.int32(n)
-        if resumed:
+        if resumed and "cov_hits" in resume_aux:
+            # Recycled checkpoints persist the ledger itself (retired-
+            # and-refilled slots no longer carry their histograms, so a
+            # pre-pass could not rebuild it): restore and continue.
+            # Folds trigger on active FALLING within a chunk, so worlds
+            # already inactive in the snapshot never re-fold.
+            cov_hits, cov_first = jax.device_put(
+                (jnp.asarray(np.asarray(resume_aux["cov_hits"], np.int32)),
+                 jnp.asarray(np.asarray(resume_aux["cov_first"], np.int32))),
+                NamedSharding(mesh, scalar_spec()))
+        elif resumed:
             # Resume pre-pass: worlds that retired before the checkpoint
             # carry frozen histograms but will never transition
             # active→inactive in THIS call — fold them up front. The
@@ -911,6 +993,25 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         obs_t, idx_t = _observer(eng)(frozen, fidx)
         return obs_t, idx_t, tail_len
 
+    def ckpt_aux(cov_pair):
+        """Sweep-level aux for a recycled checkpoint, captured at submit
+        time — the one point where host cursor/idx/retired are
+        consistent with the submitted state (pending retires drained;
+        pipelined submits additionally gated on epoch match). Device
+        values (idx, ledger) ride as refs the writer thread pulls;
+        retired observations as lists it concatenates — the loop thread
+        never blocks here."""
+        if not recycle:
+            return None
+        aux: Dict[str, Any] = {"cursor": np.int64(cursor), "idx": idx}
+        if cov_pair is not None:
+            aux["cov_hits"], aux["cov_first"] = cov_pair
+        if retired_rows:
+            aux["ret_rows"] = list(retired_rows)
+            for k, v in retired.items():
+                aux[f"ret_{k}"] = list(v)
+        return aux
+
     try:
         if pipeline:
             # -- pipelined, superstepped orchestration ---------------------
@@ -926,10 +1027,14 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 """The on-device early-exit occupancy for the NEXT
                 dispatch: the serial loop's trigger boundary (half the
                 batch) whenever a refill or shrink could actually fire,
-                else 0 (run until all retired)."""
+                else 0 (run until all retired). Under a checkpoint
+                writer the dry-cursor shrink fallback is disabled (a
+                shrunken snapshot could not resume), so the tail runs
+                to all-retired at full width."""
                 if recycle and cursor < n_ids:
                     return w_cur // 2
-                if ((compact or recycle) and w_cur % 2 == 0
+                if ((compact or (recycle and writer is None))
+                        and w_cur % 2 == 0
                         and (w_cur // 2) % n_dev == 0):
                     return w_cur // 2
                 return 0
@@ -981,7 +1086,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 perf["dispatches"] += 1
                 inflight = _Flight(
                     any_bug, n_active, k_done, hist, k, w_cur, epoch,
-                    state if writer is not None else None, cov_h)
+                    state if writer is not None else None, cov_h,
+                    ((cov_hits, cov_first)
+                     if writer is not None and cov_on else None))
 
             # max_steps <= 0 means a zero-chunk budget: the serial loop
             # never enters its body, so the pipelined loop must not
@@ -1044,11 +1151,16 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     else:
                         k_cur = max(k_done, 1)
                 if writer is not None and checkpoint_every_chunks and \
+                        prev.epoch == epoch and \
                         chunks // checkpoint_every_chunks > ckpt_mark:
                     # Async: the pull + write overlap later supersteps'
                     # device work; the submitted state is a COMPLETED
                     # superstep output (donation is off with a writer).
-                    writer.submit(prev.out_state)
+                    # Epoch-gated: a stale pass-through superstep's state
+                    # predates the refill the host idx/cursor already
+                    # reflect — submitting it would tear the snapshot
+                    # (the current epoch's next superstep submits soon).
+                    writer.submit(prev.out_state, ckpt_aux(prev.out_cov))
                     submitted_chunks = chunks
                     ckpt_mark = chunks // checkpoint_every_chunks
                 if prev.epoch == epoch and not stop:
@@ -1063,7 +1175,10 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                         epoch_fresh = True
                     else:
                         new_w = _compact_bucket(n_act, w_cur, n_dev)
-                        if (compact or (recycle and not more_seeds)) \
+                        # Dry-cursor shrink only without a writer: every
+                        # snapshot written must stay full-shape-resumable.
+                        if (compact or (recycle and not more_seeds
+                                        and writer is None)) \
                                 and new_w < w_cur:
                             pending_retires.append(do_shrink(new_w))
                             epoch += 1
@@ -1101,7 +1216,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                         chunks % checkpoint_every_chunks == 0:
                     # Async: the pull + write overlap the next chunk's
                     # device work; the loop never blocks on the filesystem.
-                    writer.submit(state)
+                    writer.submit(state, ckpt_aux(
+                        (cov_hits, cov_first) if cov_on else None))
                     submitted_chunks = chunks
                 t0 = _clk()
                 if cov_on:
@@ -1132,7 +1248,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     fetch_retire(handles)
                     continue
                 new_w = _compact_bucket(n_act, w_cur, n_dev)
-                if (compact or (recycle and not more_seeds)) \
+                if (compact or (recycle and not more_seeds
+                                and writer is None)) \
                         and new_w < w_cur:
                     handles = do_shrink(new_w)
                     perf["host_decision_s"] += _clk() - t0
@@ -1140,7 +1257,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 else:
                     perf["host_decision_s"] += _clk() - t0
         if writer is not None and submitted_chunks != chunks:
-            writer.submit(state)  # the final state is always durable
+            # The final state is always durable.
+            writer.submit(state, ckpt_aux(
+                (cov_hits, cov_first) if cov_on else None))
         if writer is not None:
             writer.flush_and_close()
             writer = None

@@ -94,6 +94,121 @@ def test_sweep_resume_rejects_wrong_world_count(tmp_path):
               max_steps=64, checkpoint_path=path, resume=True)
 
 
+@pytest.fixture(scope="module")
+def heng():
+    """One shared engine for the hardening tests below: they exercise
+    file-level behavior (fsync ordering, torn files, aux arrays), so a
+    single compiled engine + one batch shape keeps them cheap."""
+    return DeviceEngine(RaftActor(RCFG), ECFG)
+
+
+def test_crash_between_write_and_rename_keeps_previous(tmp_path,
+                                                       monkeypatch, heng):
+    """A writer dying between the tmp write and the rename must leave
+    the PREVIOUS checkpoint intact and loadable — the atomic-replace
+    contract under the exact crash the fsync+rename dance exists for."""
+    from madsim_tpu.engine import checkpoint as ckpt_mod
+
+    path = tmp_path / "ckpt.npz"
+    eng = heng
+    half = eng.run_steps(eng.init(np.arange(8)), 200)
+    save_checkpoint(eng, half, path)
+
+    # A different state for the crashing re-save. Built from a fresh
+    # init, NOT by stepping ``half``: run_steps donates its input, and
+    # on the CPU backend host views of donated buffers can alias the
+    # memory XLA then overwrites — ``half`` must stay alive untouched
+    # for the comparison below.
+    later = eng.run_steps(eng.init(np.arange(8)), 400)
+
+    def dying_replace(src, dst):
+        raise OSError("simulated crash between write and rename")
+
+    monkeypatch.setattr(ckpt_mod.os, "replace", dying_replace)
+    with pytest.raises(OSError, match="simulated crash"):
+        save_checkpoint(eng, later, path)
+    monkeypatch.undo()
+
+    # The published path still holds the FIRST snapshot, bit-intact, and
+    # resume proceeds from it to the same place an unbroken run reaches.
+    recovered = load_checkpoint(eng, path)
+    assert _leaves_equal(half, recovered), \
+        "a crashed re-save must not touch the previous checkpoint"
+    assert _leaves_equal(later, eng.run_steps(recovered, 200))
+
+
+def test_save_fsyncs_before_rename(tmp_path, monkeypatch, heng):
+    """Durability ordering: the tmp file's bytes must be fsync'd BEFORE
+    os.replace publishes the name (without it, a machine crash can
+    publish a name pointing at unflushed, torn bytes)."""
+    from madsim_tpu.engine import checkpoint as ckpt_mod
+
+    order = []
+    real_fsync, real_replace = ckpt_mod.os.fsync, ckpt_mod.os.replace
+    monkeypatch.setattr(ckpt_mod.os, "fsync",
+                        lambda fd: (order.append("fsync"), real_fsync(fd)))
+    monkeypatch.setattr(
+        ckpt_mod.os, "replace",
+        lambda a, b: (order.append("replace"), real_replace(a, b)))
+    save_checkpoint(heng, heng.init(np.arange(8)), tmp_path / "c.npz")
+    assert "fsync" in order and "replace" in order
+    assert order.index("fsync") < order.index("replace")
+
+
+def test_corrupt_checkpoint_reports_path_and_recovery(tmp_path, heng):
+    """Truncated and garbage files raise CheckpointError naming the file
+    and the recovery options — never a bare zipfile/numpy internal."""
+    path = tmp_path / "ckpt.npz"
+    eng = heng
+    save_checkpoint(eng, eng.init(np.arange(8)), path)
+    good = path.read_bytes()
+
+    # Truncation (torn write) and garbage (disk corruption).
+    for bad in (good[:137], b"not an npz at all"):
+        path.write_bytes(bad)
+        with pytest.raises(CheckpointError) as exc_info:
+            load_checkpoint(eng, path)
+        msg = str(exc_info.value)
+        assert str(path) in msg, "must name the corrupt file"
+        assert "recovery options" in msg
+        assert "zipfile" not in msg.lower().replace("badzipfile", "")
+
+
+def test_sweep_resume_on_corrupt_checkpoint_reports(tmp_path, heng):
+    """resume=True over a corrupt file surfaces the same actionable
+    CheckpointError (path + recovery options) through the sweep."""
+    from madsim_tpu.parallel.sweep import sweep
+
+    path = tmp_path / "sweep.npz"
+    eng = heng
+    sweep(None, ECFG, np.arange(8), engine=eng, chunk_steps=64,
+          max_steps=64, checkpoint_path=str(path))
+    path.write_bytes(path.read_bytes()[:100])
+    with pytest.raises(CheckpointError, match="recovery options"):
+        sweep(None, ECFG, np.arange(8), engine=eng, chunk_steps=64,
+              max_steps=64, checkpoint_path=str(path), resume=True)
+
+
+def test_checkpoint_extra_arrays_round_trip(tmp_path, heng):
+    """save(extra_arrays=...) / load(with_aux=True): named host arrays
+    ride beside the state leaves (the recycled sweep's cursor/index/
+    retired-observation carrier); plain loads ignore them."""
+    path = tmp_path / "aux.npz"
+    eng = heng
+    state = eng.init(np.arange(8))
+    aux_in = {"cursor": np.int64(17),
+              "idx": np.arange(8, dtype=np.int32),
+              "ret_steps": np.asarray([5, 9], np.int32)}
+    save_checkpoint(eng, state, path, extra_arrays=aux_in)
+    loaded, aux = load_checkpoint(eng, path, with_aux=True)
+    assert _leaves_equal(state, loaded)
+    assert set(aux) == set(aux_in)
+    for k in aux_in:
+        np.testing.assert_array_equal(aux[k], aux_in[k])
+    # Backward-shaped call: aux invisible unless asked for.
+    assert _leaves_equal(state, load_checkpoint(eng, path))
+
+
 def test_sweep_resumes_from_checkpoint(tmp_path):
     from madsim_tpu.parallel.sweep import sweep
 

@@ -1,0 +1,409 @@
+"""Fleet fabric (madsim_tpu/fleet, docs/fleet.md): leased seed ranges,
+crash-identical recovery, duplicate-completion crosschecks.
+
+The headline contract (ISSUE 7 acceptance): a 2+-worker fleet sweep
+with injected worker kills, lease expiries, duplicated completions,
+SIGTERM preemptions, and torn checkpoints returns a SweepResult whose
+CONTRACT fields — seed ids, bug flags, per-seed observations (incl. the
+``m_*`` metrics frames), coverage ledger hits/first-seen — are bitwise
+identical to BOTH a crash-free fleet run and a single-host ``sweep()``
+over the same seeds, for raft/pb/tpc. Fabric telemetry (histories,
+loop_stats) legitimately differs and is excluded.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import (
+    DeviceEngine,
+    EngineConfig,
+    PBActor,
+    PBDeviceConfig,
+    RaftActor,
+    RaftDeviceConfig,
+    TPCActor,
+    TPCDeviceConfig,
+)
+from madsim_tpu.fleet import (
+    ChaosConfig,
+    Coordinator,
+    FleetIntegrityError,
+    LeaseTable,
+    RetryPolicy,
+    SeedRange,
+    VirtualClock,
+    fleet_sweep,
+    split_ranges,
+)
+from madsim_tpu.parallel.sweep import sweep
+
+RCFG = RaftDeviceConfig(n=3, buggy_double_vote=True)
+ECFG = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                    t_limit_us=1_500_000, stop_on_bug=True)
+SWEEP_KW = dict(chunk_steps=64, max_steps=20_000)
+
+# The full failure mix in one config: explicit kill, preemption, lease
+# expiry via the kill, duplicated completions, transient RPC failures.
+CHAOS = ChaosConfig(seed=11, kill_at=(("w0", 2),),
+                    preempt_at=(("w1", 5),),
+                    duplicate_all_completions=True,
+                    drop_rpc_rate=0.25, drop_heartbeat_rate=0.1,
+                    restart_after=2)
+
+
+@pytest.fixture(scope="module")
+def raft_eng():
+    # metrics=True so the acceptance check covers the coverage ledger
+    # and the per-seed m_* metrics frames too.
+    import dataclasses
+
+    return DeviceEngine(RaftActor(RCFG),
+                        dataclasses.replace(ECFG, metrics=True))
+
+
+RAFT_SEEDS = np.arange(64)
+
+
+@pytest.fixture(scope="module")
+def raft_single(raft_eng):
+    """Single-host reference over RAFT_SEEDS — computed once; every
+    fleet leg in this module compares against the same run."""
+    return sweep(None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng,
+                 **SWEEP_KW)
+
+
+def assert_contract_equal(a, b):
+    """The crash-identical contract: ids, bug flags, observations
+    (metrics frames included), coverage ledger."""
+    np.testing.assert_array_equal(a.seeds, b.seeds)
+    np.testing.assert_array_equal(a.bug, b.bug)
+    assert set(a.observations) == set(b.observations)
+    for k in a.observations:
+        np.testing.assert_array_equal(a.observations[k], b.observations[k],
+                                      err_msg=k)
+    assert a.failing_seeds == b.failing_seeds
+    assert (a.coverage is None) == (b.coverage is None)
+    if a.coverage is not None:
+        np.testing.assert_array_equal(a.coverage.hits, b.coverage.hits)
+        np.testing.assert_array_equal(a.coverage.first_seen_seed,
+                                      b.coverage.first_seen_seed)
+        assert a.coverage.distinct_behaviors == b.coverage.distinct_behaviors
+
+
+# ---------------------------------------------------------------------------
+# Protocol units (no device work)
+# ---------------------------------------------------------------------------
+
+def test_split_ranges_tiles_and_is_deterministic():
+    rs = split_ranges(100, 32)
+    assert [r.range_id for r in rs] == [0, 1, 2, 3]
+    assert rs[0].lo == 0 and rs[-1].hi == 100
+    assert sum(r.n_seeds for r in rs) == 100
+    assert split_ranges(100, 32) == rs  # pure function of the inputs
+    with pytest.raises(ValueError):
+        split_ranges(10, 0)
+
+
+def test_lease_table_expiry_reissue_and_dedup():
+    table = LeaseTable(split_ranges(8, 4), ttl=5)
+    a = table.issue("w0", now=0)
+    b = table.issue("w1", now=0)
+    assert a.range.range_id == 0 and b.range.range_id == 1
+    assert table.issue("w0", now=0) is None  # nothing pending
+    # Heartbeat extends; a stale lease id is refused.
+    assert table.heartbeat(a.lease_id, "w0", now=3)
+    assert not table.heartbeat(999, "w0", now=3)
+    assert not table.heartbeat(a.lease_id, "w1", now=3)  # wrong holder
+    # w1 never heartbeats: its lease expires and the range re-queues.
+    reaped = table.expire(now=6)
+    assert [l.range.range_id for l in reaped] == [1]
+    c = table.issue("w0", now=6)
+    assert c.range.range_id == 1 and c.generation == 1
+    # The ORIGINAL holder completes anyway: accepted (first), and the
+    # re-issued holder's later completion resolves as a duplicate.
+    first, _ = table.complete(1, b.lease_id)
+    assert first
+    dup, _ = table.complete(1, c.lease_id)
+    assert not dup
+    # Voluntary release re-queues immediately with the checkpoint.
+    assert table.release(a.lease_id, "w0", checkpoint="/tmp/ck.npz")
+    d = table.issue("w1", now=7)
+    assert d.range.range_id == 0 and d.checkpoint == "/tmp/ck.npz"
+
+
+def test_retry_backoff_is_deterministic_and_jittered():
+    p = RetryPolicy(seed=3, base_delay=1.0, jitter=0.5)
+    q = RetryPolicy(seed=3, base_delay=1.0, jitter=0.5)
+    d = [p.delay("w0:acquire", a) for a in range(4)]
+    assert d == [q.delay("w0:acquire", a) for a in range(4)]  # replayable
+    assert d[1] > d[0] and d[2] > d[1]  # exponential growth survives jitter
+    assert d != [p.delay("w1:acquire", a) for a in range(4)]  # desynced
+
+
+def test_call_with_retry_exhaustion_and_success():
+    from madsim_tpu.fleet import RetryExhausted, RpcError, call_with_retry
+
+    clock = VirtualClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RpcError("boom")
+        return "ok"
+
+    assert call_with_retry(flaky, RetryPolicy(max_attempts=5), clock,
+                           "t") == "ok"
+    assert calls["n"] == 3
+    assert clock.now() > 0  # backoff advanced the fabric clock
+    with pytest.raises(RetryExhausted):
+        call_with_retry(lambda: (_ for _ in ()).throw(RpcError("x")),
+                        RetryPolicy(max_attempts=2), clock, "t2")
+
+
+def _fake_result(seeds, bug_at=()):
+    obs = {"bug": np.isin(np.arange(len(seeds)), bug_at),
+           "steps": np.ones(len(seeds), np.int32)}
+    from madsim_tpu.parallel.sweep import SweepResult
+
+    return SweepResult(seeds=np.asarray(seeds, np.uint64), bug=obs["bug"],
+                       observations=obs, steps_run=1, n_devices=1)
+
+
+def test_duplicate_mismatch_raises_integrity_error():
+    """A double-reported range whose two executions disagree bitwise is
+    the one unrecoverable fleet fault: nondeterminism. It must raise,
+    never silently pick a winner."""
+    clock = VirtualClock()
+    coord = Coordinator(np.arange(8), range_size=8, lease_ttl=10,
+                        clock=clock)
+    lease = coord.rpc_acquire(worker_id="w0")
+    ok = _fake_result(np.arange(8))
+    coord.rpc_complete(worker_id="w0", lease_id=lease["lease_id"],
+                       range_id=0, result=ok)
+    # Identical duplicate: crosschecked and absorbed.
+    out = coord.rpc_complete(worker_id="w1", lease_id=lease["lease_id"],
+                             range_id=0, result=_fake_result(np.arange(8)))
+    assert out["duplicate"]
+    assert coord.stats["duplicates_crosschecked"] == 1
+    with pytest.raises(FleetIntegrityError, match="bitwise"):
+        coord.rpc_complete(worker_id="w1", lease_id=lease["lease_id"],
+                           range_id=0,
+                           result=_fake_result(np.arange(8), bug_at=(3,)))
+
+
+def test_merge_requires_tiling_ranges():
+    from madsim_tpu.fleet import merge_range_results
+
+    with pytest.raises(ValueError, match="not completed"):
+        merge_range_results(np.arange(8), [SeedRange(0, 0, 8)], {}, 1)
+
+
+# ---------------------------------------------------------------------------
+# The chaos matrix (the tier-1 acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_chaos_matrix_raft(raft_eng, raft_single, tmp_path):
+    """Raft (with coverage + metrics): single-host == clean fleet ==
+    chaotic fleet, with every failure mode injected at once — and the
+    chaos demonstrably happened (kills, expiries, duplicates, retries,
+    preemption all nonzero)."""
+    single = raft_single
+    clean = fleet_sweep(None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng,
+                        n_workers=2, range_size=16, **SWEEP_KW)
+    chaotic = fleet_sweep(None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng,
+                          n_workers=2, range_size=16, chaos=CHAOS,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          **SWEEP_KW)
+    assert_contract_equal(single, clean)
+    assert_contract_equal(single, chaotic)
+    assert single.failing_seeds, "matrix must exercise failing seeds"
+    fleet_stats = chaotic.loop_stats["fleet"]
+    assert fleet_stats["kills"] >= 1
+    assert fleet_stats["preemptions"] >= 1
+    assert fleet_stats["leases_expired"] >= 1
+    assert fleet_stats["leases_reissued"] >= 1
+    assert fleet_stats["duplicate_completions"] >= 1
+    assert fleet_stats["duplicates_crosschecked"] == \
+        fleet_stats["duplicate_completions"]
+    assert fleet_stats["rpc_retries"] >= 1
+
+
+def test_chaos_matrix_pb():
+    eng = DeviceEngine(
+        PBActor(PBDeviceConfig(n=3, n_writes=4)),
+        EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.05))
+    seeds = np.arange(32)
+    single = sweep(None, eng.cfg, seeds, engine=eng, **SWEEP_KW)
+    clean = fleet_sweep(None, eng.cfg, seeds, engine=eng, n_workers=2,
+                        range_size=8, **SWEEP_KW)
+    chaotic = fleet_sweep(None, eng.cfg, seeds, engine=eng, n_workers=2,
+                          range_size=8, chaos=CHAOS, **SWEEP_KW)
+    assert_contract_equal(single, clean)
+    assert_contract_equal(single, chaotic)
+    assert chaotic.loop_stats["fleet"]["kills"] >= 1
+
+
+def test_chaos_matrix_tpc():
+    eng = DeviceEngine(
+        TPCActor(TPCDeviceConfig(n=4, n_txns=4, buggy_presumed_commit=True)),
+        EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.1))
+    seeds = np.arange(32)
+    single = sweep(None, eng.cfg, seeds, engine=eng, **SWEEP_KW)
+    clean = fleet_sweep(None, eng.cfg, seeds, engine=eng, n_workers=2,
+                        range_size=8, **SWEEP_KW)
+    chaotic = fleet_sweep(None, eng.cfg, seeds, engine=eng, n_workers=2,
+                          range_size=8, chaos=CHAOS, **SWEEP_KW)
+    assert_contract_equal(single, clean)
+    assert_contract_equal(single, chaotic)
+    assert single.failing_seeds  # buggy config: bug attribution survives
+
+
+def test_fleet_composes_with_multihost_mesh(raft_eng, raft_single):
+    """The DCN×ICI leg: every worker sweeps its leases on the 2-D
+    multihost mesh (psum over dcn+worlds inside each lease) and the
+    merged result still equals the single-host reference."""
+    from madsim_tpu.parallel.mesh import multihost_mesh
+
+    single = raft_single
+    mesh2d = multihost_mesh(n_hosts=2)
+    assert mesh2d.devices.shape == (2, 4)
+    fleet = fleet_sweep(None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng,
+                        mesh=mesh2d, n_workers=2, range_size=16,
+                        chaos=ChaosConfig(seed=5, kill_at=(("w1", 3),),
+                                          restart_after=1),
+                        **SWEEP_KW)
+    assert_contract_equal(single, fleet)
+    assert fleet.loop_stats["fleet"]["kills"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Preemption + checkpoint recovery
+# ---------------------------------------------------------------------------
+
+def test_preemption_releases_lease_and_resumes_checkpoint(raft_eng,
+                                                          raft_single,
+                                                          tmp_path):
+    """SIGTERM path: the preempted worker's lease re-queues immediately
+    with its checkpoint attached; the next holder RESUMES (bit-exactly)
+    instead of replaying, and the result is still contract-identical."""
+    single = raft_single
+    recs = []
+    fleet = fleet_sweep(
+        None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng, n_workers=2,
+        range_size=32, observe=recs.append,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every_chunks=1,
+        chaos=ChaosConfig(seed=2, preempt_at=(("w0", 2),),
+                          restart_after=2),
+        **SWEEP_KW)
+    assert_contract_equal(single, fleet)
+    stats = fleet.loop_stats["fleet"]
+    assert stats["preemptions"] >= 1
+    assert stats["checkpoints_recovered"] >= 1
+    events = [r["event"] for r in recs]
+    assert "worker_preempted" in events
+    assert "lease_released" in events
+    assert "lease_resumed" in events
+    rel = next(r for r in recs if r["event"] == "worker_preempted")
+    assert rel["checkpoint"], "preemption must release WITH a checkpoint"
+
+
+def test_torn_checkpoint_recovers_by_rerun(raft_eng, raft_single,
+                                           tmp_path):
+    """Crash-corrupted checkpoint: the killed worker's file is torn; the
+    next holder's resume hits the hardened loader's CheckpointError,
+    discards the file, re-runs fresh — same bitwise result."""
+    single = raft_single
+    recs = []
+    fleet = fleet_sweep(
+        None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng, n_workers=2,
+        range_size=32, observe=recs.append,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every_chunks=1,
+        chaos=ChaosConfig(seed=4, kill_at=(("w0", 3),),
+                          tear_checkpoint_on_kill=True, restart_after=2),
+        **SWEEP_KW)
+    assert_contract_equal(single, fleet)
+    stats = fleet.loop_stats["fleet"]
+    assert stats["kills"] >= 1
+    assert stats["checkpoints_discarded"] >= 1
+    events = [r["event"] for r in recs]
+    assert "checkpoint_torn" in events
+    assert "checkpoint_corrupt" in events
+
+
+# ---------------------------------------------------------------------------
+# Telemetry stream
+# ---------------------------------------------------------------------------
+
+def test_fleet_telemetry_jsonl_and_watch(raft_eng, tmp_path):
+    """The observatory stream gains per-worker lease/retry/re-lease
+    records: JSONL sink, schema'd records, and `obs watch` renders a
+    fleet summary."""
+    import io
+
+    from madsim_tpu.obs.observatory import watch
+
+    seeds = np.arange(32)
+    path = str(tmp_path / "fleet.jsonl")
+    fleet_sweep(None, raft_eng.cfg, seeds, engine=raft_eng, n_workers=2,
+                range_size=8, observe=path,
+                chaos=ChaosConfig(seed=9, kill_at=(("w1", 2),),
+                                  drop_rpc_rate=0.3, restart_after=1),
+                **SWEEP_KW)
+    with open(path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    assert recs, "stream must not be empty"
+    assert all(r["schema"] == "madsim.fleet.telemetry/1" for r in recs)
+    events = {r["event"] for r in recs}
+    assert {"lease_issued", "heartbeat", "completion",
+            "fleet_summary"} <= events
+    assert "worker_killed" in events and "lease_expired" in events
+    assert "rpc_retry" in events
+    # Re-issued lease records carry the generation + reissued flag.
+    reissues = [r for r in recs
+                if r["event"] == "lease_issued" and r.get("reissued")]
+    assert reissues and all(r["generation"] >= 1 for r in reissues)
+    out = io.StringIO()
+    assert watch(path, out=out) == 0
+    text = out.getvalue()
+    assert "fleet:" in text and "crosschecked" in text
+
+
+def test_fleet_stalls_loudly_when_unrecoverable(raft_eng):
+    """All workers dead + restarts disabled must raise FleetStalledError
+    (with diagnostics), never hang."""
+    from madsim_tpu.fleet import FleetStalledError
+
+    with pytest.raises(FleetStalledError, match="dead"):
+        fleet_sweep(None, raft_eng.cfg, np.arange(16), engine=raft_eng,
+                    n_workers=1, range_size=8,
+                    chaos=ChaosConfig(seed=1, kill_at=(("w0", 1),),
+                                      restart_after=-1),
+                    **SWEEP_KW)
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess leg (real processes + signals) — excluded from tier-1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_process_fleet_smoke(tmp_path):
+    """Two real worker processes over pipes, one SIGKILLed mid-lease:
+    the merged result still equals the single-host reference (recovery
+    via lease TTL + respawn). Marked slow: each spawned worker pays a
+    fresh JAX import + compile."""
+    eng = DeviceEngine(RaftActor(RCFG), ECFG)
+    seeds = np.arange(24)
+    single = sweep(None, ECFG, seeds, engine=eng, **SWEEP_KW)
+    fleet = fleet_sweep(RaftActor(RCFG), ECFG, seeds, n_workers=2,
+                        range_size=8, spawn="process", lease_ttl=5.0,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        kill_after_heartbeats={"w0": 1},
+                        serve_timeout_s=300.0, **SWEEP_KW)
+    np.testing.assert_array_equal(single.bug, fleet.bug)
+    for k in single.observations:
+        np.testing.assert_array_equal(single.observations[k],
+                                      fleet.observations[k], err_msg=k)

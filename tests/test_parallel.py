@@ -253,11 +253,50 @@ def test_recycled_utilization_beats_shrink_only():
     assert (recycled.n_active_history[:-1] > 0).all()
 
 
-def test_recycle_rejects_checkpointing(tmp_path):
-    eng = DeviceEngine(RaftActor(RCFG), ECFG)
-    with pytest.raises(ValueError, match="recycle"):
-        sweep(None, ECFG, np.arange(16), engine=eng, recycle=True,
-              batch_worlds=8, checkpoint_path=str(tmp_path / "x.npz"))
+def test_recycled_sweep_checkpoints_and_resumes(tmp_path):
+    """PR 2's recycle/checkpoint restriction is lifted: the checkpoint
+    persists the slot→seed index, refill cursor, and retired
+    observations, so the hunt config (recycle=True) resumes — per-seed
+    observations and bug flags bitwise equal to an unbroken recycled
+    run's. Only genuinely unresumable width mismatches (a shrunk or
+    re-batched state) still raise."""
+    path = str(tmp_path / "hunt.npz")
+    # Shorter virtual horizon than the module ECFG: this test runs five
+    # sweeps and only needs refills + retirement, not long tails.
+    ecfg = EngineConfig(n_nodes=3, outbox_cap=4, t_limit_us=1_500_000)
+    eng = DeviceEngine(RaftActor(RCFG), ecfg)
+    seeds = np.arange(48)
+    kw = dict(chunk_steps=64, recycle=True, batch_worlds=16)
+
+    unbroken = sweep(None, ecfg, seeds, engine=eng, max_steps=100_000, **kw)
+    # Interrupted mid-stream (the cursor has refilled at least once by
+    # chunk 6 at this occupancy), checkpointing as it goes.
+    partial = sweep(None, ecfg, seeds, engine=eng, max_steps=64 * 6,
+                    checkpoint_path=path, checkpoint_every_chunks=1, **kw)
+    assert partial.steps_run < unbroken.steps_run
+    # "Process restart": fresh engine, resume, run to completion.
+    eng2 = DeviceEngine(RaftActor(RCFG), ecfg)
+    resumed = sweep(None, ecfg, seeds, engine=eng2, max_steps=100_000,
+                    checkpoint_path=path, resume=True, **kw)
+    for key in unbroken.observations:
+        np.testing.assert_array_equal(unbroken.observations[key],
+                                      resumed.observations[key],
+                                      err_msg=key)
+    np.testing.assert_array_equal(unbroken.bug, resumed.bug)
+    assert unbroken.failing_seeds == resumed.failing_seeds
+
+    # Resuming under a different batch width is the unresumable case
+    # the old blanket ValueError shrank to: full-shape contract only.
+    with pytest.raises(ValueError, match="full-shape"):
+        sweep(None, ecfg, seeds, engine=eng2, max_steps=100_000,
+              chunk_steps=64, recycle=True, batch_worlds=32,
+              checkpoint_path=path, resume=True)
+    # A recycled checkpoint cannot silently resume as a plain sweep.
+    from madsim_tpu.engine import CheckpointError
+
+    with pytest.raises(CheckpointError, match="recycled"):
+        sweep(None, ecfg, seeds, engine=eng2, max_steps=100_000,
+              chunk_steps=64, checkpoint_path=path, resume=True)
 
 
 def test_recycled_sweep_zero_recompiles_after_warmup():

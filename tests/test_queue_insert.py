@@ -288,11 +288,39 @@ def _bug_config_engine():
     return DeviceEngine(RaftActor(rcfg), cfg)
 
 
+def _compile_fresh(lowered):
+    """Compile BYPASSING the persistent compilation cache (conftest.py):
+    an executable deserialized from the cache loses parts of its
+    cost/memory statistics (alias_size_in_bytes reads 0), which would
+    let the budget gates below silently pass-or-fail on cache state
+    instead of on the program. Fresh compiles keep the measurements
+    honest regardless of cache warmth. The cache singleton initializes
+    once per process and then ignores config updates, so it must be
+    reset around the config flip (and reset back after, so later tests
+    re-attach to the directory cache)."""
+    import jax
+
+    try:
+        from jax._src import compilation_cache as _cc
+        reset = _cc.reset_cache
+    except (ImportError, AttributeError):  # pragma: no cover — jax drift
+        reset = lambda: None  # noqa: E731
+
+    prev = jax.config.jax_compilation_cache_dir
+    reset()
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        reset()
+
+
 def test_step_op_budget_regression():
     eng = _bug_config_engine()
     w = 256
     state = eng.init(np.arange(w))
-    comp = eng._run.lower(state, 4_000).compile()
+    comp = _compile_fresh(eng._run.lower(state, 4_000))
     ca = comp.cost_analysis()
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]
         ca = ca[0]
@@ -311,7 +339,7 @@ def test_donated_run_peak_memory():
     insert's temp work)."""
     eng = _bug_config_engine()
     state = eng.init(np.arange(1024))
-    comp = eng._run.lower(state, 4_000).compile()
+    comp = _compile_fresh(eng._run.lower(state, 4_000))
     ma = comp.memory_analysis()
     assert ma.alias_size_in_bytes == ma.argument_size_in_bytes, (
         "donation did not alias the full input state")

@@ -75,6 +75,12 @@ METRICS: List[Tuple[str, str, bool]] = [
     ("bridge seeds/s", "configs.bridge_sweep.bridge_seeds_per_sec", True),
     ("bridge vs host", "configs.bridge_sweep.bridge_vs_host", True),
     ("host engine seeds/s", "configs.host_engine.seeds_per_sec", True),
+    # Fleet fabric overhead (docs/fleet.md; bench_fleet_sweep): the
+    # 2-worker local fabric's rate vs the single-host sweep on the same
+    # seeds, tracked so lease/heartbeat/merge costs can't creep.
+    ("fleet seeds/s", "configs.fleet_sweep.fleet_seeds_per_sec", True),
+    ("fleet overhead frac",
+     "configs.fleet_sweep.fabric_overhead_frac", False),
 ]
 
 

@@ -1,0 +1,159 @@
+"""Chaos matrix runner: `make chaos` (docs/fleet.md).
+
+Drives the fleet fabric's worker-crash / duplicate-completion matrix on
+the virtual CPU mesh for all three actor families and asserts the
+crash-identical contract end to end:
+
+    single-host sweep()  ==  crash-free fleet  ==  chaotic fleet
+
+on seed ids, bug flags, per-seed observations, and (raft, metrics on)
+the coverage ledger — while verifying the chaos actually happened
+(kills, lease expiries + re-issues, duplicated completions, SIGTERM
+preemptions, torn checkpoints, RPC retries all nonzero). Prints one
+JSON summary line per family and exits nonzero on any violation.
+
+`--process` additionally runs the multiprocess leg (real worker
+processes, pipes, SIGKILL mid-lease) — slower: each worker pays a JAX
+import + compile. CI runs the default matrix after smoke; the same
+assertions also live in tier-1 (tests/test_fleet.py) so `make test`
+covers them too.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _families():
+    from madsim_tpu.engine import (
+        DeviceEngine,
+        EngineConfig,
+        PBActor,
+        PBDeviceConfig,
+        RaftActor,
+        RaftDeviceConfig,
+        TPCActor,
+        TPCDeviceConfig,
+    )
+
+    raft_cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                            t_limit_us=1_500_000, stop_on_bug=True,
+                            metrics=True)
+    yield "raft", DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=3, buggy_double_vote=True)), raft_cfg)
+    yield "pb", DeviceEngine(
+        PBActor(PBDeviceConfig(n=3, n_writes=4)),
+        EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.05))
+    yield "tpc", DeviceEngine(
+        TPCActor(TPCDeviceConfig(n=4, n_txns=4,
+                                 buggy_presumed_commit=True)),
+        EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                     t_limit_us=1_500_000, loss_rate=0.1))
+
+
+def _contract_equal(a, b) -> list:
+    from madsim_tpu.fleet import contract_mismatches
+
+    return contract_mismatches(a, b)
+
+
+def run_matrix(n_seeds: int = 64) -> int:
+    from madsim_tpu.fleet import ChaosConfig, fleet_sweep
+    from madsim_tpu.parallel.sweep import sweep
+
+    chaos = ChaosConfig(seed=11, kill_at=(("w0", 2),),
+                        preempt_at=(("w1", 5),),
+                        duplicate_all_completions=True,
+                        drop_rpc_rate=0.25, drop_heartbeat_rate=0.1,
+                        tear_checkpoint_on_kill=True, restart_after=2)
+    kw = dict(chunk_steps=64, max_steps=20_000)
+    failures = 0
+    for name, eng in _families():
+        seeds = np.arange(n_seeds)
+        single = sweep(None, eng.cfg, seeds, engine=eng, **kw)
+        clean = fleet_sweep(None, eng.cfg, seeds, engine=eng,
+                            n_workers=2, range_size=n_seeds // 4, **kw)
+        with tempfile.TemporaryDirectory() as ckdir:
+            chaotic = fleet_sweep(None, eng.cfg, seeds, engine=eng,
+                                  n_workers=2, range_size=n_seeds // 4,
+                                  chaos=chaos, checkpoint_dir=ckdir, **kw)
+        bad = _contract_equal(single, clean) + _contract_equal(single,
+                                                               chaotic)
+        stats = chaotic.loop_stats["fleet"]
+        injected = {k: stats[k] for k in
+                    ("kills", "preemptions", "rpc_retries",
+                     "checkpoints_discarded")}
+        injected["leases_expired"] = stats["leases_expired"]
+        injected["leases_reissued"] = stats["leases_reissued"]
+        injected["duplicates_crosschecked"] = \
+            stats["duplicates_crosschecked"]
+        missing = [k for k in ("kills", "leases_expired", "leases_reissued",
+                               "duplicates_crosschecked")
+                   if not injected.get(k)]
+        ok = not bad and not missing
+        failures += 0 if ok else 1
+        print(json.dumps({
+            "family": name, "ok": ok, "n_seeds": n_seeds,
+            "failing_seeds": len(single.failing_seeds),
+            "contract_mismatches": bad,
+            "chaos_not_exercised": missing,
+            "injected": injected,
+        }))
+    return failures
+
+
+def run_process_leg(n_seeds: int = 32) -> int:
+    from madsim_tpu.engine import (
+        DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
+    )
+    from madsim_tpu.fleet import fleet_sweep
+    from madsim_tpu.parallel.sweep import sweep
+
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_500_000, stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+    seeds = np.arange(n_seeds)
+    kw = dict(chunk_steps=64, max_steps=20_000)
+    single = sweep(None, cfg, seeds, engine=eng, **kw)
+    with tempfile.TemporaryDirectory() as ckdir:
+        fleet = fleet_sweep(RaftActor(rcfg), cfg, seeds, n_workers=2,
+                            range_size=n_seeds // 4, spawn="process",
+                            lease_ttl=5.0, checkpoint_dir=ckdir,
+                            kill_after_heartbeats={"w0": 1},
+                            serve_timeout_s=300.0, **kw)
+    bad = _contract_equal(single, fleet)
+    print(json.dumps({"family": "raft(process)", "ok": not bad,
+                      "contract_mismatches": bad,
+                      "fleet": {k: v for k, v in
+                                fleet.loop_stats["fleet"].items()
+                                if not isinstance(v, dict)}}))
+    return 1 if bad else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=64)
+    ap.add_argument("--process", action="store_true",
+                    help="also run the multiprocess (spawn) leg")
+    args = ap.parse_args()
+    failures = run_matrix(args.seeds)
+    if args.process:
+        failures += run_process_leg()
+    if failures:
+        print(f"chaos matrix: {failures} FAMILY FAILURES", file=sys.stderr)
+        return 1
+    print("chaos matrix: all families crash-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
