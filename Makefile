@@ -8,18 +8,30 @@ PY ?= python
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check lint test smoke dryrun determinism dualmode native clean \
-        replay-demo bench-diff chaos chaos-full
+.PHONY: check lint detlint tracelint test smoke dryrun determinism \
+        dualmode native clean replay-demo bench-diff chaos chaos-full
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
 
-# detlint static gate: nondeterminism escapes (DET*) + sim/real API parity
-# (PAR*). Zero findings required; intentional sites are covered by
-# detlint-allow.txt and inline `detlint: allow[RULE]` pragmas. See
-# docs/detlint.md for the rule catalog.
-lint:
+# The static gate, two layers (docs/detlint.md):
+#  - detlint: AST passes — nondeterminism escapes (DET*), sim/real API
+#    parity (PAR*), hot-loop sync discipline (DET008/DET009).
+#  - tracelint: program-level pass — jaxpr rules over the compiled
+#    hot-path programs (TRC*), donation contracts, and the checked-in
+#    cost-budget ledger analysis/budgets.json (BUD*). Budget programs
+#    compile FRESH (the persistent cache strips cost/alias stats), so
+#    this leg costs real compile time — that is the point: an op-budget
+#    regression fails `make lint` before a bench round ever runs.
+# Zero findings required; intentional sites are covered by
+# detlint-allow.txt and inline `detlint: allow[RULE]` pragmas.
+lint: detlint tracelint
+
+detlint:
 	$(PY) -m madsim_tpu.analysis madsim_tpu tools
+
+tracelint:
+	$(CPU_ENV) $(PY) tools/update_budgets.py --check
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -14,17 +14,25 @@ Two passes (docs/detlint.md):
    ``time``) enforced as signatures, so one program keeps compiling
    against both backends — the reference's ``--cfg madsim`` contract.
 
-CLI: ``python -m madsim_tpu.analysis`` (or ``tools/detlint.py``);
-``make lint`` is the repo gate. Suppression: ``# detlint: allow[RULE]``
-pragmas (stale ones are errors) + the checked-in ``detlint-allow.txt``.
+3. **Program-level tracelint** (:mod:`.tracelint`): the hot-path entry
+   points traced to jaxprs and compiled fresh — host-callback and
+   nondeterministic-primitive rules (TRC001/002), x64-invariance
+   (TRC003), donation contracts (TRC004), and the checked-in cost-budget
+   ledger ``analysis/budgets.json`` (BUD001/002, :mod:`.budgets`).
+
+CLI: ``python -m madsim_tpu.analysis`` / ``... trace`` (or
+``tools/detlint.py``); ``make lint`` is the repo gate (detlint +
+tracelint). Suppression: ``# detlint: allow[RULE]`` pragmas (stale ones
+are errors; DET008/009 waivers need ``reason=``) + the checked-in
+``detlint-allow.txt`` (stale lines are DET901 errors).
 """
-from .cli import main, run_lint
+from .cli import main, main_trace, run_lint
 from .escape import run_escape_pass, scan_source
 from .parity import run_parity_pass
 from .pragmas import Allowlist, Finding
 from .rules import RULES, Rule
 
 __all__ = [
-    "main", "run_lint", "run_escape_pass", "run_parity_pass", "scan_source",
-    "Allowlist", "Finding", "RULES", "Rule",
+    "main", "main_trace", "run_lint", "run_escape_pass", "run_parity_pass",
+    "scan_source", "Allowlist", "Finding", "RULES", "Rule",
 ]

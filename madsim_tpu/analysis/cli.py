@@ -1,7 +1,17 @@
-"""detlint CLI: ``python -m madsim_tpu.analysis [paths...]``.
+"""detlint/tracelint CLI: ``python -m madsim_tpu.analysis [trace] [...]``.
 
-Exit codes: 0 clean, 1 findings, 2 usage/config error — the Makefile/CI
-gate is just the exit code.
+Two entry shapes, one exit-code contract (0 clean, 1 findings, 2
+usage/config error — the Makefile/CI gate is just the exit code):
+
+- ``python -m madsim_tpu.analysis [paths...]`` — the AST passes
+  (nondeterminism escapes + sim/real parity + hot-loop sync discipline).
+- ``python -m madsim_tpu.analysis trace`` — pass 3 (tracelint): jaxpr
+  rules over the registered hot-path programs plus the budget-ledger
+  diff (``--no-budgets`` for the trace rules alone).
+
+Output: human text (default), ``--json`` machine-readable findings, or
+``--format=github`` workflow-annotation lines so CI findings surface as
+inline annotations instead of buried log text.
 """
 from __future__ import annotations
 
@@ -22,23 +32,164 @@ DEFAULT_PATHS = ["madsim_tpu", "tools"]
 
 def run_lint(root: str, paths: List[str],
              allowlist: Optional[Allowlist] = None,
-             escape: bool = True, parity: bool = True) -> List[Finding]:
-    """Both passes over ``paths`` under ``root``; the library entry tests
-    and embedders use (the CLI is a thin shell over this)."""
+             escape: bool = True, parity: bool = True,
+             check_allowlist: bool = True,
+             allowlist_name: str = DEFAULT_ALLOWLIST) -> List[Finding]:
+    """Both AST passes over ``paths`` under ``root``; the library entry
+    tests and embedders use (the CLI is a thin shell over this).
+
+    ``check_allowlist``: after filtering, flag allowlist entries that
+    matched no finding (DET901) — but only when both passes ran (a
+    skipped pass could be the entry's whole audience) and only for
+    entries whose path prefix the scan surface covered.
+    """
     allowlist = allowlist or Allowlist.empty()
     findings: List[Finding] = []
     if escape:
         findings.extend(run_escape_pass(root, paths, allowlist))
     if parity:
         findings.extend(allowlist.filter(run_parity_pass(root)))
+    if check_allowlist and escape and parity:
+        for entry in allowlist.stale_entries(paths):
+            rule = f":{entry.rule}" if entry.rule else ""
+            findings.append(Finding(
+                allowlist_name, entry.line, "DET901",
+                f"stale allowlist entry: `{entry.prefix}{rule}` matches no "
+                f"finding under the scanned surface — delete the line (the "
+                "tree it excused is clean, or was renamed)"))
     return findings
 
 
+def render_findings(findings: List[Finding], fmt: str,
+                    label: str = "detlint") -> None:
+    """Print findings in the chosen format; the summary line goes to
+    stderr so stdout stays machine-parseable."""
+    if fmt == "json":
+        print(json.dumps([f._asdict() for f in findings]))
+        return
+    for f in findings:
+        if fmt == "github":
+            # GitHub workflow-annotation command: renders as an inline
+            # file annotation on the PR diff.
+            msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+                .replace("\n", "%0A")
+            print(f"::error file={f.path},line={max(f.line, 1)},"
+                  f"title={f.rule}::{msg}")
+        else:
+            print(f.render())
+    n = len(findings)
+    print(f"{label}: {n} finding{'s' if n != 1 else ''}"
+          if n else f"{label}: clean", file=sys.stderr)
+
+
+def _add_format_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout "
+                         "(alias for --format=json)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text",
+                    help="output format; `github` emits workflow-"
+                         "annotation lines for inline CI annotations")
+
+
+def _fmt(args) -> str:
+    return "json" if args.json else args.format
+
+
+# ---------------------------------------------------------------------------
+# `trace` subcommand — pass 3 (tracelint)
+# ---------------------------------------------------------------------------
+
+def _prepare_trace_env() -> None:
+    """Default the JAX platform to the virtual 8-device CPU mesh the
+    ledger shapes are pinned to — BEFORE jax is first imported. A jax
+    already imported with different devices is left alone (the caller
+    opted into their own topology)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main_trace(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="detlint trace",
+        description="tracelint: program-level static analysis of the "
+                    "compiled sweep — jaxpr rules (TRC001-003), donation "
+                    "contracts (TRC004), and the checked-in cost-budget "
+                    "ledger (BUD001/BUD002)")
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated subset of registered programs")
+    ap.add_argument("--list-programs", action="store_true")
+    ap.add_argument("--no-budgets", action="store_true",
+                    help="trace rules only: skip the fresh compiles and "
+                         "the ledger diff (fast)")
+    ap.add_argument("--budgets", default=None, metavar="PATH",
+                    help="ledger file (default: analysis/budgets.json "
+                         "inside the package)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist file applied to trace/<program> "
+                         "pseudo-paths (default: ./detlint-allow.txt "
+                         "when present)")
+    _add_format_args(ap)
+    args = ap.parse_args(argv)
+
+    _prepare_trace_env()
+    from .tracelint import registry, run_trace
+
+    if args.list_programs:
+        for name, prog in sorted(registry().items()):
+            tags = []
+            if prog.budget:
+                tags.append("budget")
+            if prog.donates:
+                tags.append("donates")
+            if prog.x64 == "required":
+                tags.append("x64")
+            tag = f" [{','.join(tags)}]" if tags else ""
+            print(f"{name:28s} {prog.title}{tag}")
+        return 0
+
+    programs = ([p.strip() for p in args.programs.split(",") if p.strip()]
+                if args.programs else None)
+    try:
+        findings, _measured = run_trace(
+            programs=programs, budget_check=not args.no_budgets,
+            ledger_path=args.budgets)
+    except (KeyError, FileNotFoundError, ValueError) as exc:
+        print(f"tracelint: {exc}", file=sys.stderr)
+        return 2
+
+    allowlist = Allowlist.empty()
+    allow_path = args.allowlist or DEFAULT_ALLOWLIST
+    if os.path.isfile(allow_path):
+        allowlist = Allowlist.load(allow_path)
+    elif args.allowlist is not None:
+        print(f"tracelint: allowlist not found: {args.allowlist}",
+              file=sys.stderr)
+        return 2
+    findings = allowlist.filter(findings)
+    render_findings(findings, _fmt(args), label="tracelint")
+    return 1 if findings else 0
+
+
+# ---------------------------------------------------------------------------
+# the AST passes (the original detlint entry)
+# ---------------------------------------------------------------------------
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "trace":
+        return main_trace(argv[1:])
+
     ap = argparse.ArgumentParser(
         prog="detlint",
         description="madsim_tpu static analyzer: nondeterminism escapes "
-                    "(pass 1) + sim/real API parity (pass 2)")
+                    "(pass 1) + sim/real API parity (pass 2); "
+                    "`trace` subcommand for pass 3 (tracelint)")
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
     ap.add_argument("--root", default=".",
@@ -50,8 +201,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip pass 2 (sim/real parity)")
     ap.add_argument("--no-escape", action="store_true",
                     help="skip pass 1 (nondeterminism escapes)")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings on stdout")
+    _add_format_args(ap)
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -87,13 +237,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     findings = run_lint(root, paths, allowlist,
-                        escape=not args.no_escape, parity=not args.no_parity)
-    if args.json:
-        print(json.dumps([f._asdict() for f in findings]))
-    else:
-        for f in findings:
-            print(f.render())
-        n = len(findings)
-        print(f"detlint: {n} finding{'s' if n != 1 else ''}"
-              if n else "detlint: clean", file=sys.stderr)
+                        escape=not args.no_escape,
+                        parity=not args.no_parity,
+                        check_allowlist=os.path.isfile(allow_path),
+                        allowlist_name=os.path.basename(allow_path))
+    render_findings(findings, _fmt(args))
     return 1 if findings else 0

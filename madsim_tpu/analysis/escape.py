@@ -26,8 +26,10 @@ import os
 from typing import Dict, List, Optional
 
 from .pragmas import Allowlist, Finding, apply_pragmas, extract_pragmas
-from .rules import (ATTR_CALLS, CLOCK_DEFAULT_CALLS, EXACT_CALLS,
-                    PREFIX_CALLS, RULES)
+from .rules import (ATTR_CALLS, CLOCK_DEFAULT_CALLS, CONVERT_BUILTINS,
+                    CONVERT_NP, DEVICE_CALLS, EXACT_CALLS, FETCH_NAMES,
+                    HOT_LOOP_MARKER, HOT_LOOP_MODULES, PREFIX_CALLS, RULES,
+                    SYNC_CALLS, SYNC_METHODS)
 
 _SORT_BUILTINS = {"sorted", "min", "max"}
 
@@ -155,8 +157,270 @@ def _looks_stdlib(head: str) -> bool:
                     "jax")
 
 
-def scan_source(source: str, path: str) -> List[Finding]:
-    """Lint one module's source; returns post-pragma findings."""
+# ---------------------------------------------------------------------------
+# Sync-discipline pass (DET008/DET009) — hot-loop modules only
+# ---------------------------------------------------------------------------
+
+def _root_name(expr: ast.expr) -> Optional[str]:
+    """The base Name of an Attribute/Subscript-free attribute chain
+    (``a.b.c`` -> ``a``); None for anything rooted elsewhere."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class _SyncScanner:
+    """One *scope* (module body or one function body) of the hot-loop
+    sync-discipline pass.
+
+    The pass replays the scope's statements in source order, keeping a
+    set of names last assigned from a device-producing expression
+    (``jnp.*`` calls, ``jax.device_put``, ``shard_worlds``, or anything
+    mentioning an already-tainted name) — and cleared again by
+    assignment from the sanctioned ``_fetch`` hook (or any host
+    expression). Conversions of tainted names (DET009) and the explicit
+    blocking-sync APIs (DET008) are flagged wherever they appear.
+
+    Source-order replay over a tree is a heuristic, not a dataflow
+    analysis: branches and loops are linearized, and closures start
+    untainted. That is the right price for a lint — it is exact on the
+    straight-line hot loops it guards, and a miss only ever defers to
+    the runtime counted-``_fetch`` tests.
+    """
+
+    def __init__(self, path: str, imports: Dict[str, str],
+                 findings: List[Finding]):
+        self.path = path
+        self.imports = imports
+        self.findings = findings
+        self.tainted: set = set()
+
+    # -- name resolution ----------------------------------------------------
+    def _full(self, expr: ast.expr) -> Optional[str]:
+        parts = _dotted(expr)
+        if parts is None:
+            return None
+        head = self.imports.get(parts[0])
+        return ".".join([head] + parts[1:]) if head else ".".join(parts)
+
+    def _is_fetch_expr(self, expr: ast.expr) -> bool:
+        """Does the expression materialize HOST data (contain a `_fetch`
+        or `jax.device_get` call)?"""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in FETCH_NAMES:
+                    return True
+                full = self._full(node.func)
+                if full in SYNC_CALLS:
+                    return True
+        return False
+
+    def _is_device_call(self, call: ast.Call) -> bool:
+        full = self._full(call.func)
+        if full is None:
+            return False
+        if full in DEVICE_CALLS or full.startswith("jax.numpy."):
+            return True
+        return isinstance(call.func, ast.Name) and call.func.id in DEVICE_CALLS
+
+    def _is_device_expr(self, expr: ast.expr) -> bool:
+        """Does the expression produce device-resident data?
+
+        True when it contains a device-producing call (``jnp.*``,
+        ``jax.device_put``, ``shard_worlds``) anywhere, or when it IS a
+        direct alias of a tainted name (bare name / tuple of names /
+        ternary between them). A call *mentioning* a tainted name does
+        NOT propagate taint — most such calls (``eng.observe(state)``,
+        ``ckpt_aux(...)``) return host data, and the conversions the rule
+        hunts re-materialize a device value someone just computed.
+        """
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and self._is_device_call(node):
+                return True
+        return self._is_alias_of_tainted(expr)
+
+    def _is_alias_of_tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._is_alias_of_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self._is_alias_of_tainted(expr.value)
+        if isinstance(expr, ast.IfExp):
+            return (self._is_alias_of_tainted(expr.body)
+                    or self._is_alias_of_tainted(expr.orelse))
+        return False
+
+    # -- taint bookkeeping --------------------------------------------------
+    def _assign_targets(self, target: ast.expr, device: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_targets(elt, device)
+        elif isinstance(target, ast.Starred):
+            self._assign_targets(target.value, device)
+        elif isinstance(target, ast.Name):
+            (self.tainted.add if device
+             else self.tainted.discard)(target.id)
+        # Attribute/Subscript targets: container mutation, no name to track.
+
+    def _classify_and_assign(self, targets: List[ast.expr],
+                             value: ast.expr) -> None:
+        device = (not self._is_fetch_expr(value)) \
+            and self._is_device_expr(value)
+        for t in targets:
+            self._assign_targets(t, device)
+
+    # -- findings -----------------------------------------------------------
+    def _flag(self, node: ast.AST, rule: str, what: str) -> None:
+        r = RULES[rule]
+        self.findings.append(Finding(
+            self.path, node.lineno, rule,
+            f"{r.title}: {what} — {r.suggestion}"))
+
+    def _check_expr(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            full = self._full(node)
+            if full in SYNC_CALLS:
+                self._flag(node, "DET008", f"`{full}`")
+            return
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in SYNC_METHODS \
+                and not node.args:
+            self._flag(node, "DET008", f"`.{func.attr}()`")
+            return
+        # Host conversions: np.asarray/np.array/np.copy, float/int/bool.
+        is_np = False
+        full = self._full(func)
+        if full is not None and full.startswith("numpy.") \
+                and full.split(".", 1)[1] in CONVERT_NP:
+            is_np = True
+        is_builtin = (isinstance(func, ast.Name)
+                      and func.id in CONVERT_BUILTINS
+                      and func.id not in self.imports)
+        if not (is_np or is_builtin) or len(node.args) < 1:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Call):
+            inner = self._full(arg.func)
+            if inner is not None and (inner.startswith("jax.numpy.")
+                                      or inner.startswith("jax.")
+                                      and inner not in SYNC_CALLS
+                                      and not inner.startswith("jax.tree")):
+                self._flag(node, "DET008",
+                           f"`{'np.' if is_np else ''}"
+                           f"{func.attr if is_np else func.id}"
+                           f"({inner}(...))` materializes a fresh device "
+                           "computation inline")
+            return
+        root = _root_name(arg)
+        if root is not None and root in self.tainted and \
+                not isinstance(arg, ast.Subscript):
+            name = func.attr if is_np else func.id
+            self._flag(node, "DET009",
+                       f"`{name}({ast.unparse(arg)})` — `{root}` was last "
+                       "bound to a device value")
+
+    # -- ordered replay -----------------------------------------------------
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _visit_exprs(self, node: ast.AST) -> None:
+        """Flag candidates in an expression tree, skipping nested
+        function/lambda bodies (their own scopes)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _SyncScanner(self.path, self.imports, self.findings).run(node.body)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        self._check_expr(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit_exprs(child)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _SyncScanner(self.path, self.imports, self.findings)
+            sub.run(stmt.body)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._visit_exprs(stmt.value)
+            self._classify_and_assign(stmt.targets, stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._visit_exprs(stmt.value)
+            self._classify_and_assign([stmt.target], stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._visit_exprs(stmt.value)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_exprs(stmt.iter)
+            self._classify_and_assign([stmt.target], stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.While,)):
+            self._visit_exprs(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.If):
+            self._visit_exprs(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._visit_exprs(item.context_expr)
+                if item.optional_vars is not None:
+                    self._classify_and_assign([item.optional_vars],
+                                              item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        # Expression statements, return/raise/assert/del/import/...: flag
+        # candidates in any embedded expressions, no taint updates.
+        self._visit_exprs(stmt)
+
+
+def is_hot_loop_module(path: str, source: str) -> bool:
+    """Hot-loop modules get the sync-discipline pass: the repo's known
+    orchestration loops plus any file opting in via a first-line
+    ``# tracelint: hot-loop`` marker."""
+    if path in HOT_LOOP_MODULES:
+        return True
+    head = source.split("\n", 2)[:2]
+    return any(HOT_LOOP_MARKER in line for line in head)
+
+
+def run_sync_pass(tree: ast.Module, path: str,
+                  imports: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    _SyncScanner(path, imports, findings).run(tree.body)
+    return findings
+
+
+def scan_source(source: str, path: str,
+                hot: Optional[bool] = None) -> List[Finding]:
+    """Lint one module's source; returns post-pragma findings. ``hot``
+    forces the sync-discipline pass on/off (default: auto-detect via
+    :func:`is_hot_loop_module`)."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
@@ -166,7 +430,11 @@ def scan_source(source: str, path: str) -> List[Finding]:
     table.visit(tree)
     scanner = _CallScanner(path, table.names)
     scanner.visit(tree)
-    return apply_pragmas(scanner.findings, extract_pragmas(source), path)
+    findings = scanner.findings
+    if hot if hot is not None else is_hot_loop_module(path, source):
+        findings = findings + run_sync_pass(tree, path, table.names)
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return apply_pragmas(findings, extract_pragmas(source), path)
 
 
 def iter_py_files(root: str, paths: List[str]) -> List[str]:

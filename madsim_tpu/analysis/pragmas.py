@@ -6,10 +6,15 @@ Two escape hatches, for two shapes of intent:
   — for a *single deliberate site* (e.g. ``testing.py``'s wall-clock default
   seed). A pragma that suppresses nothing is itself an error (DET900), so
   allow-comments cannot rot in place after the code they excused changes.
+  Sync-discipline waivers (DET008/DET009) must additionally carry a
+  machine-readable justification — ``allow[DET008] reason=...`` — because a
+  sanctioned blocking site is an architectural claim, not a style choice.
 - an allowlist file (default ``detlint-allow.txt`` at the scan root) with
   ``path-prefix[:RULE]`` lines — for *whole intentional trees* (all of
   ``madsim_tpu/real/`` IS the nondeterministic backend; flagging it would
-  be flagging the design).
+  be flagging the design). An entry that stops matching any finding is
+  flagged DET901 by the CLI (when the scan surface covers its prefix), so
+  the file cannot rot silently either.
 """
 from __future__ import annotations
 
@@ -29,19 +34,25 @@ class Finding(NamedTuple):
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
 
-_PRAGMA_RE = re.compile(r"#\s*detlint:\s*allow\[([A-Za-z0-9_,\s]+)\]")
+_PRAGMA_RE = re.compile(
+    r"#\s*detlint:\s*allow\[([A-Za-z0-9_,\s]+)\](?:\s+reason=(\S[^#]*))?")
+
+# Rules whose pragmas must carry a reason= tail: waiving the hot-loop sync
+# discipline without saying why defeats the point of counting fetches.
+REASON_REQUIRED = frozenset({"DET008", "DET009"})
 
 
-def extract_pragmas(source: str) -> Dict[int, Tuple[int, Set[str]]]:
-    """Map *effective* line -> (pragma line, allowed rule codes).
+def extract_pragmas(source: str) -> Dict[int, Tuple[int, Dict[str, Optional[str]]]]:
+    """Map *effective* line -> (pragma line, {rule code: reason or None}).
 
     Tokenized, not line-grepped: only real COMMENT tokens count, so a
     pragma example quoted inside a docstring is documentation, not a
     suppression. A pragma trailing code covers its own line; a pragma on
     a comment-only line covers the next line (the decorator-friendly
-    form).
+    form). The optional ``reason=...`` tail is captured per pragma and
+    applies to every code the bracket names.
     """
-    out: Dict[int, Tuple[int, Set[str]]] = {}
+    out: Dict[int, Tuple[int, Dict[str, Optional[str]]]] = {}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type != tokenize.COMMENT:
@@ -49,22 +60,26 @@ def extract_pragmas(source: str) -> Dict[int, Tuple[int, Set[str]]]:
             m = _PRAGMA_RE.search(tok.string)
             if m is None:
                 continue
-            codes = {c.strip().upper()
+            reason = m.group(2).strip() if m.group(2) else None
+            codes = {c.strip().upper(): reason
                      for c in m.group(1).split(",") if c.strip()}
             line = tok.start[0]
             comment_only = tok.line[:tok.start[1]].strip() == ""
             target = line + 1 if comment_only else line
-            prev_line, prev_codes = out.get(target, (line, set()))
-            out[target] = (prev_line, prev_codes | codes)
+            prev_line, prev_codes = out.get(target, (line, {}))
+            merged = dict(prev_codes)
+            merged.update(codes)
+            out[target] = (prev_line, merged)
     except (tokenize.TokenError, IndentationError):
         pass  # unparseable source surfaces as DET000 from the AST pass
     return out
 
 
 def apply_pragmas(findings: List[Finding],
-                  pragmas: Dict[int, Tuple[int, Set[str]]],
+                  pragmas: Dict[int, Tuple[int, Dict[str, Optional[str]]]],
                   path: str) -> List[Finding]:
-    """Drop findings covered by a pragma; emit DET900 for unused codes."""
+    """Drop findings covered by a pragma; emit DET900 for unused codes and
+    for sync-discipline waivers missing their ``reason=`` tail."""
     used: Dict[Tuple[int, str], bool] = {}
     for line, (_pline, codes) in pragmas.items():
         for code in codes:
@@ -83,25 +98,45 @@ def apply_pragmas(findings: List[Finding],
                     path, pline, "DET900",
                     f"pragma allows {code} but line {line} has no {code} "
                     f"finding — delete the stale pragma"))
+            elif code in REASON_REQUIRED and not codes[code]:
+                kept.append(Finding(
+                    path, pline, "DET900",
+                    f"allow[{code}] waives the hot-loop sync discipline "
+                    f"and must carry a machine-readable justification: "
+                    f"`detlint: allow[{code}] reason=...`"))
     kept.sort(key=lambda f: (f.line, f.rule))
     return kept
 
 
+class AllowEntry(NamedTuple):
+    prefix: str
+    rule: Optional[str]
+    line: int   # 1-based line in the allowlist file (0: built in code)
+
+
 class Allowlist:
-    """``path-prefix[:RULE]`` entries; '#' starts a comment."""
+    """``path-prefix[:RULE]`` entries; '#' starts a comment.
+
+    ``filter`` records which entries matched, so the CLI can flag entries
+    that excuse nothing (DET901) once a scan has covered their prefix.
+    """
 
     def __init__(self, entries: List[Tuple[str, Optional[str]]]):
-        self._entries = entries
+        self._entries = [
+            e if isinstance(e, AllowEntry) else AllowEntry(e[0], e[1], 0)
+            for e in entries]
+        self._matched: Set[AllowEntry] = set()
 
     @classmethod
     def parse(cls, text: str) -> "Allowlist":
-        entries: List[Tuple[str, Optional[str]]] = []
-        for raw in text.splitlines():
+        entries: List[AllowEntry] = []
+        for lineno, raw in enumerate(text.splitlines(), start=1):
             line = raw.split("#", 1)[0].strip()
             if not line:
                 continue
             prefix, _, rule = line.partition(":")
-            entries.append((prefix.strip(), rule.strip().upper() or None))
+            entries.append(AllowEntry(prefix.strip(),
+                                      rule.strip().upper() or None, lineno))
         return cls(entries)
 
     @classmethod
@@ -113,11 +148,34 @@ class Allowlist:
     def empty(cls) -> "Allowlist":
         return cls([])
 
+    @property
+    def entries(self) -> List[AllowEntry]:
+        return list(self._entries)
+
     def allows(self, finding: Finding) -> bool:
-        return any(
-            finding.path.startswith(prefix)
-            and (rule is None or rule == finding.rule)
-            for prefix, rule in self._entries)
+        hit = False
+        for entry in self._entries:
+            if finding.path.startswith(entry.prefix) and \
+                    (entry.rule is None or entry.rule == finding.rule):
+                self._matched.add(entry)
+                hit = True
+        return hit
 
     def filter(self, findings: List[Finding]) -> List[Finding]:
         return [f for f in findings if not self.allows(f)]
+
+    def stale_entries(self, scanned_paths: List[str]) -> List[AllowEntry]:
+        """Entries no ``filter`` call matched, restricted to prefixes the
+        scan surface actually covered (an entry for an unscanned tree is
+        unknown, not stale). Call after filtering raw findings."""
+        out = []
+        for entry in self._entries:
+            if entry in self._matched:
+                continue
+            covered = any(entry.prefix.startswith(p.rstrip("/") + "/")
+                          or entry.prefix.rstrip("/") == p.rstrip("/")
+                          or p.startswith(entry.prefix)
+                          for p in scanned_paths)
+            if covered:
+                out.append(entry)
+        return out

@@ -10,8 +10,14 @@ the sweep never executes are exactly where escapes hide (the ahead-of-time
 argument of PRISM-style modeling vs observed-run sampling, PAPERS.md).
 
 ``PAR`` rules belong to pass 2 (sim/real API parity); ``DET9xx`` codes are
-lint-hygiene errors (stale pragmas), so an allow-comment can never silently
-rot into a blanket waiver.
+lint-hygiene errors (stale pragmas, stale allowlist lines), so an
+allow-comment can never silently rot into a blanket waiver.
+
+``TRC``/``BUD`` rules belong to pass 3 (tracelint, :mod:`.tracelint`):
+they fire on *compiled programs* — the traced jaxprs and XLA executables
+of the hot-path entry points — not on source lines, because the
+determinism and performance contracts of the superstep loop, donated
+buffers, and the coverage fold live below the Python AST.
 """
 from __future__ import annotations
 
@@ -41,8 +47,37 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          "profile from the observatory layer (madsim_tpu.obs.observatory "
          "ProfilerWindow / sweep(profile_dir=...)) — step code must stay "
          "free of host-time observation"),
+    Rule("DET008", "blocking device sync in an orchestration hot-loop module",
+         "route every device->host pull through the counted `_fetch` hook "
+         "(parallel/sweep.py) so the sync-discipline tests stay honest; a "
+         "deliberate site needs `detlint: allow[DET008] reason=...`"),
+    Rule("DET009", "device value converted to host without going through "
+         "`_fetch`",
+         "fetch first (`x_h = _fetch(x)`), then convert the host copy — "
+         "int()/np.asarray() on a device array is a hidden blocking sync"),
     Rule("DET900", "stale pragma: allow[...] names a rule with no finding",
          "delete the pragma (or the code that made it necessary came back)"),
+    Rule("DET901", "stale allowlist entry: its path[:rule] matches no finding",
+         "delete the detlint-allow.txt line — the tree it excused is clean "
+         "now (or was renamed out from under it)"),
+    Rule("TRC001", "host callback primitive inside a jitted sim program",
+         "pure_callback/io_callback/debug_callback re-enter the host mid-"
+         "program: remove it (debug prints belong in obs/, not the step)"),
+    Rule("TRC002", "backend-variant or nondeterministic primitive",
+         "unstable sorts, float scatter-accumulation onto duplicate "
+         "indices, approximate/stateful kernels vary across backends — "
+         "use a stable, exact formulation"),
+    Rule("TRC003", "numerics that change under the x64 flag",
+         "pin every dtype explicitly (jnp.int32/float32) so the program "
+         "is bit-identical whether or not jax_enable_x64 is set"),
+    Rule("TRC004", "declared buffer donation was dropped by XLA",
+         "restructure so the output can alias the donated input (XLA "
+         "drops donation SILENTLY; peak memory then double-buffers)"),
+    Rule("BUD001", "program exceeds its checked-in cost budget",
+         "if intentional, re-measure and regenerate analysis/budgets.json "
+         "via tools/update_budgets.py --reason '...' in the same PR"),
+    Rule("BUD002", "budget ledger out of sync with the program registry",
+         "run tools/update_budgets.py to add/remove the program entry"),
     Rule("PAR001", "sim/real API parity drift",
          "mirror the signature in both trees — the same program must compile "
          "against either backend"),
@@ -135,3 +170,50 @@ CLOCK_DEFAULT_CALLS: Dict[str, Tuple[str, int]] = {
 ATTR_CALLS: Dict[str, str] = {
     "run_in_executor": "DET003",
 }
+
+
+# -- sync-discipline tables (DET008/DET009) ----------------------------------
+# The orchestration hot loops live by a counted-fetch contract (docs/perf.md
+# "Pipelined orchestration"): the ONLY device->host pull per superstep is the
+# `_fetch` hook, which the tier-1 sync tests monkeypatch and count. These
+# modules get the extra pass; everywhere else a blocking read is just slow,
+# here it silently breaks the dispatch-ahead pipeline.
+HOT_LOOP_MODULES = frozenset({
+    "madsim_tpu/parallel/sweep.py",
+    "madsim_tpu/fleet/worker.py",
+    "madsim_tpu/obs/observatory.py",
+})
+
+# First-line marker opting any other file into the hot-loop pass (fixtures,
+# user orchestration code): `# tracelint: hot-loop`.
+HOT_LOOP_MARKER = "tracelint: hot-loop"
+
+# Fully-qualified jax APIs that ARE a blocking sync (or hand one out).
+SYNC_CALLS = frozenset({
+    "jax.device_get",
+    "jax.block_until_ready",
+    "jax.effects_barrier",
+})
+
+# Method names that force materialization on an arbitrary receiver.
+SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+# Host-conversion callables: np.asarray(x)/np.array(x)/float(x)/... block
+# when x is a device array. Flagged (DET008) when applied directly to a
+# fresh jnp./jax. call result, or (DET009) to a name the module-order taint
+# scan marked device-resident and never `_fetch`ed.
+CONVERT_NP = frozenset({"asarray", "array", "copy"})
+CONVERT_BUILTINS = frozenset({"float", "int", "bool"})
+
+# The sanctioned pull hook: assignments FROM it mark their targets as host
+# values, and calls THROUGH it are never findings.
+FETCH_NAMES = frozenset({"_fetch"})
+
+# Callees whose results are device-resident (taint sources for DET009);
+# `jnp.`-rooted calls are device-typed by construction, the rest are the
+# repo's device-placement helpers.
+DEVICE_CALL_HEADS = frozenset({"jnp"})
+DEVICE_CALLS = frozenset({
+    "jax.device_put",
+    "shard_worlds",
+})

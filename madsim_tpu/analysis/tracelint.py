@@ -1,0 +1,589 @@
+"""tracelint — pass 3: program-level static analysis of the compiled sweep.
+
+detlint's AST passes see Python source; since PR 3-7 the determinism and
+performance contracts moved INTO compiled programs — the superstep loop,
+donated step buffers, the coverage fold, the bridge kernel — where an AST
+walk cannot follow. This pass traces the repo's hot-path entry points to
+their jaxprs (and, for the budget/donation gates, compiles them fresh)
+and enforces four rule families, the same shape as compiler-level
+sanitizer passes in a training stack (DrJAX's MapReduce-primitive
+discipline, SCALE-Sim's cost-model validation — PAPERS.md):
+
+- **TRC001** — no host callbacks (``pure_callback``/``io_callback``/
+  ``debug_callback``) inside jitted sim programs: a callback re-enters
+  the host mid-program, breaking both determinism (host state) and the
+  dispatch-ahead pipeline (implicit sync).
+- **TRC002** — no backend-variant or nondeterministic primitives:
+  unstable sorts, float scatter-accumulation onto possibly-duplicate
+  indices, approximate/stateful kernels.
+- **TRC003** — no numerics that change under the x64 flag: each engine
+  program is traced twice (plain and under ``enable_x64``) and must keep
+  identical output dtypes and stay float64-free — otherwise a process
+  that flips ``jax_enable_x64`` silently changes trajectories.
+- **TRC004** — declared donation actually lands: JAX drops donation
+  SILENTLY when an output cannot alias its input, which would quietly
+  re-double-buffer the state PR 3 paid to alias (the 1.195x-of-state
+  peak gate). Checked against the per-program ``alias_fraction`` floor
+  recorded in the budget ledger, compiled FRESH (cache-deserialized
+  executables lose alias statistics — :mod:`.budgets`).
+
+Plus the **budget ledger** (``analysis/budgets.json``): per-program
+``cost_analysis`` flops/bytes and ``memory_analysis`` temp/peak, diffed
+against checked-in ceilings (BUD001/BUD002) so a hot program regressing
+its op budget fails ``make lint`` before a bench round ever runs.
+
+Entry points: ``python -m madsim_tpu.analysis trace`` (the ``make
+tracelint`` / ``make lint`` gate), ``tools/update_budgets.py`` to
+regenerate the ledger. Findings use the pseudo-path ``trace/<program>``
+so allowlist prefixes and ``--format=github`` output compose unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import budgets as _budgets
+from .pragmas import Finding
+from .rules import RULES
+
+# -- rule tables -------------------------------------------------------------
+
+# TRC001: primitives that re-enter the host from inside a program.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback",
+    # legacy host_callback spellings, in case a dependency resurrects them
+    "outside_call", "host_callback",
+})
+
+# TRC002: outright-forbidden primitives (stateful/approximate kernels whose
+# results are backend- or scheduling-dependent).
+NONDET_PRIMS = frozenset({
+    "rng_uniform",        # the old stateful lax RNG — backend-defined
+    "rng_bit_generator",  # platform-keyed algorithm selection
+    "approx_top_k",       # approximate by construction
+})
+
+# TRC002: scatter accumulation combiners that are order-sensitive in
+# floating point (float add/mul are not associative; duplicate indices
+# then make the result depend on reduction order, which backends choose).
+SCATTER_ACCUM_PRIMS = frozenset({"scatter-add", "scatter-mul"})
+
+
+# -- jaxpr walking -----------------------------------------------------------
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    vals = value if isinstance(value, (tuple, list)) else [value]
+    for v in vals:
+        if hasattr(v, "eqns"):               # open Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+            yield v.jaxpr                    # ClosedJaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator[Any]:
+    """Every equation in ``jaxpr`` and (recursively) every sub-jaxpr a
+    param carries — while/scan/cond bodies, pjit calls, shard_map, custom
+    derivative closures."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _where(eqn) -> str:
+    """Best-effort source attribution for an equation."""
+    try:
+        from jax._src import source_info_util
+
+        s = source_info_util.summarize(eqn.source_info)
+        return f" at {s}" if s else ""
+    except Exception:  # pragma: no cover — jax internals drift
+        return ""
+
+
+def _aval_dtypes(jaxpr, acc: set) -> None:
+    for eqn in jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                acc.add(str(aval.dtype))
+        for p in eqn.params.values():
+            for sub in _sub_jaxprs(p):
+                _aval_dtypes(sub, acc)
+
+
+# -- the program registry ----------------------------------------------------
+
+@dataclasses.dataclass
+class Built:
+    """A traceable/lowerable hot-path program instance.
+
+    ``fn``/``args`` is the jitted entry used for ``lower().compile()``
+    (donation declarations live there); ``trace_fn``/``trace_args``
+    override it for ``make_jaxpr`` when the jit carries static argnums
+    (``make_jaxpr`` traces every argument, so a static int would arrive
+    as a tracer and fail to hash)."""
+
+    fn: Callable                  # the jitted callable
+    args: Tuple[Any, ...]         # small concrete example args
+    ctx: Callable[[], Any] = contextlib.nullcontext  # trace/lower context
+    trace_fn: Optional[Callable] = None
+    trace_args: Optional[Tuple[Any, ...]] = None
+
+    @property
+    def for_trace(self) -> Tuple[Callable, Tuple[Any, ...]]:
+        return (self.trace_fn or self.fn,
+                self.args if self.trace_args is None else self.trace_args)
+
+
+@dataclasses.dataclass
+class TraceProgram:
+    name: str
+    title: str                    # one human line for --list-programs
+    build: Callable[[], Built]
+    x64: str = "off"              # "off": dual-trace diff; "required": bridge
+    budget: bool = False          # compile fresh: TRC004 + ledger metrics
+    donates: bool = False         # program declares input donation
+    unit_div: Optional[int] = None  # world count for flops_per_world
+
+
+_ENGINE_CACHE: Dict[str, Any] = {}
+
+
+def _bug_engine(metrics: bool = False):
+    """The canonical raft bug config every budget in the repo is pinned
+    to (tests/test_queue_insert.py, bench time_to_first_bug)."""
+    key = f"eng_m{int(metrics)}"
+    if key not in _ENGINE_CACHE:
+        from ..engine import (DeviceEngine, EngineConfig, RaftActor,
+                              RaftDeviceConfig)
+
+        cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                           t_limit_us=2_000_000, stop_on_bug=False,
+                           metrics=metrics)
+        _ENGINE_CACHE[key] = DeviceEngine(
+            RaftActor(RaftDeviceConfig(n=3, buggy_double_vote=True)), cfg)
+    return _ENGINE_CACHE[key]
+
+
+def _mesh():
+    if "mesh" not in _ENGINE_CACHE:
+        from ..parallel.mesh import seed_mesh
+
+        _ENGINE_CACHE["mesh"] = seed_mesh()
+    return _ENGINE_CACHE["mesh"]
+
+
+# Pinned shapes: every ledger number is "at this shape" — small enough to
+# trace in seconds, large enough that per-world figures are meaningful.
+RUN_WORLDS = 256          # matches the historical tier-1 op-budget shape
+RUN_MAX_STEPS = 4_000
+SWEEP_WORLDS = 64
+SWEEP_CHUNK_STEPS = 16
+SWEEP_K_MAX = 4
+
+
+def _build_engine_run() -> Built:
+    import numpy as np
+
+    eng = _bug_engine()
+    state = eng.init(np.arange(RUN_WORLDS))
+    return Built(fn=eng._run, args=(state, RUN_MAX_STEPS),
+                 trace_fn=lambda s: eng._run_impl(s, RUN_MAX_STEPS),
+                 trace_args=(state,))
+
+
+def _build_push_many() -> Built:
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.queue import Event, empty_queue, push_many
+
+    q = empty_queue(64, 2)
+    m = 4
+    evs = Event(time=jnp.zeros((m,), jnp.int32),
+                kind=jnp.zeros((m,), jnp.int32),
+                flags=jnp.zeros((m,), jnp.int32),
+                src=jnp.zeros((m,), jnp.int32),
+                dst=jnp.zeros((m,), jnp.int32),
+                gen=jnp.zeros((m,), jnp.int32),
+                payload=jnp.zeros((m, 2), jnp.int32))
+    return Built(fn=jax.jit(push_many), args=(q, evs))
+
+
+def _superstep_args(eng, mesh):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.mesh import shard_worlds
+
+    state = shard_worlds(eng.init(np.arange(SWEEP_WORLDS)), mesh)
+    return state, (jnp.int32(0), jnp.asarray(False),
+                   jnp.int32(SWEEP_K_MAX))
+
+
+def _build_superstep(min_one: bool) -> Built:
+    def build():
+        from ..parallel.sweep import sharded_superstep
+
+        eng, mesh = _bug_engine(), _mesh()
+        runner = sharded_superstep(eng, mesh, SWEEP_CHUNK_STEPS,
+                                   SWEEP_K_MAX, donate=True,
+                                   min_one=min_one)
+        state, scalars = _superstep_args(eng, mesh)
+        return Built(fn=runner, args=(state,) + scalars)
+    return build
+
+
+def _build_superstep_coverage() -> Built:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..obs.coverage import ledger_zeros
+    from ..parallel.mesh import scalar_spec, shard_worlds
+    from ..parallel.sweep import sharded_superstep
+
+    eng, mesh = _bug_engine(metrics=True), _mesh()
+    cov_k = 64
+    runner = sharded_superstep(eng, mesh, SWEEP_CHUNK_STEPS, SWEEP_K_MAX,
+                               donate=True, min_one=False, coverage=cov_k)
+    state = shard_worlds(eng.init(np.arange(SWEEP_WORLDS)), mesh)
+    hits, first = jax.device_put(ledger_zeros(cov_k),
+                                 NamedSharding(mesh, scalar_spec()))
+    idx = shard_worlds(jnp.arange(SWEEP_WORLDS, dtype=jnp.int32), mesh)
+    return Built(fn=runner, args=(
+        state, hits, first, idx, jnp.int32(SWEEP_WORLDS), jnp.int32(0),
+        jnp.asarray(False), jnp.int32(SWEEP_K_MAX)))
+
+
+def _build_endfold() -> Built:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..obs.coverage import ledger_zeros
+    from ..parallel.mesh import scalar_spec, shard_worlds
+    from ..parallel.sweep import _cov_endfolder
+
+    eng, mesh = _bug_engine(metrics=True), _mesh()
+    state = shard_worlds(eng.init(np.arange(SWEEP_WORLDS)), mesh)
+    hits, first = jax.device_put(ledger_zeros(64),
+                                 NamedSharding(mesh, scalar_spec()))
+    idx = shard_worlds(jnp.arange(SWEEP_WORLDS, dtype=jnp.int32), mesh)
+    return Built(fn=_cov_endfolder(eng, mesh), args=(
+        state, hits, first, idx, jnp.int32(SWEEP_WORLDS),
+        jnp.asarray(False)))
+
+
+def _build_compactor() -> Built:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.mesh import shard_worlds
+    from ..parallel.sweep import _compactor
+
+    eng, mesh = _bug_engine(), _mesh()
+    state = shard_worlds(eng.init(np.arange(SWEEP_WORLDS)), mesh)
+    idx = shard_worlds(jnp.arange(SWEEP_WORLDS, dtype=jnp.int32), mesh)
+    return Built(fn=_compactor(eng, mesh, SWEEP_WORLDS, SWEEP_WORLDS),
+                 args=(state, idx))
+
+
+def _build_refill_select() -> Built:
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _bug_engine()
+    mask = jnp.zeros((SWEEP_WORLDS,), bool)
+    fresh = eng.init(np.arange(SWEEP_WORLDS))
+    state = eng.init(np.arange(SWEEP_WORLDS))
+    return Built(fn=eng._refill_select, args=(mask, fresh, state))
+
+
+BRIDGE_SLOTS = 8
+BRIDGE_CAP = 16
+BRIDGE_K_EVENTS = 2
+BRIDGE_PAD = 4
+
+
+def _bridge_kernel():
+    if "bridge" not in _ENGINE_CACHE:
+        import numpy as np
+
+        from ..bridge.kernel import BridgeKernel
+
+        _ENGINE_CACHE["bridge"] = BridgeKernel(
+            np.arange(1, BRIDGE_SLOTS + 1), cap=BRIDGE_CAP,
+            k_events=BRIDGE_K_EVENTS)
+    return _ENGINE_CACHE["bridge"]
+
+
+def _bridge_batch_args(bk):
+    """A zero HostBatch at the kernel's bucketed pad shapes, with the
+    exact dtypes bridge/runtime.py feeds the jitted step."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..bridge.kernel import HostBatch
+
+    W, P = bk.W, BRIDGE_PAD
+    batch = HostBatch(
+        t_slot=np.zeros((W, P), np.int32), t_dl=np.zeros((W, P), np.int64),
+        t_seq=np.zeros((W, P), np.int64), t_mask=np.zeros((W, P), bool),
+        c_slot=np.zeros((W, P), np.int32), c_mask=np.zeros((W, P), bool),
+        s_ctr=np.zeros((W, P), np.uint64), s_base=np.zeros((W, P), np.int64),
+        s_slot=np.zeros((W, P), np.int32), s_seq=np.zeros((W, P), np.int64),
+        s_thr=np.zeros((W, P), np.uint64),
+        s_lossall=np.zeros((W, P), bool),
+        s_lat_lo=np.zeros((W, P), np.int64),
+        s_lat_w=np.ones((W, P), np.int64),
+        s_mask=np.zeros((W, P), bool), s_live=np.zeros((W, P), bool),
+        clock=np.zeros((W,), np.int64), advance=np.zeros((W,), bool))
+    return tuple(jnp.asarray(x) for x in batch)
+
+
+def _bridge_ctx():
+    bk = _bridge_kernel()
+
+    @contextlib.contextmanager
+    def ctx():
+        with bk._jax.default_device(bk.device), bk._enable_x64():
+            yield
+    return ctx
+
+
+def _build_bridge_step() -> Built:
+    bk = _bridge_kernel()
+    ctx = _bridge_ctx()
+    with ctx():
+        args = (bk.state, bk._mb, bk._net_k0, bk._net_k1) \
+            + _bridge_batch_args(bk)
+    return Built(fn=bk._fn, args=args, ctx=ctx)
+
+
+def _build_bridge_drain() -> Built:
+    bk = _bridge_kernel()
+    return Built(fn=bk._drain_fn, args=(bk.state, bk._mb),
+                 ctx=_bridge_ctx())
+
+
+def registry() -> Dict[str, TraceProgram]:
+    """Every hot-path program the sweep actually dispatches, by name.
+    Builders are lazy (nothing imports jax until a check runs)."""
+    progs = [
+        TraceProgram(
+            "engine.run", "DeviceEngine.run while-loop (donated step "
+            f"path, raft bug config, W={RUN_WORLDS})",
+            _build_engine_run, budget=True, donates=True,
+            unit_div=RUN_WORLDS),
+        TraceProgram(
+            "engine.push_many", "single-pass outbox insert (queue "
+            "scatter core of the step)", _build_push_many),
+        TraceProgram(
+            "engine.refill_select", "recycle-slot select (donated old "
+            "batch)", _build_refill_select, budget=True, donates=True),
+        TraceProgram(
+            "sweep.superstep", "pipelined superstep runner "
+            f"(W={SWEEP_WORLDS}, chunk_steps={SWEEP_CHUNK_STEPS}, "
+            f"k_max={SWEEP_K_MAX})", _build_superstep(False),
+            budget=True, donates=True),
+        TraceProgram(
+            "sweep.superstep_min_one", "superstep min_one variant (epoch-"
+            "first dispatch cadence)", _build_superstep(True),
+            budget=True, donates=True),
+        TraceProgram(
+            "sweep.superstep_coverage", "superstep with the retire-time "
+            "coverage fold (metrics on)", _build_superstep_coverage),
+        TraceProgram(
+            "sweep.coverage_endfold", "boundary coverage fold (resume "
+            "pre-pass / end-of-sweep)", _build_endfold),
+        TraceProgram(
+            "sweep.compactor", "on-device stable active-first compaction "
+            "(deliberately undonated: gather outputs cannot alias)",
+            _build_compactor, budget=True, donates=False),
+        TraceProgram(
+            "bridge.step", "bridge decision-kernel lockstep round "
+            f"(W={BRIDGE_SLOTS}, cap={BRIDGE_CAP})", _build_bridge_step,
+            x64="required", budget=True, donates=True),
+        TraceProgram(
+            "bridge.drain", "bridge pop-only drain round",
+            _build_bridge_drain, x64="required", budget=True,
+            donates=True),
+    ]
+    return {p.name: p for p in progs}
+
+
+# -- rule checks -------------------------------------------------------------
+
+def _x64_ctx():
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:  # pragma: no cover — newer jax
+        from jax.experimental import enable_x64 as ctx
+    return ctx
+
+
+def _finding(program: str, rule: str, msg: str) -> Finding:
+    r = RULES[rule]
+    return Finding(f"trace/{program}", 0, rule,
+                   f"{r.title}: {msg} — {r.suggestion}")
+
+
+def check_jaxpr_rules(name: str, jaxpr) -> List[Finding]:
+    """TRC001/TRC002 over one traced program."""
+    findings: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim in CALLBACK_PRIMS:
+            cb = eqn.params.get("callback")
+            what = f" ({cb!r})" if cb is not None else ""
+            findings.append(_finding(
+                name, "TRC001",
+                f"`{prim}` primitive{what}{_where(eqn)}"))
+        elif prim in NONDET_PRIMS:
+            findings.append(_finding(
+                name, "TRC002", f"`{prim}` primitive{_where(eqn)}"))
+        elif prim == "sort" and eqn.params.get("is_stable") is False:
+            # Equal keys then land in backend-chosen order.
+            findings.append(_finding(
+                name, "TRC002",
+                f"unstable `sort` (is_stable=False){_where(eqn)}"))
+        elif prim in SCATTER_ACCUM_PRIMS \
+                and not eqn.params.get("unique_indices", False):
+            import numpy as _np
+
+            dt = getattr(eqn.outvars[0].aval, "dtype", None)
+            if dt is not None and _np.issubdtype(dt, _np.floating):
+                findings.append(_finding(
+                    name, "TRC002",
+                    f"float `{prim}` without unique_indices (reduction "
+                    f"order is backend-chosen){_where(eqn)}"))
+    return findings
+
+
+def check_x64_invariance(name: str, prog: TraceProgram,
+                         built: Built) -> List[Finding]:
+    """TRC003: trace twice — plain and under ``enable_x64`` — and demand
+    identical output dtypes plus a float64-free x64 trace. (int64 index
+    arithmetic under x64 is exact and tolerated; float64 intermediates
+    round differently than the f32 they silently replace.)"""
+    import jax
+
+    findings: List[Finding] = []
+    tfn, targs = built.for_trace
+    with built.ctx():
+        base = jax.make_jaxpr(tfn)(*targs)
+    try:
+        with built.ctx(), _x64_ctx()():
+            wide = jax.make_jaxpr(tfn)(*targs)
+    except Exception as exc:  # the program cannot even trace under x64
+        return [_finding(name, "TRC003",
+                         f"fails to trace under jax_enable_x64: "
+                         f"{type(exc).__name__}: {exc}")]
+    b_out = [str(v.aval.dtype) for v in base.jaxpr.outvars]
+    w_out = [str(v.aval.dtype) for v in wide.jaxpr.outvars]
+    if b_out != w_out:
+        diff = [(a, b) for a, b in zip(b_out, w_out) if a != b][:4]
+        findings.append(_finding(
+            name, "TRC003",
+            f"output dtypes change with the x64 flag: {diff} "
+            f"({sum(a != b for a, b in zip(b_out, w_out))} outputs)"))
+    acc: set = set()
+    _aval_dtypes(wide.jaxpr, acc)
+    bad = sorted(d for d in acc if d in ("float64", "complex128"))
+    if bad:
+        findings.append(_finding(
+            name, "TRC003",
+            f"{'/'.join(bad)} intermediates appear under jax_enable_x64 "
+            "(an unpinned float dtype — f32 math silently widens)"))
+    return findings
+
+
+def check_trace_rules(name: str, prog: TraceProgram,
+                      built: Optional[Built] = None) -> List[Finding]:
+    """The trace-only rule families (no XLA compile): TRC001/002 on the
+    program's jaxpr, TRC003 via the dual trace for non-x64 programs."""
+    import jax
+
+    built = built or prog.build()
+    findings: List[Finding] = []
+    tfn, targs = built.for_trace
+    if prog.x64 == "required":
+        with built.ctx(), _x64_ctx()():
+            jaxpr = jax.make_jaxpr(tfn)(*targs)
+        findings.extend(check_jaxpr_rules(name, jaxpr.jaxpr))
+        acc: set = set()
+        _aval_dtypes(jaxpr.jaxpr, acc)
+        if "complex128" in acc:
+            findings.append(_finding(
+                name, "TRC003", "complex128 intermediates in an x64 "
+                "program"))
+    else:
+        with built.ctx():
+            jaxpr = jax.make_jaxpr(tfn)(*targs)
+        findings.extend(check_jaxpr_rules(name, jaxpr.jaxpr))
+        findings.extend(check_x64_invariance(name, prog, built))
+    return findings
+
+
+def measure_program(name: str, prog: TraceProgram,
+                    built: Optional[Built] = None) -> Dict[str, Any]:
+    """Fresh-compile one budget program and extract its ledger metrics
+    (:func:`budgets.measure_compiled`)."""
+    built = built or prog.build()
+    with built.ctx():
+        lowered = built.fn.lower(*built.args)
+        comp = _budgets.compile_fresh(lowered)
+        return _budgets.measure_compiled(comp, unit_div=prog.unit_div)
+
+
+# -- the pass entry ----------------------------------------------------------
+
+def run_trace(programs: Optional[List[str]] = None,
+              budget_check: bool = True,
+              ledger_path: Optional[str] = None,
+              ) -> Tuple[List[Finding], Dict[str, Dict[str, Any]]]:
+    """Run tracelint over the registered programs.
+
+    Returns ``(findings, measurements)``. Trace rules (TRC001-003) run on
+    every selected program; with ``budget_check`` the budget programs are
+    additionally compiled fresh and diffed against the ledger
+    (TRC004/BUD001/BUD002). Measurements are returned either way (empty
+    without ``budget_check``) so ``tools/update_budgets.py`` can reuse
+    this exact code path for regeneration.
+    """
+    regs = registry()
+    if programs:
+        unknown = [p for p in programs if p not in regs]
+        if unknown:
+            raise KeyError(f"unknown program(s): {unknown}; known: "
+                           f"{sorted(regs)}")
+        regs = {k: v for k, v in regs.items() if k in programs}
+    findings: List[Finding] = []
+    measured: Dict[str, Dict[str, Any]] = {}
+    for name, prog in regs.items():
+        try:
+            built = prog.build()
+        except Exception as exc:
+            findings.append(_finding(
+                name, "BUD002",
+                f"program failed to build: {type(exc).__name__}: {exc}"))
+            continue
+        findings.extend(check_trace_rules(name, prog, built))
+        if budget_check and prog.budget:
+            measured[name] = measure_program(name, prog, built)
+    if budget_check:
+        ledger = _budgets.load_ledger(ledger_path)
+        regs_all = registry() if programs else regs
+        findings.extend(_budgets.diff_ledger(
+            measured, ledger,
+            registered=sorted(regs_all) if not programs else None,
+            donates={k: v.donates for k, v in regs.items()}))
+    findings.sort(key=lambda f: (f.path, f.rule))
+    return findings, measured

@@ -572,11 +572,15 @@ class DeviceEngine:
                 # bit-identical to metrics-off on every other leaf.
                 i32 = jnp.int32
                 _n_req, n_inf, n_over = insert_metrics(t, enable, n_ins)
+                # dtype-pinned sums: under jax_enable_x64 a plain
+                # jnp.sum(i32) widens its accumulator to i64, which would
+                # make the metrics block's dtypes depend on a process
+                # flag (tracelint TRC003).
                 metrics = metrics._replace(
                     msgs_sent=metrics.msgs_sent + jnp.sum(
-                        (ob.valid & ~ob.is_timer & ws.active).astype(i32)),
+                        (ob.valid & ~ob.is_timer & ws.active), dtype=i32),
                     drop_loss=metrics.drop_loss + jnp.sum(
-                        (ob.valid & dropped & ws.active).astype(i32)),
+                        (ob.valid & dropped & ws.active), dtype=i32),
                     enqueued=metrics.enqueued + jnp.asarray(n_ins, i32),
                     drop_overflow=metrics.drop_overflow + n_over,
                     drop_inf=metrics.drop_inf + n_inf,
@@ -754,7 +758,8 @@ class DeviceEngine:
 
         def measure(s):
             any_bug = reduce_sum(jnp.any(s.bug).astype(jnp.int32)) > 0
-            n_active = reduce_sum(jnp.sum(s.active.astype(jnp.int32)))
+            # dtype-pinned: jnp.sum(i32) widens to i64 under x64 (TRC003).
+            n_active = reduce_sum(jnp.sum(s.active, dtype=jnp.int32))
             return any_bug, n_active
 
         stop_threshold = jnp.asarray(stop_threshold, jnp.int32)

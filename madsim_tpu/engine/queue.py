@@ -274,9 +274,11 @@ def insert_metrics(times, enable, n_inserted):
     invisibility contract of metrics-on runs).
     """
     en = jnp.asarray(enable, bool)
-    n_req = jnp.sum(en.astype(jnp.int32))
-    n_inf = jnp.sum((en & (jnp.asarray(times, jnp.int32) >= INF_TIME))
-                    .astype(jnp.int32))
+    # dtype-pinned sums: a bare jnp.sum would widen to i64 under the x64
+    # flag, leaking a process setting into the metrics dtypes (TRC003).
+    n_req = jnp.sum(en, dtype=jnp.int32)
+    n_inf = jnp.sum(en & (jnp.asarray(times, jnp.int32) >= INF_TIME),
+                    dtype=jnp.int32)
     return n_req, n_inf, n_req - n_inf - jnp.asarray(n_inserted, jnp.int32)
 
 

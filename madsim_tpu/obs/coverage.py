@@ -138,8 +138,11 @@ def fold_retired(hits, first_seen, mb, fold_mask, idx,
 
 
 def distinct_count(hits: jnp.ndarray) -> jnp.ndarray:
-    """Number of non-empty buckets — the ``distinct_behaviors`` scalar."""
-    return jnp.sum((hits > 0).astype(jnp.int32))
+    """Number of non-empty buckets — the ``distinct_behaviors`` scalar.
+    (dtype-pinned sum: a bare jnp.sum widens to i64 under the x64 flag,
+    which would break the i32 novelty-history carry — tracelint TRC003.)
+    """
+    return jnp.sum(hits > 0, dtype=jnp.int32)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,21 @@
+# tracelint: hot-loop
+"""Golden DET008/DET009 fixture: an orchestration loop that violates the
+counted-fetch sync discipline in every way the rules cover. The first-
+line marker opts the file into the hot-loop pass the real modules
+(parallel/sweep.py, fleet/worker.py, obs/observatory.py) get by path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_fetch = jax.device_get  # detlint: allow[DET008] reason=the fixture's sanctioned hook
+
+
+def loop(runner, state):
+    state, n_active = runner(state, jnp.int32(4))
+    n = int(n_active)                # DET009: un-fetched conversion
+    h = np.asarray(jnp.sum(state))   # DET008: inline materialization
+    v = state.item()                 # DET008: forced sync method
+    jax.block_until_ready(state)     # DET008: explicit barrier
+    n_h = _fetch(n_active)
+    ok = int(n_h)                    # clean: fetched first
+    return n, h, v, ok

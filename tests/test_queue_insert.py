@@ -275,10 +275,22 @@ def test_carried_depth_equals_recomputed_reduction():
 # Cost-model flops per world-step for the time_to_first_bug engine config
 # (3-node, queue_cap=64), measured via compiled.cost_analysis() on the CPU
 # backend. Measured 7727 after the single-pass insert landed (the
-# pre-rewrite step measured 21469 — a 2.8x reduction). Update this budget
-# IN THE SAME PR as any change that legitimately alters the step's op
-# count, with the new measurement in docs/perf.md.
-FLOPS_PER_WORLD_STEP_BUDGET = 9_000
+# pre-rewrite step measured 21469 — a 2.8x reduction). The budget now
+# lives in the checked-in ledger `madsim_tpu/analysis/budgets.json`
+# (engine.run entry) — ONE source of truth shared with `make tracelint`
+# — regenerated via `tools/update_budgets.py --reason '...'` IN THE SAME
+# PR as any change that legitimately alters the step's op count, with
+# the new measurement in docs/perf.md.
+from madsim_tpu.analysis import budgets as _budgets
+
+_LEDGER = _budgets.load_ledger()
+FLOPS_PER_WORLD_STEP_BUDGET = _budgets.budget_for(
+    _LEDGER, "engine.run", "flops_per_world")
+PEAK_OVER_STATE_BUDGET = _budgets.budget_for(
+    _LEDGER, "engine.run", "peak_over_arg")
+assert FLOPS_PER_WORLD_STEP_BUDGET and PEAK_OVER_STATE_BUDGET, (
+    "analysis/budgets.json lost its engine.run budgets — regenerate via "
+    "tools/update_budgets.py")
 
 
 def _bug_config_engine():
@@ -288,32 +300,13 @@ def _bug_config_engine():
     return DeviceEngine(RaftActor(rcfg), cfg)
 
 
-def _compile_fresh(lowered):
-    """Compile BYPASSING the persistent compilation cache (conftest.py):
-    an executable deserialized from the cache loses parts of its
-    cost/memory statistics (alias_size_in_bytes reads 0), which would
-    let the budget gates below silently pass-or-fail on cache state
-    instead of on the program. Fresh compiles keep the measurements
-    honest regardless of cache warmth. The cache singleton initializes
-    once per process and then ignores config updates, so it must be
-    reset around the config flip (and reset back after, so later tests
-    re-attach to the directory cache)."""
-    import jax
-
-    try:
-        from jax._src import compilation_cache as _cc
-        reset = _cc.reset_cache
-    except (ImportError, AttributeError):  # pragma: no cover — jax drift
-        reset = lambda: None  # noqa: E731
-
-    prev = jax.config.jax_compilation_cache_dir
-    reset()
-    jax.config.update("jax_compilation_cache_dir", None)
-    try:
-        return lowered.compile()
-    finally:
-        jax.config.update("jax_compilation_cache_dir", prev)
-        reset()
+# Compile BYPASSING the persistent compilation cache (conftest.py): an
+# executable deserialized from the cache loses parts of its cost/memory
+# statistics (alias_size_in_bytes reads 0), which would let the budget
+# gates below silently pass-or-fail on cache state instead of on the
+# program. The shared implementation lives in analysis/budgets.py, next
+# to the ledger the measurements feed.
+_compile_fresh = _budgets.compile_fresh
 
 
 def test_step_op_budget_regression():
@@ -346,10 +339,11 @@ def test_donated_run_peak_memory():
     peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
             + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
     ratio = peak / ma.argument_size_in_bytes
-    assert ratio <= 1.2, (
+    assert ratio <= PEAK_OVER_STATE_BUDGET, (
         f"donated-run peak is {ratio:.3f}x the argument state "
         f"(temp {ma.temp_size_in_bytes} B); the no-double-buffer "
-        "contract allows at most 1.2x")
+        f"contract (analysis/budgets.json engine.run) allows at most "
+        f"{PEAK_OVER_STATE_BUDGET}x")
 
 
 def test_run_donates_its_input_state():

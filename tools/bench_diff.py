@@ -182,6 +182,48 @@ def diff_table(old: dict, new: dict, old_name: str, new_name: str,
     return "\n".join(lines), regressions
 
 
+def ledger_rows(round_doc: dict, round_name: str) -> List[str]:
+    """Rows comparing the tracelint budget ledger (analysis/budgets.json
+    — what `make lint` enforces) against a bench round's recorded
+    `xla_cost` (what that round actually measured). The two are the same
+    program at possibly different shapes, so the per-world / ratio
+    figures are the comparable ones; a gap means the ledger is stale
+    relative to what benches run (regenerate via tools/update_budgets.py).
+    """
+    ledger_path = os.path.join(
+        REPO, "madsim_tpu", "analysis", "budgets.json")
+    if not os.path.exists(ledger_path):
+        return []
+    try:
+        with open(ledger_path, encoding="utf-8") as f:
+            ledger = json.load(f)
+    except ValueError:
+        return []
+    rows: List[str] = []
+    pairs = [
+        ("engine.run flops/world-step", "engine.run", "flops_per_world",
+         "configs.time_to_first_bug.xla_cost.flops_per_world_step"),
+        ("engine.run peak/state", "engine.run", "peak_over_arg",
+         "configs.time_to_first_bug.xla_cost.peak_over_state"),
+    ]
+    for label, prog, metric, round_path in pairs:
+        entry = ledger.get("programs", {}).get(prog, {}).get(metric)
+        if not isinstance(entry, dict):
+            continue
+        measured, budget = entry.get("measured"), entry.get("budget")
+        round_v = dig(round_doc, round_path)
+        gap = ""
+        if round_v is not None and measured:
+            pct = (round_v - measured) / abs(measured) * 100.0
+            gap = f"  round {round_v:,.4g} ({pct:+.1f}% vs ledger)"
+        rows.append(f"  {label:<28} ledger {measured:,.4g} "
+                    f"budget {budget:,.4g}{gap}")
+    if rows:
+        rows.insert(0, f"budget ledger (analysis/budgets.json) vs "
+                       f"{round_name}:")
+    return rows
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="regression table between two bench rounds")
@@ -210,6 +252,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         fail_pct=args.fail_on_regress)
     print(f"bench_diff: {old_path} -> {new_path}")
     print(table)
+    for row in ledger_rows(old, os.path.basename(old_path)):
+        print(row)
     if regressions:
         print(f"\n{len(regressions)} metric(s) regressed past "
               f"{args.fail_on_regress}%:", file=sys.stderr)

@@ -14,6 +14,7 @@ from madsim_tpu.analysis import main  # noqa: E402
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    if "--root" not in argv:
+    # The `trace` subcommand (pass 3) has no --root; hand it through.
+    if argv[:1] != ["trace"] and "--root" not in argv:
         argv = ["--root", _REPO] + argv
     sys.exit(main(argv))

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Regenerate (or verify) the tracelint cost-budget ledger.
+
+Usage::
+
+    python tools/update_budgets.py --reason "why the budgets moved"
+    python tools/update_budgets.py --check          # the CI/make gate
+    python tools/update_budgets.py --check --json
+
+Regeneration re-measures every budget-tracked hot-path program (fresh
+compiles — the persistent cache strips cost/alias statistics) and
+rewrites ``madsim_tpu/analysis/budgets.json``. Budgets RATCHET: an
+existing ceiling survives while the fresh measurement still fits under
+it; raising one requires the ``--reason`` line, which is recorded in the
+ledger so every budget bump carries its justification in-tree.
+
+``--check`` runs the full tracelint gate instead (trace rules + ledger
+diff) — exactly what ``make tracelint`` executes — and exits nonzero on
+any finding. CI uses this mode.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="verify instead of regenerate: run the full "
+                         "tracelint gate (rules + ledger diff)")
+    ap.add_argument("--reason", default=None,
+                    help="justification recorded in the ledger "
+                         "(required to regenerate)")
+    ap.add_argument("--budgets", default=None, metavar="PATH",
+                    help="ledger path (default: the in-package "
+                         "analysis/budgets.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --check: machine-readable findings")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        from madsim_tpu.analysis.cli import main_trace
+
+        trace_args = []
+        if args.budgets:
+            trace_args += ["--budgets", args.budgets]
+        if args.json:
+            trace_args += ["--json"]
+        elif args.format != "text":
+            trace_args += ["--format", args.format]
+        return main_trace(trace_args)
+
+    if not args.reason:
+        print("update_budgets: regenerating the ledger requires "
+              "--reason '...' (recorded as the justification line); "
+              "use --check to verify instead", file=sys.stderr)
+        return 2
+
+    from madsim_tpu.analysis import budgets as B
+    from madsim_tpu.analysis.tracelint import (measure_program, registry)
+
+    path = args.budgets or B.DEFAULT_LEDGER
+    try:
+        prev = B.load_ledger(path).get("programs", {})
+    except (FileNotFoundError, ValueError):
+        prev = {}
+
+    entries = {}
+    for name, prog in sorted(registry().items()):
+        if not prog.budget:
+            continue
+        print(f"measuring {name} (fresh compile)...", file=sys.stderr)
+        m = measure_program(name, prog)
+        entries[name] = B.make_entry(m, prog.title, prev.get(name))
+        for metric in B.CEILING_METRICS:
+            if metric in entries[name]:
+                e = entries[name][metric]
+                moved = (prev.get(name, {}).get(metric, {}).get("budget")
+                         != e["budget"])
+                print(f"  {metric:18s} measured {e['measured']:>14} "
+                      f"budget {e['budget']:>14}"
+                      f"{'  (budget moved)' if moved else ''}",
+                      file=sys.stderr)
+        af = entries[name]["alias_fraction"]
+        print(f"  {'alias_fraction':18s} measured {af['measured']:>14} "
+              f"min {af['min']:>14}", file=sys.stderr)
+    out = B.write_ledger(entries, args.reason, path)
+    print(f"update_budgets: wrote {len(entries)} program entries to {out}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
