@@ -9,7 +9,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint detlint tracelint test smoke dryrun determinism \
-        dualmode native clean replay-demo bench-diff chaos chaos-full
+        dualmode native clean replay-demo bench-diff chaos chaos-full \
+        triage-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -90,6 +91,16 @@ chaos:
 
 chaos-full:
 	$(CPU_ENV) $(PY) tools/chaos_matrix.py --process
+
+# End-to-end failure-triage workflow (docs/triage.md): inject the
+# known-minimal synthetic bug, hunt it with one pipelined sweep, dedupe
+# the failures into classes, batch-ddmin one representative per class
+# (must converge to EXACTLY the two load-bearing schedule rows), and
+# replay the minimized bundle through `python -m madsim_tpu.obs replay`
+# in a fresh process — nonzero exit unless the recorded failure
+# reproduces from the minimized schedule. CI runs this after chaos.
+triage-demo:
+	$(CPU_ENV) $(PY) tools/triage_demo.py
 
 # Regression table between two bench rounds (tools/bench_diff.py):
 # compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls

@@ -710,6 +710,49 @@ def bench_fleet_sweep(n_worlds: int) -> dict:
     return out
 
 
+def bench_minimize_bug(n_rows: int) -> dict:
+    """Batched ddmin schedule minimization on the known-minimal
+    synthetic bug (docs/triage.md; triage/synthetic.py): an ``n_rows``
+    restart schedule whose failure needs exactly two rows. Tracks the
+    minimizer's round/candidate economy and wall time round over round
+    (tools/bench_diff.py) — the metric is how cheaply a hunt's failure
+    turns into a 1-minimal repro, not seeds/s."""
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.triage import (PairRestartActor, PairRestartConfig,
+                                   minimize, pair_schedule)
+    from madsim_tpu.triage.synthetic import engine_config
+
+    acfg = PairRestartConfig()
+    cfg = engine_config(acfg)
+    eng = DeviceEngine(PairRestartActor(acfg), cfg)
+    need = (n_rows // 6, (2 * n_rows) // 3)
+    faults = pair_schedule(n_rows=n_rows, need=need, acfg=acfg)
+    kw = dict(engine=eng, chunk_steps=32, max_steps=4_000)
+
+    # Warmup: compiles every candidate-batch bucket the loop will use.
+    res = minimize(None, cfg, 7, faults, **kw)
+    t0 = walltime.perf_counter()
+    res = minimize(None, cfg, 7, faults, **kw)
+    dt = walltime.perf_counter() - t0
+
+    assert res.final_rows == 2 and res.one_minimal, res.summary()
+    assert (res.schedule == faults[list(need)]).all(), \
+        f"minimizer missed the known-minimal rows {need}"
+    out = {"n_rows": n_rows,
+           "final_rows": res.final_rows,
+           "rounds": res.rounds,
+           "candidates_evaluated": res.candidates_evaluated,
+           "one_minimal": bool(res.one_minimal),
+           "wall_s": round(dt, 3),
+           "candidates_per_sec": round(res.candidates_evaluated / dt, 1)
+           if dt > 0 else None,
+           "rounds_per_sec": round(res.rounds / dt, 2) if dt > 0 else None}
+    log(f"minimize_bug[{jax.default_backend()}]: {dt:.2f}s  {out}")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Cross-engine validation: TPU<->CPU bit-exactness
 # ---------------------------------------------------------------------------
@@ -1076,6 +1119,8 @@ _CONFIGS = [
      lambda a: bench_madraft_5node(256 if a.smoke else 100_000)),
     ("fleet", "fleet_sweep",
      lambda a: bench_fleet_sweep(128 if a.smoke else 4_096)),
+    ("minimize", "minimize_bug",
+     lambda a: bench_minimize_bug(16 if a.smoke else 64)),
     ("bridge", "bridge_sweep",
      lambda a: bench_bridge_sweep(n_host=16 if a.smoke else 64,
                                   n_bridge=64 if a.smoke else 512)),
@@ -1158,7 +1203,7 @@ def main() -> None:
     ap.add_argument("--host-seeds", type=int, default=None)
     ap.add_argument("--only", type=str, default=None,
                     help="comma list: 3node,rpc,rpc_real,grpc,postgres,"
-                         "5node,fleet,crosscheck,bug,bridge "
+                         "5node,fleet,minimize,crosscheck,bug,bridge "
                          "(3node = the headline)")
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "

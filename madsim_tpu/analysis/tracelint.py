@@ -305,6 +305,41 @@ def _build_refill_select() -> Built:
     return Built(fn=eng._refill_select, args=(mask, fresh, state))
 
 
+# Triage candidate-eval shape (triage/minimize.py): one batch of
+# candidate schedules of the known-minimal synthetic bug, evaluated by
+# the superstep runner compiled for the pair_restart engine — a
+# DISTINCT compiled program from sweep.superstep (different actor step),
+# and the hot path every minimization round dispatches.
+TRIAGE_CANDS = 32
+TRIAGE_ROWS = 16
+
+
+def _build_triage_candidate_eval() -> Built:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import DeviceEngine
+    from ..parallel.mesh import shard_worlds
+    from ..parallel.sweep import sharded_superstep
+    from ..triage.synthetic import (PairRestartActor, PairRestartConfig,
+                                    engine_config, pair_schedule)
+
+    if "triage_eng" not in _ENGINE_CACHE:
+        acfg = PairRestartConfig()
+        _ENGINE_CACHE["triage_eng"] = DeviceEngine(
+            PairRestartActor(acfg), engine_config(acfg))
+    eng, mesh = _ENGINE_CACHE["triage_eng"], _mesh()
+    runner = sharded_superstep(eng, mesh, SWEEP_CHUNK_STEPS, SWEEP_K_MAX,
+                               donate=True, min_one=False)
+    cands = np.broadcast_to(
+        pair_schedule(n_rows=TRIAGE_ROWS, need=(2, 11)),
+        (TRIAGE_CANDS, TRIAGE_ROWS, 4))
+    state = shard_worlds(
+        eng.init(np.full(TRIAGE_CANDS, 7, np.uint64), faults=cands), mesh)
+    return Built(fn=runner, args=(state, jnp.int32(0), jnp.asarray(False),
+                                  jnp.int32(SWEEP_K_MAX)))
+
+
 BRIDGE_SLOTS = 8
 BRIDGE_CAP = 16
 BRIDGE_K_EVENTS = 2
@@ -406,6 +441,11 @@ def registry() -> Dict[str, TraceProgram]:
             "sweep.compactor", "on-device stable active-first compaction "
             "(deliberately undonated: gather outputs cannot alias)",
             _build_compactor, budget=True, donates=False),
+        TraceProgram(
+            "triage.candidate_eval", "batched ddmin candidate sweep "
+            f"(C={TRIAGE_CANDS} candidate schedules x F={TRIAGE_ROWS} "
+            "rows over the pair_restart engine, docs/triage.md)",
+            _build_triage_candidate_eval, budget=True, donates=True),
         TraceProgram(
             "bridge.step", "bridge decision-kernel lockstep round "
             f"(W={BRIDGE_SLOTS}, cap={BRIDGE_CAP})", _build_bridge_step,

@@ -22,7 +22,11 @@ FoundationDB lineage):
    *virtual time* — never the wall clock (detlint-gated).
 3. **One-file repros** (:mod:`.bundle`): a failing run writes a JSON
    artifact (seed, config + hash, fault schedule, backend/batch knobs)
-   that ``python -m madsim_tpu.obs replay`` re-runs verbatim.
+   that ``python -m madsim_tpu.obs replay`` re-runs verbatim. Bundles
+   emitted by the failure-triage pipeline (:mod:`madsim_tpu.triage`,
+   docs/triage.md) carry the MINIMIZED fault schedule plus a
+   ``minimization`` provenance block (rounds, candidates, original→final
+   row counts, weakenings) — the replay contract is unchanged.
 
 Since the sweep observatory landed, the triad has a live fourth leg
 (docs/observability.md "The sweep observatory"): a behavior-coverage

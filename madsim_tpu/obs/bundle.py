@@ -61,14 +61,23 @@ def write_sweep_bundle(path: str, *, seed: int, actor: str,
                        max_steps: int = 2_000,
                        error: Optional[str] = None,
                        trace_path: Optional[str] = None,
+                       minimization: Optional[Dict[str, Any]] = None,
                        extra: Optional[Dict[str, Any]] = None) -> str:
     """Write a device-sweep repro bundle; returns the file path.
 
     ``path`` may be a directory (a ``repro-seed<seed>-<hash>.json`` name
     is chosen inside it). ``actor`` is a replay-registry name
-    (``raft``/``pb``/``tpc`` — obs/cli.py); configs are the dataclass
-    instances (or plain dicts) the sweep ran with; ``faults`` the
-    schedule rows for THIS seed ((F, 4), or None).
+    (``raft``/``pb``/``tpc``/``pair_restart`` — obs/cli.py); configs are
+    the dataclass instances (or plain dicts) the sweep ran with;
+    ``faults`` the schedule rows for THIS seed ((F, 4), or None).
+
+    ``minimization`` (triage/minimize.py ``MinimizeResult.provenance()``)
+    records how the recorded schedule was shrunk from the one the hunt
+    actually swept — rounds, candidates evaluated, original→final row
+    counts, weakenings applied (schema
+    ``madsim.triage.minimization/1``, docs/triage.md). When present,
+    ``faults`` should be the MINIMIZED rows: replay then reproduces the
+    failure from the minimal schedule, which is the point.
     """
     import numpy as np
 
@@ -91,6 +100,7 @@ def write_sweep_bundle(path: str, *, seed: int, actor: str,
         "max_steps": int(max_steps),
         "error": error,
         "trace_path": trace_path,
+        "minimization": minimization,
         "extra": dict(extra or {}),
     }
     return _write(bundle, path, f"repro-seed{int(seed)}")
@@ -103,6 +113,7 @@ def write_test_bundle(path: str, *, seed: int, test_id: Optional[str],
                       config_path: Optional[str] = None,
                       time_limit: Optional[float] = None,
                       error: Optional[str] = None,
+                      minimization: Optional[Dict[str, Any]] = None,
                       extra: Optional[Dict[str, Any]] = None) -> str:
     """Write a host-test repro bundle (a failing ``@madsim_tpu.test``);
     returns the file path. ``test_id`` is ``module:qualname`` of the
@@ -111,6 +122,9 @@ def write_test_bundle(path: str, *, seed: int, test_id: Optional[str],
     name — scripts run as ``__main__``); the ``env`` block is the exact
     ``MADSIM_TEST_*`` environment that reproduces the failure —
     including the backend/batch knobs a bridge-backend failure needs.
+    ``minimization`` (testing.py ``MADSIM_MINIMIZE=1``) records the
+    fault-model knob minimization: which non-default config rows the
+    failure actually needs, with the minimized config dict inside.
     """
     cfg_dict = None
     cfg_hash = None
@@ -140,6 +154,7 @@ def write_test_bundle(path: str, *, seed: int, test_id: Optional[str],
                                                   "backend": backend}),
         "env": env,
         "error": error,
+        "minimization": minimization,
         "extra": dict(extra or {}),
     }
     return _write(bundle, path, f"repro-seed{int(seed)}")

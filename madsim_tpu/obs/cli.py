@@ -45,11 +45,15 @@ from .timeline import dump_chrome, render_text, trace_to_chrome
 def _actor_registry() -> Dict[str, tuple]:
     from ..engine import (PBActor, PBDeviceConfig, RaftActor,
                           RaftDeviceConfig, TPCActor, TPCDeviceConfig)
+    from ..triage.synthetic import PairRestartActor, PairRestartConfig
 
     return {
         "raft": (RaftActor, RaftDeviceConfig),
         "pb": (PBActor, PBDeviceConfig),
         "tpc": (TPCActor, TPCDeviceConfig),
+        # The triage fixture actor (triage/synthetic.py): minimized
+        # corpus bundles from tests/demos replay through the same CLI.
+        "pair_restart": (PairRestartActor, PairRestartConfig),
     }
 
 

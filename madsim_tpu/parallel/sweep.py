@@ -282,6 +282,19 @@ def _cov_endfolder(eng: DeviceEngine, mesh: Mesh):
     return fn
 
 
+class TriageContext(NamedTuple):
+    """What :meth:`SweepResult.minimize` / ``triage.triage`` need to
+    re-execute worlds from this sweep: the engine (compiled programs and
+    all), the ORIGINAL fault schedule argument, and the mesh. Attached
+    to every locally-run SweepResult; absent (None) on results
+    reconstructed from checkpoints or merged across a fleet — those
+    must re-run the sweep to minimize."""
+
+    engine: Any                 # the DeviceEngine the sweep ran
+    faults: Optional[Any]       # the faults= argument, verbatim
+    mesh: Any                   # the mesh the sweep ran on
+
+
 class _Flight(NamedTuple):
     """One dispatched-but-unread superstep: its scalar futures plus the
     host-side facts (plan, width, epoch) needed to interpret them."""
@@ -455,10 +468,51 @@ class SweepResult:
     # ``novelty_curve`` (cumulative distinct behaviors, aligned
     # entrywise with ``n_active_history``/``n_active_chunks``).
     coverage: Optional[Any] = None
+    # Triage context (triage/): the engine/schedule/mesh refs
+    # :meth:`minimize` and ``triage.triage`` re-execute worlds with.
+    # None on reconstructed results (fleet merges, checkpoint loads).
+    triage_ctx: Optional[TriageContext] = dataclasses.field(
+        default=None, repr=False)
 
     @property
     def failing_seeds(self) -> List[int]:
         return [int(s) for s in self.seeds[self.bug]]
+
+    def minimize(self, seed: Optional[int] = None, **kw):
+        """Minimize a failing seed's fault schedule (triage/minimize.py).
+
+        ``seed`` defaults to the first failing seed; ``kw`` forwards to
+        :func:`madsim_tpu.triage.minimize` (``pipeline``, ``weaken``,
+        ``tighten``, ``chunk_steps``, ``max_steps``, ...). Re-uses this
+        sweep's engine — and its compiled programs — so the candidate
+        sweeps pay no fresh actor compile. Returns a
+        :class:`~madsim_tpu.triage.MinimizeResult` whose ``schedule``
+        is the smallest still-failing row set, 1-minimal and
+        deterministic (docs/triage.md)."""
+        from ..triage import TriageError
+        from ..triage import minimize as _minimize
+
+        if self.triage_ctx is None:
+            raise TriageError(
+                "this SweepResult carries no triage context (merged or "
+                "reconstructed result): re-run the sweep locally, or "
+                "call triage.minimize(actor, cfg, seed, faults) with "
+                "the original inputs")
+        if seed is None:
+            if not self.failing_seeds:
+                raise TriageError("no failing seeds to minimize")
+            seed = self.failing_seeds[0]
+        rows = np.flatnonzero(np.asarray(self.seeds) == np.uint64(seed))
+        if rows.size == 0:
+            raise TriageError(f"seed {seed} was not part of this sweep")
+        faults = self.triage_ctx.faults
+        if faults is not None:
+            faults = np.asarray(faults, np.int32)
+            if faults.ndim == 3:  # per-world schedules: this seed's rows
+                faults = faults[int(rows[0])]
+        eng = self.triage_ctx.engine
+        return _minimize(eng.actor, eng.cfg, int(seed), faults,
+                         engine=eng, mesh=self.triage_ctx.mesh, **kw)
 
     @property
     def metrics(self) -> Optional[Dict[str, Any]]:
@@ -722,10 +776,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     f"shared fault schedule must be (F, 4) rows of "
                     f"[time_us, op, a, b]; got shape {faults_p.shape}")
         elif faults_p.ndim == 3:
-            if faults_p.shape[0] != n or faults_p.shape[-1] != 4:
+            # Validate the leading dim EXPLICITLY against len(seeds):
+            # without this, a mismatched (m, F, 4) would silently gather
+            # via ``faults_p[ids]`` below — wrong-world schedules (m > n)
+            # or an IndexError deep in a refill (m < n) instead of a
+            # boundary error naming both dims.
+            if faults_p.shape[-1] != 4:
                 raise ValueError(
                     f"per-world fault schedules must be (n_seeds, F, 4) "
-                    f"with n_seeds={n}; got shape {faults_p.shape}")
+                    f"rows of [time_us, op, a, b]; got shape "
+                    f"{faults_p.shape}")
+            if faults_p.shape[0] != n:
+                raise ValueError(
+                    f"per-world fault schedules carry one (F, 4) block "
+                    f"per seed: got leading dim {faults_p.shape[0]} but "
+                    f"len(seeds)={n}")
             per_world_faults = True
             if n_ids > n:
                 faults_p = np.concatenate(
@@ -1341,7 +1406,10 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                          loop_stats=loop_stats,
                          faults_sha256=(seeds_meta["faults_sha256"]
                                         if faults is not None else None),
-                         coverage=coverage)
+                         coverage=coverage,
+                         triage_ctx=TriageContext(engine=eng,
+                                                  faults=faults,
+                                                  mesh=mesh))
     if emit_telemetry is not None:
         final = {
             "schema": "madsim.sweep.telemetry/1",

@@ -21,6 +21,15 @@ Environment variables (same names as the reference):
   worlds; seeds stream through recycled kernel slots
   (``bridge.sweep(batch=...)``), so a million-seed sweep runs in bounded
   memory with unchanged per-seed trajectories.
+- ``MADSIM_MINIMIZE`` — off by default. When set, a failing seed's
+  fault model is MINIMIZED before the repro bundle is written: each
+  non-default config knob (net loss, net latency, fs latency) becomes
+  one schedule row, and the triage ddmin loop (triage/minimize.py, the
+  same algebra the device sweeps use) re-runs the failing seed against
+  candidate subsets until the row set is 1-minimal — the banner logs
+  the row-count reduction, and the ``MADSIM_REPRO_DIR`` bundle gains a
+  ``minimization`` block naming the knobs the failure actually needs
+  (docs/triage.md).
 
 On failure the driver prints the repro banner with the failing seed and the
 config hash (`runtime/mod.rs:192-199`).
@@ -44,6 +53,45 @@ from typing import Any, Callable, Coroutine, Optional
 from .core.config import Config
 from .core.runtime import Runtime, init_logger
 
+# Fault-model knob rows (MADSIM_MINIMIZE, docs/triage.md): each
+# non-default Config knob maps to one opaque schedule row
+# ``[0, _KNOB_OP_BASE + index, 0, 0]`` so the triage ddmin loop
+# (triage/minimize.py minimize_rows) can drop/keep knobs with the exact
+# machinery the device schedules use; the kept row indices map back to
+# the ORIGINAL Python knob values (no int round-trip — the oracle reruns
+# the exact failing config minus dropped knobs).
+_KNOB_OP_BASE = 100
+_KNOBS = (
+    ("net.packet_loss_rate",
+     lambda c: c.net.packet_loss_rate,
+     lambda c, v: setattr(c.net, "packet_loss_rate", v)),
+    ("net.send_latency",
+     lambda c: tuple(c.net.send_latency),
+     lambda c, v: setattr(c.net, "send_latency", tuple(v))),
+    ("fs.io_latency",
+     lambda c: tuple(c.fs.io_latency),
+     lambda c, v: setattr(c.fs, "io_latency", tuple(v))),
+)
+
+
+def _knob_rows(config: Config):
+    """(knob index, name, value) for every knob differing from the
+    default fault model — the 'schedule rows' of a host test."""
+    default = Config()
+    return [(i, name, get(config))
+            for i, (name, get, _set) in enumerate(_KNOBS)
+            if get(config) != get(default)]
+
+
+def _config_from_rows(config: Config, kept_idx) -> Config:
+    """A default-model Config with only the kept knobs re-applied from
+    ``config`` (the candidate the minimization oracle re-runs)."""
+    out = Config()
+    for i in kept_idx:
+        name, get, set_ = _KNOBS[i]
+        set_(out, get(config))
+    return out
+
 
 class Builder:
     """Seed-sweep driver for simulation tests."""
@@ -51,7 +99,8 @@ class Builder:
     def __init__(self, seed: Optional[int] = None, count: int = 1, jobs: int = 1,
                  config: Optional[Config] = None, config_path: Optional[str] = None,
                  time_limit: Optional[float] = None, check_determinism: bool = False,
-                 backend: str = "host", batch: Optional[int] = None):
+                 backend: str = "host", batch: Optional[int] = None,
+                 minimize: bool = False):
         # Wall-clock default seed (the reference's builder does the same):
         # deliberate nondeterminism, made reproducible by the up-front
         # banner in run() that logs the chosen seed.
@@ -71,6 +120,11 @@ class Builder:
         if batch is not None and batch < 1:
             raise ValueError("batch must be >= 1")
         self.batch = batch
+        # MADSIM_MINIMIZE: ddmin the fault-model knobs of a failing seed
+        # before bundling (docs/triage.md). Costs one re-run per
+        # candidate knob subset, so strictly opt-in.
+        self.minimize = bool(minimize)
+        self._minimize_coro: Optional[Callable[[], Coroutine]] = None
         # ``module:qualname`` of the decorated test, when driven through
         # @test/@main — repro bundles (obs/bundle.py) record it so the
         # CLI can re-import and re-run the exact entry point. test_file
@@ -96,11 +150,12 @@ class Builder:
                 config = Config.from_toml(f.read())
         batch = int(env["MADSIM_TEST_BATCH"]) if "MADSIM_TEST_BATCH" in env \
             else None
+        minimize = env.get("MADSIM_MINIMIZE", "") not in ("", "0", "false")
         return Builder(seed=seed, count=count, jobs=jobs, config=config,
                        config_path=config_path, time_limit=time_limit,
                        check_determinism=check,
                        backend=env.get("MADSIM_TEST_BACKEND", "host"),
-                       batch=batch)
+                       batch=batch, minimize=minimize)
 
     def _run_one(self, seed: int, make_coro: Callable[[], Coroutine]) -> Any:
         config = copy.deepcopy(self.config) if self.config is not None else None
@@ -135,6 +190,9 @@ class Builder:
                 return asyncio.run(_limited())
             return asyncio.run(coro)
 
+        # Kept for MADSIM_MINIMIZE: the failure-time banner re-runs the
+        # failing seed under candidate fault models through this factory.
+        self._minimize_coro = make_coro
         if self.seed_from_walltime:
             # The seed came from the wall clock: log it BEFORE running, so
             # even a hang/SIGKILL (no failure banner) leaves a repro line.
@@ -166,6 +224,77 @@ class Builder:
                     result = fut.result()
         return result
 
+    def _minimize_fault_model(self, seed: int,
+                              error: BaseException) -> Optional[dict]:
+        """MADSIM_MINIMIZE: ddmin the non-default fault-model knobs.
+
+        Each knob is one opaque schedule row; the oracle re-runs the
+        failing seed under the candidate config (default model + kept
+        knobs) and asks "same exception type?" — exact, because the
+        simulation is deterministic per (seed, config). Returns the
+        bundle ``minimization`` block, or None when there is nothing to
+        minimize / the failure did not re-reproduce (never raises: a
+        minimization problem must not mask the original failure).
+        """
+        import numpy as np
+
+        from .triage.minimize import TriageError, minimize_rows
+
+        config = self.config if self.config is not None else Config()
+        rows = _knob_rows(config)
+        if not rows or self._minimize_coro is None:
+            return None
+        make_coro = self._minimize_coro
+        err_name = type(error).__name__
+        sched0 = np.zeros((len(rows), 4), np.int32)
+        for r, (i, _name, _val) in enumerate(rows):
+            sched0[r, 1] = _KNOB_OP_BASE + i
+
+        def still_fails(cand: np.ndarray) -> bool:
+            kept = [int(cand[r, 1]) - _KNOB_OP_BASE
+                    for r in range(cand.shape[0]) if cand[r, 0] >= 0]
+            cfg = _config_from_rows(config, kept)
+
+            def body(_seed):
+                # Runtime built INSIDE the isolation thread, exactly like
+                # the driver's own per-seed runs (`builder.rs:123`).
+                rt = Runtime(seed=seed, config=cfg)
+                if self.time_limit is not None:
+                    rt.set_time_limit(self.time_limit)
+                return rt.block_on(make_coro())
+
+            try:
+                _run_on_thread(body, seed)
+            except BaseException as exc:  # noqa: BLE001 — the oracle
+                return type(exc).__name__ == err_name
+            return False
+
+        def evaluate(cands):
+            return np.array([still_fails(c) for c in cands], bool)
+
+        try:
+            final, stats = minimize_rows(sched0, evaluate, weaken=False,
+                                         tighten=False, max_rounds=32)
+        except TriageError:
+            return None  # failure did not re-reproduce under re-run
+        kept = sorted(int(final[r, 1]) - _KNOB_OP_BASE
+                      for r in range(final.shape[0]) if final[r, 0] >= 0)
+        names = {i: name for i, name, _v in rows}
+        return {
+            "schema": "madsim.triage.minimization/1",
+            "kind": "fault_model_knobs",
+            "seed": int(seed),
+            "rounds": int(stats["rounds"]),
+            "candidates_evaluated": int(stats["candidates_evaluated"]),
+            "original_rows": len(rows),
+            "final_rows": len(kept),
+            "one_minimal": bool(stats["one_minimal"]),
+            "kept_knobs": [names[i] for i in kept],
+            "dropped_knobs": [name for i, name, _v in rows
+                              if i not in kept],
+            "minimized_config": _config_from_rows(config, kept).to_dict(),
+        }
+
     def _print_banner(self, seed: int,
                       error: Optional[BaseException] = None) -> None:
         import hashlib
@@ -193,6 +322,19 @@ class Builder:
             f"note: fault-schedule digest: MADSIM_FAULT_SHA={fault_digest}\n"
             f"note: backend: {env_line}"
         )
+        minimization = None
+        if self.minimize and error is not None:
+            minimization = self._minimize_fault_model(seed, error)
+            if minimization is not None:
+                kept = minimization["kept_knobs"]
+                banner += (
+                    "\nnote: fault-model minimization (MADSIM_MINIMIZE): "
+                    f"{minimization['original_rows']} knob row(s) -> "
+                    f"{minimization['final_rows']} in "
+                    f"{minimization['rounds']} round(s), "
+                    f"{minimization['candidates_evaluated']} candidates; "
+                    + ("failure needs: " + ", ".join(kept) if kept
+                       else "failure is fault-model-independent"))
         repro_dir = os.environ.get("MADSIM_REPRO_DIR")
         if repro_dir:
             try:
@@ -206,7 +348,8 @@ class Builder:
                     config=self.config, config_path=self.config_path,
                     time_limit=self.time_limit,
                     error=(f"{type(error).__name__}: {error}"
-                           if error is not None else None))
+                           if error is not None else None),
+                    minimization=minimization)
                 banner += (f"\nnote: repro bundle written: {path} "
                            "(replay: python -m madsim_tpu.obs replay "
                            f"--bundle {path})")

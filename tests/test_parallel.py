@@ -118,9 +118,18 @@ def test_sweep_rejects_misshaped_faults():
     with pytest.raises(ValueError, match=r"\(F, 4\)"):
         sweep(None, ECFG, seeds, engine=eng,
               faults=np.zeros(4, np.int32), max_steps=64)
-    with pytest.raises(ValueError, match="per-world fault schedules"):
+    # Mismatched leading dim: without the boundary check this would
+    # silently gather wrong-world schedules via faults_p[ids] (m > n)
+    # or IndexError deep inside a refill (m < n) — the error must name
+    # BOTH dims so the caller sees which input is off.
+    with pytest.raises(ValueError,
+                       match=r"leading dim 5.*len\(seeds\)=12"):
         sweep(None, ECFG, seeds, engine=eng,
               faults=np.zeros((5, 2, 4), np.int32), max_steps=64)
+    with pytest.raises(ValueError,
+                       match=r"leading dim 24.*len\(seeds\)=12"):
+        sweep(None, ECFG, seeds, engine=eng,
+              faults=np.zeros((24, 2, 4), np.int32), max_steps=64)
     with pytest.raises(ValueError, match="per-world fault schedules"):
         sweep(None, ECFG, seeds, engine=eng,
               faults=np.zeros((12, 2, 5), np.int32), max_steps=64)

@@ -1,0 +1,490 @@
+"""Failure triage: batched ddmin minimizer + deduplicated corpus
+(madsim_tpu/triage/, docs/triage.md).
+
+The load-bearing contracts pinned here:
+
+- ddmin CONVERGENCE on a known-minimal case: a synthetic actor whose
+  bug requires exactly rows {5, 20} of a 32-row schedule minimizes to
+  exactly those two rows, 1-minimal (every single-row drop verified to
+  stop failing).
+- DETERMINISM: re-running yields a bitwise-identical minimized schedule
+  and identical round history; pipelined and serial candidate sweeps
+  agree bitwise.
+- BATCHING: each round's candidate evaluation is ONE sweep (counted
+  through both the sweep-call seam and the parallel.sweep ``_fetch``
+  hook) — never a per-candidate loop.
+- CORPUS: k injected distinct failure classes dedupe to exactly k
+  entries, keyed by the device-parity behavior signature; each class's
+  minimized bundle round-trips through obs/bundle.py and replays to the
+  recorded failure.
+- HOST TWIN: MADSIM_MINIMIZE ddmins the fault-model knob rows of a
+  failing ``@madsim_tpu.test`` before bundling.
+"""
+import importlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+# The package re-exports the sweep FUNCTION as an attribute named like
+# the submodule; resolve the module itself for the monkeypatch seams.
+sweep_mod = importlib.import_module("madsim_tpu.parallel.sweep")
+from madsim_tpu.engine import DeviceEngine
+from madsim_tpu.engine.core import FAULT_KILL, FAULT_PAUSE, FAULT_SET_LOSS
+from madsim_tpu.parallel.sweep import sweep
+from madsim_tpu.triage import (
+    FailureClass,  # noqa: F401  (public-surface import check)
+    MinimizeResult,
+    PairRestartActor,
+    PairRestartConfig,
+    TriageError,
+    behavior_signatures,
+    failure_classes,
+    minimize,
+    minimize_rows,
+    pair_schedule,
+    triage,
+)
+from madsim_tpu.triage import shrink
+from madsim_tpu.triage.synthetic import engine_config
+
+ACFG = PairRestartConfig()
+
+
+@pytest.fixture(scope="module")
+def pair_eng():
+    return DeviceEngine(PairRestartActor(ACFG), engine_config(ACFG))
+
+
+@pytest.fixture(scope="module")
+def pair_eng_m():
+    return DeviceEngine(PairRestartActor(ACFG),
+                        engine_config(ACFG, metrics=True))
+
+
+MIN_KW = dict(chunk_steps=32, max_steps=4_000)
+
+
+# ---------------------------------------------------------------------------
+# the schedule algebra (shrink.py) — pure host-side units
+# ---------------------------------------------------------------------------
+
+def test_shrink_candidates_and_cost_order():
+    rows = np.array([[1_000, FAULT_KILL, 1, 0],
+                     [2_000, FAULT_SET_LOSS, 500_000, 0],
+                     [3_000, FAULT_PAUSE, 2, 0]], np.int32)
+    # Subsets at k=2: two keep-chunks, no complements (they coincide).
+    pairs = shrink.subset_candidates(rows, 2)
+    assert [p[0] for p in pairs] == ["subset:0/2", "subset:1/2"]
+    # k=3 adds the complements — exactly the single-row drops.
+    pairs = shrink.subset_candidates(rows, 3)
+    assert sum(p[0].startswith("complement") for p in pairs) == 3
+    # Weakenings: kill->pause and loss->0, canonical order + strictly
+    # cheaper under the total cost order.
+    weak = shrink.weaken_candidates(rows)
+    assert [w[0] for w in weak] == ["weaken:0:kill->pause",
+                                   "weaken:1:loss->0"]
+    for _label, cand in weak:
+        assert shrink.schedule_cost(cand) < shrink.schedule_cost(rows)
+    # Tightening halves fire times, strictly cheaper too.
+    tight = shrink.tighten_candidates(rows)
+    assert len(tight) == 3
+    assert int(tight[0][1][0, 0]) == 500
+    # Dropping rows dominates everything: fewest-rows-first.
+    dropped = shrink.keep_rows(rows, np.array([0]))
+    assert shrink.schedule_cost(dropped) < shrink.schedule_cost(weak[0][1])
+    # Normalization canonicalizes disabled rows (bitwise tie-break).
+    messy = rows.copy()
+    messy[1] = [-7, 3, 9, 9]
+    assert (shrink.normalize(messy)[1] == shrink.DISABLED_ROW).all()
+
+
+def test_minimize_rows_weaken_phase_pure_oracle():
+    """The generic loop adopts a severity weakening when dropping the
+    row is impossible: oracle = 'fails iff row 0 is live with op KILL
+    or PAUSE' -> ddmin keeps row 0, weaken turns KILL into PAUSE."""
+    rows = np.array([[1_000, FAULT_KILL, 1, 0],
+                     [2_000, FAULT_KILL, 2, 0]], np.int32)
+
+    def evaluate(cands):
+        return np.array([c[0, 0] >= 0 and int(c[0, 1]) in
+                         (FAULT_KILL, FAULT_PAUSE) for c in cands], bool)
+
+    final, stats = minimize_rows(rows, evaluate, weaken=True)
+    live = shrink.compact(final)
+    assert live.shape == (1, 4)
+    assert int(live[0, 1]) == FAULT_PAUSE
+    assert stats["weakenings"] == ["weaken:0:kill->pause"]
+    assert stats["one_minimal"]
+
+
+def test_minimize_rows_rejects_non_failing():
+    rows = np.array([[1_000, FAULT_KILL, 1, 0]], np.int32)
+    with pytest.raises(TriageError, match="does not fail"):
+        minimize_rows(rows, lambda cands: np.zeros(len(cands), bool))
+
+
+# ---------------------------------------------------------------------------
+# batched device minimization (minimize.py)
+# ---------------------------------------------------------------------------
+
+def test_ddmin_converges_to_known_minimal_pair(pair_eng):
+    """The acceptance case: bug needs exactly rows {5, 20} of a 32-row
+    schedule -> the minimizer returns exactly those two rows and the
+    1-minimality check passes (ground-truthed below by direct runs)."""
+    rows = pair_schedule(n_rows=32, need=(5, 20), acfg=ACFG)
+    res = minimize(None, pair_eng.cfg, 7, rows, engine=pair_eng, **MIN_KW)
+    assert isinstance(res, MinimizeResult)
+    assert res.original_rows == 32
+    assert res.final_rows == 2
+    assert (res.schedule == rows[[5, 20]]).all()
+    assert res.one_minimal
+    # Ground truth for the 1-minimality claim: each single row alone
+    # does NOT fail, both together DO.
+    for keep in ([5], [20], [5, 20]):
+        obs = pair_eng.observe(pair_eng.run(
+            pair_eng.init(np.asarray([7], np.uint64),
+                          faults=rows[keep][None]), max_steps=4_000))
+        assert bool(obs["bug"][0]) == (keep == [5, 20])
+    # Provenance block: the bundle schema the corpus embeds.
+    prov = res.provenance()
+    assert prov["schema"] == "madsim.triage.minimization/1"
+    assert (prov["original_rows"], prov["final_rows"]) == (32, 2)
+    assert prov["rounds"] == res.rounds > 3
+    assert prov["candidates_evaluated"] == res.candidates_evaluated \
+        > res.rounds  # batched: strictly more candidates than sweeps
+    assert prov["one_minimal"] is True
+
+
+def test_minimize_bitwise_deterministic_and_pipeline_agnostic(pair_eng):
+    """Determinism gate: same (seed, schedule) -> bitwise-identical
+    minimized schedule across two runs AND across pipeline=True/False,
+    with identical round histories."""
+    rows = pair_schedule(n_rows=16, need=(3, 12), acfg=ACFG)
+    runs = [minimize(None, pair_eng.cfg, 11, rows, engine=pair_eng,
+                     pipeline=p, **MIN_KW)
+            for p in (True, True, False)]
+    a, b, c = runs
+    assert (a.full == b.full).all() and (a.full == c.full).all()
+    assert (a.schedule == b.schedule).all()
+    assert a.rounds == b.rounds == c.rounds
+    assert a.candidates_evaluated == b.candidates_evaluated \
+        == c.candidates_evaluated
+    assert a.history == b.history == c.history
+    assert (a.schedule == rows[[3, 12]]).all()
+
+
+def test_each_round_is_one_sweep_no_per_candidate_loop(pair_eng,
+                                                       monkeypatch):
+    """BATCHING contract: candidate evaluation dispatches ONE sweep per
+    round — counted at the sweep-call seam AND via the parallel.sweep
+    ``_fetch`` hook (host pulls must scale with rounds, not with the
+    candidate count)."""
+    sweep_calls = []
+    real_sweep = sweep_mod.sweep
+
+    def counting_sweep(actor, cfg, seeds, **kw):
+        sweep_calls.append(len(np.asarray(seeds)))
+        return real_sweep(actor, cfg, seeds, **kw)
+
+    fetches = []
+    real_fetch = sweep_mod._fetch
+
+    def counting_fetch(tree):
+        fetches.append(1)
+        return real_fetch(tree)
+
+    monkeypatch.setattr(sweep_mod, "sweep", counting_sweep)
+    monkeypatch.setattr(sweep_mod, "_fetch", counting_fetch)
+
+    rows = pair_schedule(n_rows=32, need=(5, 20), acfg=ACFG)
+    res = minimize(None, pair_eng.cfg, 7, rows, engine=pair_eng, **MIN_KW)
+    # One sweep per round, every candidate of the round inside it.
+    assert len(sweep_calls) == res.rounds
+    assert sum(sweep_calls) >= res.candidates_evaluated
+    # Host pulls scale with rounds (a few per sweep: scalar batches +
+    # the final merge), NEVER with the candidate count.
+    assert len(fetches) <= 8 * res.rounds
+    assert res.candidates_evaluated > res.rounds  # batching was real
+
+
+def test_schedule_independent_failure_minimizes_to_empty(pair_eng):
+    """A bug that fires regardless of the schedule short-circuits to
+    zero rows in the first round (the 'empty' probe)."""
+
+    class AlwaysBug(PairRestartActor):
+        def invariant(self, cfg, s):
+            return s["restarts"][..., 0] >= 0  # tautology
+
+    eng = DeviceEngine(AlwaysBug(ACFG), engine_config(ACFG))
+    rows = pair_schedule(n_rows=4, need=(0, 3), acfg=ACFG)
+    res = minimize(None, eng.cfg, 3, rows, engine=eng, **MIN_KW)
+    assert res.final_rows == 0
+    assert res.one_minimal
+    assert res.rounds == 2  # verify-original (+empty) and verify-1min
+
+
+def test_minimize_rejects_non_failing_seed(pair_eng):
+    # Schedule lacking the node_b restart: never fails.
+    rows = pair_schedule(n_rows=8, need=(1, 6), acfg=ACFG)
+    rows[6, 2] = 0
+    with pytest.raises(TriageError, match="does not fail"):
+        minimize(None, pair_eng.cfg, 7, rows, engine=pair_eng, **MIN_KW)
+
+
+def test_tighten_phase_halves_times_deterministically(pair_eng):
+    """Opt-in fire-time tightening: the pair bug is time-insensitive,
+    so tightening walks both surviving rows' times to 0 — still
+    failing, still 2 rows, bitwise reproducible."""
+    rows = pair_schedule(n_rows=4, need=(0, 3), acfg=ACFG,
+                         t0_us=4, dt_us=4)
+    res = minimize(None, pair_eng.cfg, 5, rows, engine=pair_eng,
+                   tighten=True, **MIN_KW)
+    res2 = minimize(None, pair_eng.cfg, 5, rows, engine=pair_eng,
+                    tighten=True, **MIN_KW)
+    assert res.final_rows == 2
+    assert (res.schedule[:, 0] == 0).all()
+    assert [w.startswith("tighten:") for w in res.weakenings].count(True) \
+        == len(res.weakenings) > 0
+    assert (res.full == res2.full).all()
+    assert res.history == res2.history
+
+
+def test_sweep_result_minimize_roundtrip(pair_eng):
+    """SweepResult.minimize(seed) slices the per-world schedule and
+    reuses the sweep's engine; equals a direct triage.minimize call."""
+    n = 8
+    rows = pair_schedule(n_rows=8, need=(1, 6), acfg=ACFG)
+    faults = np.broadcast_to(rows, (n, 8, 4)).copy()
+    faults[1::2, 6, 2] = 0  # odd seeds: decoy schedules, must pass
+    res = sweep(None, pair_eng.cfg, np.arange(n), faults=faults,
+                engine=pair_eng, chunk_steps=32, max_steps=4_000)
+    assert res.failing_seeds == [0, 2, 4, 6]
+    mr = res.minimize(**MIN_KW)           # defaults to first failing seed
+    direct = minimize(None, pair_eng.cfg, 0, rows, engine=pair_eng,
+                      **MIN_KW)
+    assert mr.seed == 0
+    assert (mr.full == direct.full).all()
+    assert (mr.schedule == rows[[1, 6]]).all()
+    with pytest.raises(TriageError, match="not part of this sweep"):
+        res.minimize(seed=999, **MIN_KW)
+
+
+def test_merged_results_carry_no_triage_ctx():
+    """Fleet-merged / reconstructed SweepResults must refuse to
+    minimize with a pointed error instead of recomputing nonsense."""
+    from madsim_tpu.parallel.sweep import SweepResult
+
+    bare = SweepResult(seeds=np.arange(2, dtype=np.uint64),
+                       bug=np.array([True, False]),
+                       observations={"bug": np.array([True, False])},
+                       steps_run=0, n_devices=1)
+    assert bare.triage_ctx is None
+    with pytest.raises(TriageError, match="no triage context"):
+        bare.minimize()
+
+
+# ---------------------------------------------------------------------------
+# corpus dedup + bundles (corpus.py)
+# ---------------------------------------------------------------------------
+
+def _k_class_sweep(eng, n=24):
+    """A sweep with exactly 3 distinct failure classes: per-world
+    schedules of 2 / 4 / 8 live restart rows (all containing the pair),
+    whose power-of-two fault_hist buckets differ."""
+    F = 8
+    faults = np.full((n, F, 4), -1, np.int32)
+    for w in range(n):
+        k = (2, 4, 8)[w % 3]
+        faults[w, :k] = pair_schedule(n_rows=k, need=(0, k - 1), acfg=ACFG)
+    return sweep(None, eng.cfg, np.arange(n), faults=faults, engine=eng,
+                 chunk_steps=32, max_steps=4_000), faults
+
+
+def test_k_injected_classes_dedupe_to_exactly_k(pair_eng_m):
+    res, _faults = _k_class_sweep(pair_eng_m)
+    assert len(res.failing_seeds) == 24
+    classes = failure_classes(res)
+    assert len(classes) == 3          # k classes -> exactly k entries
+    assert [c.representative for c in classes] == [0, 1, 2]
+    assert sorted(sum((list(c.seeds) for c in classes), [])) \
+        == list(range(24))
+    assert all(c.invariant_id == "pair_restart_conjunction"
+               for c in classes)
+    # Deterministic: identical keys on a re-run of the same sweep.
+    res2, _ = _k_class_sweep(pair_eng_m)
+    assert [c.key for c in failure_classes(res2)] \
+        == [c.key for c in classes]
+
+
+def test_corpus_signature_matches_device_behavior_signature(pair_eng_m):
+    """Host-side corpus signatures equal the device coverage fold's
+    behavior_signature bit for bit (same columns, bucketing, FNV)."""
+    import jax.numpy as jnp
+
+    from madsim_tpu.obs.coverage import behavior_signature
+    from madsim_tpu.obs.metrics import MetricsBlock
+
+    res, _faults = _k_class_sweep(pair_eng_m)
+    per_seed = res.metrics["per_seed"]
+    host = behavior_signatures(per_seed)
+    mb = MetricsBlock(**{f: jnp.asarray(per_seed[f])
+                         for f in MetricsBlock._fields})
+    dev = np.asarray(behavior_signature(mb))
+    assert (host == dev).all()
+
+
+def test_triage_requires_metrics(pair_eng):
+    res = sweep(None, pair_eng.cfg, np.arange(4),
+                faults=pair_schedule(n_rows=4, need=(0, 3), acfg=ACFG),
+                engine=pair_eng, chunk_steps=32, max_steps=4_000)
+    with pytest.raises(ValueError, match="metrics=True"):
+        failure_classes(res)
+
+
+def test_triage_emits_minimized_bundles_that_replay(pair_eng_m, tmp_path):
+    """triage(): one bundle per class, carrying the MINIMIZED rows and
+    the minimization provenance block; replaying the bundle's schedule
+    through the engine reproduces the recorded failure (the CLI leg of
+    this contract runs in `make triage-demo`)."""
+    from madsim_tpu.obs.bundle import load_bundle
+
+    res, _faults = _k_class_sweep(pair_eng_m)
+    report = triage(res, out_dir=str(tmp_path), **MIN_KW)
+    assert len(report.classes) == len(report.bundles) == 3
+    for fc in report.classes:
+        mr = report.minimized[fc.key]
+        assert mr.final_rows == 2 and mr.one_minimal
+        bundle = load_bundle(report.bundles[fc.key])
+        assert bundle["kind"] == "device_sweep"
+        assert bundle["actor"] == "pair_restart"
+        assert bundle["seed"] == fc.representative
+        assert np.asarray(bundle["faults"]).shape == (2, 4)
+        assert (np.asarray(bundle["faults"], np.int32)
+                == mr.schedule).all()
+        block = bundle["minimization"]
+        assert block["schema"] == "madsim.triage.minimization/1"
+        assert block["final_rows"] == 2
+        assert block["rounds"] >= 1 and block["candidates_evaluated"] >= 2
+        assert bundle["extra"]["failure_class"] == fc.key
+        assert bundle["extra"]["n_seeds"] == fc.count
+        # Library-level replay: the minimized schedule reproduces the
+        # recorded failure on a fresh engine from the bundle's configs.
+        from madsim_tpu.obs.cli import _actor_registry
+
+        actor_cls, acfg_cls = _actor_registry()[bundle["actor"]]
+        eng = DeviceEngine(
+            actor_cls(acfg_cls(**bundle["actor_config"])),
+            type(pair_eng_m.cfg)(**bundle["engine_config"]))
+        trace = eng.trace(bundle["seed"], max_steps=256,
+                          faults=np.asarray(bundle["faults"], np.int32))
+        assert any(e.get("bug_raised") for e in trace)
+
+
+def test_triage_minimize_false_buckets_only(pair_eng_m, tmp_path):
+    res, faults = _k_class_sweep(pair_eng_m)
+    report = triage(res, out_dir=str(tmp_path), minimize=False)
+    assert report.minimized == {}
+    from madsim_tpu.obs.bundle import load_bundle
+
+    b = load_bundle(report.bundles[report.classes[0].key])
+    assert b["minimization"] is None
+    # The un-minimized bundle records the representative's ORIGINAL rows.
+    assert np.asarray(b["faults"]).shape[0] == 2  # class 0: 2 live rows
+
+
+# ---------------------------------------------------------------------------
+# sweep validation satellite (per-world schedule dims)
+# ---------------------------------------------------------------------------
+
+def test_per_world_faults_leading_dim_names_both_dims(pair_eng):
+    """(m, F, 4) with m != len(seeds) must fail at the API boundary
+    naming BOTH dims — never silently gather wrong-world schedules."""
+    rows = pair_schedule(n_rows=4, need=(0, 3), acfg=ACFG)
+    for m in (5, 24):
+        with pytest.raises(ValueError) as ei:
+            sweep(None, pair_eng.cfg, np.arange(12),
+                  faults=np.broadcast_to(rows, (m, 4, 4)).copy(),
+                  engine=pair_eng, max_steps=64)
+        assert f"leading dim {m}" in str(ei.value)
+        assert "len(seeds)=12" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# host twin: MADSIM_MINIMIZE (testing.py)
+# ---------------------------------------------------------------------------
+
+def test_madsim_minimize_keeps_only_load_bearing_knob(monkeypatch,
+                                                      tmp_path, capsys):
+    """A @test failing IFF packet loss is on, run with three non-default
+    fault-model knobs: MADSIM_MINIMIZE ddmins the knob rows to exactly
+    the loss knob; the banner logs the row-count reduction and the
+    bundle gains the minimization block."""
+    import madsim_tpu as ms
+    from madsim_tpu import time as simtime
+    from madsim_tpu.net import Endpoint
+
+    monkeypatch.setenv("MADSIM_MINIMIZE", "1")
+    monkeypatch.setenv("MADSIM_REPRO_DIR", str(tmp_path))
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = 1.0             # the load-bearing knob
+    cfg.net.send_latency = (0.002, 0.020)      # irrelevant to the bug
+    cfg.fs.io_latency = (0.001, 0.002)         # irrelevant to the bug
+
+    @ms.test(seed=5, config=cfg)
+    async def lossy_test():
+        h = ms.Handle.current()
+        n1 = h.create_node(name="tx", ip="10.0.0.1")
+        n2 = h.create_node(name="rx", ip="10.0.0.2")
+
+        async def sender():
+            ep = await Endpoint.bind(("10.0.0.1", 1))
+            await ep.send_to(("10.0.0.2", 1), 1, b"x")
+
+        async def receiver():
+            ep = await Endpoint.bind(("10.0.0.2", 1))
+            await simtime.timeout(5.0, ep.recv_from(1))
+
+        n1.spawn(sender())
+        await n2.spawn(receiver())
+
+    with pytest.raises(TimeoutError):
+        lossy_test()
+    err = capsys.readouterr().err
+    assert "fault-model minimization (MADSIM_MINIMIZE): " \
+           "3 knob row(s) -> 1" in err
+    assert "failure needs: net.packet_loss_rate" in err
+    bundles = os.listdir(tmp_path)
+    assert len(bundles) == 1
+    with open(tmp_path / bundles[0], encoding="utf-8") as f:
+        bundle = json.load(f)
+    block = bundle["minimization"]
+    assert block["kind"] == "fault_model_knobs"
+    assert block["kept_knobs"] == ["net.packet_loss_rate"]
+    assert sorted(block["dropped_knobs"]) == ["fs.io_latency",
+                                              "net.send_latency"]
+    assert block["one_minimal"] is True
+    assert block["minimized_config"]["net"]["packet_loss_rate"] == 1.0
+    assert block["minimized_config"]["net"]["send_latency"] \
+        == [0.001, 0.010]  # reset to the default model
+
+
+def test_madsim_minimize_off_by_default(monkeypatch, tmp_path, capsys):
+    import madsim_tpu as ms
+
+    monkeypatch.delenv("MADSIM_MINIMIZE", raising=False)
+    monkeypatch.setenv("MADSIM_REPRO_DIR", str(tmp_path))
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = 0.5
+
+    @ms.test(seed=5, config=cfg)
+    async def failing():
+        raise AssertionError("boom")
+
+    with pytest.raises(AssertionError):
+        failing()
+    err = capsys.readouterr().err
+    assert "fault-model minimization" not in err
+    with open(tmp_path / os.listdir(tmp_path)[0], encoding="utf-8") as f:
+        assert json.load(f)["minimization"] is None

@@ -81,6 +81,15 @@ METRICS: List[Tuple[str, str, bool]] = [
     ("fleet seeds/s", "configs.fleet_sweep.fleet_seeds_per_sec", True),
     ("fleet overhead frac",
      "configs.fleet_sweep.fabric_overhead_frac", False),
+    # Failure-triage economy (docs/triage.md; bench_minimize_bug): how
+    # cheaply a hunt's failure turns into a 1-minimal repro — rounds ==
+    # candidate sweeps, so both the search's round count and its wall
+    # time are tracked against creep.
+    ("minimize rounds", "configs.minimize_bug.rounds", False),
+    ("minimize candidates",
+     "configs.minimize_bug.candidates_evaluated", False),
+    ("minimize wall s", "configs.minimize_bug.wall_s", False),
+    ("minimize final rows", "configs.minimize_bug.final_rows", False),
 ]
 
 

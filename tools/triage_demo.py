@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""End-to-end failure-triage demo: inject → hunt → minimize → replay.
+
+The `make triage-demo` target (docs/triage.md "The triage workflow").
+Exercises the whole batched-minimization loop on the known-minimal
+synthetic bug (triage/synthetic.py):
+
+1. INJECT: per-world 32-row restart schedules where only two rows (the
+   pair restarting nodes 1 and 2) are load-bearing — plus clean decoy
+   worlds whose schedules lack one of the pair;
+2. HUNT: one metrics-on pipelined sweep over the seed batch finds the
+   failing worlds;
+3. TRIAGE: `triage.triage(result)` dedupes the failures into classes
+   (behavior signature + invariant id), runs the batched ddmin
+   minimizer on one representative per class — asserting it converges
+   to EXACTLY the two load-bearing rows — and writes one repro bundle
+   per class with the `minimization` provenance block;
+4. REPLAY: each minimized bundle replays through
+   ``python -m madsim_tpu.obs replay`` in a fresh process; nonzero exit
+   unless the recorded failure reproduces from the minimized schedule.
+
+Exits nonzero on any failed expectation.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.parallel.sweep import sweep
+    from madsim_tpu.triage import (PairRestartActor, PairRestartConfig,
+                                   pair_schedule, triage)
+    from madsim_tpu.triage.synthetic import engine_config
+
+    acfg = PairRestartConfig()
+    cfg = engine_config(acfg, metrics=True)
+    eng = DeviceEngine(PairRestartActor(acfg), cfg)
+
+    # INJECT: 32 worlds; even seeds carry the full 32-row schedule with
+    # the load-bearing pair at rows {5, 20}; odd seeds get a decoy
+    # schedule missing the node-2 restart (they must NOT fail).
+    n, n_rows = 32, 32
+    full = pair_schedule(n_rows=n_rows, need=(5, 20), acfg=acfg)
+    decoy = full.copy()
+    decoy[20, 2] = 0  # row 20 restarts the filler node instead of node_b
+    faults = np.stack([full if w % 2 == 0 else decoy for w in range(n)])
+
+    # HUNT: one pipelined metrics-on sweep.
+    res = sweep(None, cfg, np.arange(n), faults=faults, engine=eng,
+                chunk_steps=32, max_steps=4_000)
+    failing = res.failing_seeds
+    print(f"triage-demo: hunt over {n} seeds: {len(failing)} failing",
+          file=sys.stderr)
+    if sorted(failing) != list(range(0, n, 2)):
+        print(f"triage-demo: expected exactly the even seeds to fail, "
+              f"got {failing}", file=sys.stderr)
+        return 1
+
+    # TRIAGE: dedupe + minimize one representative per class + bundles.
+    with tempfile.TemporaryDirectory() as td:
+        report = triage(res, out_dir=td, chunk_steps=32, max_steps=4_000)
+        print(report.summary(), file=sys.stderr)
+        if len(report.classes) != 1:
+            print(f"triage-demo: expected ONE failure class, got "
+                  f"{len(report.classes)}", file=sys.stderr)
+            return 1
+        key = report.classes[0].key
+        mr = report.minimized[key]
+        want = full[[5, 20]]
+        if mr.final_rows != 2 or not (mr.schedule == want).all():
+            print(f"triage-demo: minimizer returned\n{mr.schedule}\n"
+                  f"expected exactly rows {{5, 20}}:\n{want}",
+                  file=sys.stderr)
+            return 1
+        if not mr.one_minimal:
+            print("triage-demo: 1-minimality verification failed",
+                  file=sys.stderr)
+            return 1
+
+        # REPLAY the minimized bundle in a fresh process via the CLI —
+        # rc 1 there means the recorded failure did NOT reproduce.
+        bundle_path = report.bundles[key]
+        with open(bundle_path, encoding="utf-8") as f:
+            bundle = json.load(f)
+        block = bundle.get("minimization") or {}
+        if (block.get("original_rows"), block.get("final_rows")) != (32, 2):
+            print(f"triage-demo: bundle minimization block is off: "
+                  f"{block}", file=sys.stderr)
+            return 1
+        trace_path = os.path.join(td, "trace.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "madsim_tpu.obs", "replay",
+             "--bundle", bundle_path, "--out", trace_path],
+            env={**os.environ}, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            print(f"triage-demo: CLI replay of the minimized bundle "
+                  f"failed rc={proc.returncode}", file=sys.stderr)
+            return 1
+        with open(trace_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        if not events or events[-1]["name"] != "invariant:raise":
+            print("triage-demo: replayed trace does not end at the "
+                  "invariant raise", file=sys.stderr)
+            return 1
+        print(f"triage-demo ok: {len(failing)} failures -> 1 class, "
+              f"schedule {block['original_rows']} -> "
+              f"{block['final_rows']} rows in {block['rounds']} rounds "
+              f"({block['candidates_evaluated']} candidates), minimized "
+              f"bundle replayed to the invariant raise")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
