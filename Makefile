@@ -56,7 +56,8 @@ smoke:
 	xc=[d['configs'][k].get('xla_cost') for k in \
 	    ('time_to_first_bug','madraft_5node')]; \
 	need={'flops_per_step','flops_per_world_step','peak_bytes_est', \
-	      'argument_size_bytes','aliased_bytes'}; \
+	      'argument_size_bytes','aliased_bytes', \
+	      'state_bytes_per_world','packed'}; \
 	assert all(isinstance(x,dict) and need<=set(x) for x in xc), \
 	    f'xla_cost records missing/incomplete: {xc}'; \
 	sl=[d['configs'][k].get('sweep_loop') for k in \
@@ -77,6 +78,7 @@ smoke:
 	assert all(isinstance(x,dict) and x.get('distinct_behaviors',0)>1 \
 	           for x in cv), f'coverage records missing/flat: {cv}'; \
 	print('bench_results.json ok:', d['metric'])"
+	$(CPU_ENV) $(PY) tools/pallas_smoke.py
 
 # Fleet chaos matrix (docs/fleet.md): worker kills, lease expiries +
 # re-issues, duplicated completions, SIGTERM preemptions, torn

@@ -70,10 +70,12 @@ def xla_cost_record(eng, state, max_steps: int) -> dict:
     import numpy as _np
 
     out = {"n_worlds": None, "max_steps": max_steps,
+           "packed": bool(getattr(eng.cfg, "packed", False)),
            "flops_per_step": None, "flops_per_world_step": None,
            "bytes_accessed_per_step": None,
            "argument_size_bytes": None, "output_size_bytes": None,
            "temp_size_bytes": None, "aliased_bytes": None,
+           "state_bytes_per_world": None,
            "peak_bytes_est": None, "peak_over_state": None}
     try:
         w = int(_np.asarray(state.now).shape[0])
@@ -102,6 +104,9 @@ def xla_cost_record(eng, state, max_steps: int) -> dict:
         out["peak_bytes_est"] = peak
         if arg:
             out["peak_over_state"] = round(peak / arg, 4)
+        # The packed-lane regression surface (tracked by bench_diff and
+        # gated by the budget ledger's state_bytes_per_world entry).
+        out["state_bytes_per_world"] = round(arg / w, 2)
     except Exception as exc:  # noqa: BLE001 — observability must not fail the bench
         out["error"] = f"{type(exc).__name__}: {exc}"
     return out

@@ -102,13 +102,18 @@ def measure_compiled(comp, unit_div: Optional[int] = None) -> Dict[str, Any]:
     }
     if unit_div:
         m["flops_per_world"] = round(m["flops"] / unit_div, 2)
+        # The packed-lane regression surface (docs/perf.md "Roofline
+        # round 2"): bytes of world state per world, straight from
+        # XLA's argument accounting. A lane silently widening back to
+        # i32 shows up here before any bench round runs.
+        m["state_bytes_per_world"] = round(arg / unit_div, 2)
     return m
 
 
 # Metrics gated as ceilings (measured must stay <= budget) and the one
 # gated as a floor (donation must keep landing).
-CEILING_METRICS = ("flops", "flops_per_world", "bytes_accessed",
-                   "temp_bytes", "peak_over_arg")
+CEILING_METRICS = ("flops", "flops_per_world", "state_bytes_per_world",
+                   "bytes_accessed", "temp_bytes", "peak_over_arg")
 FLOOR_METRICS = ("alias_fraction",)
 
 
@@ -204,6 +209,11 @@ def make_entry(m: Dict[str, Any], note: str,
             budget = float(old)
         elif metric == "peak_over_arg":
             budget = round(val * 1.05 + 1e-9, 3)
+        elif metric == "state_bytes_per_world":
+            # Arg bytes are a pure function of shapes/dtypes — no XLA
+            # version noise — so the ceiling sits tight: one narrow
+            # lane regressing to i32 must trip it.
+            budget = float(math.ceil(val * 1.02))
         else:
             budget = float(math.ceil(val * HEADROOM))
         entry[metric] = {"measured": val, "budget": budget}

@@ -73,6 +73,12 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
     Rule("TRC004", "declared buffer donation was dropped by XLA",
          "restructure so the output can alias the donated input (XLA "
          "drops donation SILENTLY; peak memory then double-buffers)"),
+    Rule("TRC005", "unannotated narrow-to-wide dtype conversion in a "
+         "packed program",
+         "an i8/i16 lane widened outside engine/lanes.py — an implicit "
+         "promotion is leaking a narrow lane wide; read it through "
+         "lanes.widen() (and write back via the saturating lanes.narrow "
+         "path) so every width change is a stated decision"),
     Rule("BUD001", "program exceeds its checked-in cost budget",
          "if intentional, re-measure and regenerate analysis/budgets.json "
          "via tools/update_budgets.py --reason '...' in the same PR"),

@@ -69,6 +69,16 @@ NONDET_PRIMS = frozenset({
 # then make the result depend on reduction order, which backends choose).
 SCATTER_ACCUM_PRIMS = frozenset({"scatter-add", "scatter-mul"})
 
+# TRC005: narrow-lane dtypes (the packed profile of engine/lanes.py) and
+# the wide integer dtypes an unannotated promotion would leak them into.
+NARROW_INT_DTYPES = frozenset({"int8", "int16", "uint8", "uint16"})
+WIDE_INT_DTYPES = frozenset({"int32", "int64", "uint32", "uint64"})
+# The one sanctioned widening site: lanes.widen() (and the helpers in
+# the same module — take_small's index cast, onehot's compare operand).
+# Path-qualified: a bare "lanes.py" would also match e.g.
+# tests/test_packed_lanes.py in the source summary.
+SANCTIONED_WIDEN_FILE = "engine/lanes.py"
+
 
 # -- jaxpr walking -----------------------------------------------------------
 
@@ -147,6 +157,7 @@ class TraceProgram:
     budget: bool = False          # compile fresh: TRC004 + ledger metrics
     donates: bool = False         # program declares input donation
     unit_div: Optional[int] = None  # world count for flops_per_world
+    packed: bool = False          # TRC005 narrow-dtype discipline applies
 
 
 _ENGINE_CACHE: Dict[str, Any] = {}
@@ -193,6 +204,36 @@ def _build_engine_run() -> Built:
     return Built(fn=eng._run, args=(state, RUN_MAX_STEPS),
                  trace_fn=lambda s: eng._run_impl(s, RUN_MAX_STEPS),
                  trace_args=(state,))
+
+
+# Pallas kernel shape: smaller than RUN_WORLDS — the interpret-mode
+# kernel is traced/compiled per check and the contract (one fused
+# kernel, full donation, narrow lanes) is width-invariant.
+PALLAS_WORLDS = 64
+
+
+def _build_pallas_step() -> Built:
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+
+    if "pallas_eng" not in _ENGINE_CACHE:
+        from ..engine import DeviceEngine
+
+        eng0 = _bug_engine()
+        _ENGINE_CACHE["pallas_eng"] = DeviceEngine(
+            eng0.actor, _dc.replace(eng0.cfg, pallas=True))
+    eng = _ENGINE_CACHE["pallas_eng"]
+    state = eng.init(np.arange(PALLAS_WORLDS))
+    # One batched kernel invocation, donated like the run loop: the
+    # jitted wrapper is what the ledger prices (alias_fraction must
+    # show the input_output_aliases landing at the XLA level too).
+    if "pallas_step_jit" not in _ENGINE_CACHE:
+        _ENGINE_CACHE["pallas_step_jit"] = jax.jit(
+            eng._batched_step, donate_argnums=0)
+    return Built(fn=_ENGINE_CACHE["pallas_step_jit"], args=(state,),
+                 trace_fn=eng._batched_step)
 
 
 def _build_push_many() -> Built:
@@ -415,7 +456,13 @@ def registry() -> Dict[str, TraceProgram]:
             "engine.run", "DeviceEngine.run while-loop (donated step "
             f"path, raft bug config, W={RUN_WORLDS})",
             _build_engine_run, budget=True, donates=True,
-            unit_div=RUN_WORLDS),
+            unit_div=RUN_WORLDS, packed=True),
+        TraceProgram(
+            "engine.pallas_step", "fused Pallas step kernel "
+            f"(interpret mode, raft bug config, W={PALLAS_WORLDS}, "
+            "docs/perf.md Roofline round 2)", _build_pallas_step,
+            budget=True, donates=True, unit_div=PALLAS_WORLDS,
+            packed=True),
         TraceProgram(
             "engine.push_many", "single-pass outbox insert (queue "
             "scatter core of the step)", _build_push_many),
@@ -507,6 +554,40 @@ def check_jaxpr_rules(name: str, jaxpr) -> List[Finding]:
     return findings
 
 
+def check_narrow_discipline(name: str, jaxpr) -> List[Finding]:
+    """TRC005 over one traced *packed* program: every
+    ``convert_element_type`` that widens a narrow integer lane
+    (i8/i16 -> i32/i64) must originate in engine/lanes.py — the
+    ``widen()`` helper and the module's own index casts are the
+    sanctioned sites. Anything else is an implicit promotion: a narrow
+    lane leaking wide through mixed-dtype arithmetic, exactly the
+    regression the packed profile exists to prevent. (The dual-trace
+    machinery that backs TRC003 exposes every equation's operand and
+    result dtypes; this walk reuses it.)"""
+    findings: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = getattr(eqn.invars[0].aval, "dtype", None)
+        dst = eqn.params.get("new_dtype")
+        if src is None or dst is None:
+            continue
+        if str(src) not in NARROW_INT_DTYPES \
+                or str(dst) not in WIDE_INT_DTYPES:
+            continue
+        where = _where(eqn)
+        if not where:
+            # No source attribution (e.g. a synthesized const cast):
+            # nothing actionable to report, and no narrow lane of ours
+            # lacks a source line.
+            continue
+        if SANCTIONED_WIDEN_FILE in where:
+            continue
+        findings.append(_finding(
+            name, "TRC005", f"{src} -> {dst} widening{where}"))
+    return findings
+
+
 def check_x64_invariance(name: str, prog: TraceProgram,
                          built: Built) -> List[Finding]:
     """TRC003: trace twice — plain and under ``enable_x64`` — and demand
@@ -568,6 +649,8 @@ def check_trace_rules(name: str, prog: TraceProgram,
         with built.ctx():
             jaxpr = jax.make_jaxpr(tfn)(*targs)
         findings.extend(check_jaxpr_rules(name, jaxpr.jaxpr))
+        if prog.packed:
+            findings.extend(check_narrow_discipline(name, jaxpr.jaxpr))
         findings.extend(check_x64_invariance(name, prog, built))
     return findings
 

@@ -34,6 +34,7 @@ from .core import (
     INF_TIME,
 )
 from .conformance import ConformanceError, check_actor
+from .lanes import PACKED, WIDE, Lanes
 from .checkpoint import CheckpointError
 from .checkpoint import load as load_checkpoint
 from .checkpoint import save as save_checkpoint
@@ -46,6 +47,7 @@ __all__ = [
     "RaftActor", "RaftDeviceConfig", "PBActor", "PBDeviceConfig",
     "TPCActor", "TPCDeviceConfig",
     "check_actor", "ConformanceError",
+    "Lanes", "PACKED", "WIDE",
     "save_checkpoint", "load_checkpoint", "CheckpointError",
     "FAULT_KILL", "FAULT_RESTART", "FAULT_CLOG_NODE", "FAULT_UNCLOG_NODE",
     "FAULT_CLOG_LINK", "FAULT_UNCLOG_LINK", "FAULT_SET_LATENCY",

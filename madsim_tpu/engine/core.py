@@ -31,7 +31,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.metrics import NUM_FAULT_KINDS, MetricsBlock
-from .lanes import onehot, take_small, upd, upd2
+from .lanes import (
+    PACKED,
+    WIDE,
+    Lanes,
+    join_wide,
+    narrow,
+    narrow_wrap,
+    onehot,
+    split_wide,
+    take_small,
+    upd,
+    upd2,
+    widen,
+)
 from .queue import (
     Event,
     EventQueue,
@@ -120,6 +133,53 @@ class EngineConfig:
     # the field is None and the compiled step is the exact pre-metrics
     # program — the op budget in tests/test_queue_insert.py is untouched.
     metrics: bool = False
+    # Packed lane dtypes (engine/lanes.py Lanes registry, docs/perf.md
+    # "Roofline round 2"): node ids, role/decision codes, queue slot
+    # indices and payload words ride i8/i16 at rest instead of i32 —
+    # ~0.6x the state bytes per world, which compounds directly with
+    # buffer donation into worlds-per-chip. Virtual time, RNG cursors
+    # and unbounded counters stay wide. False is the reference i32
+    # path, kept alive for bitwise crosscheck (the sequential_insert
+    # pattern); trajectories are bit-identical between the two profiles
+    # as long as no narrow lane saturates (tier-1, tests/test_obs.py).
+    packed: bool = True
+    # Fused Pallas step kernel (engine/pallas_step.py): run the batched
+    # pop -> eligible-mask -> dispatch -> push step as ONE
+    # pl.pallas_call, so the queue scatter, mask and lane updates share
+    # one VMEM residency on TPU instead of round-tripping HBM between
+    # XLA fusions. Off by default: CPU tier-1 compiles the existing lax
+    # programs unchanged. Bitwise identical to the lax step (the kernel
+    # body IS the step function, gated in tests and `make smoke`).
+    pallas: bool = False
+    # World-axis block per Pallas grid step (None = whole batch in one
+    # kernel invocation). Must divide the batch width when set;
+    # otherwise the call falls back to the single-block form.
+    pallas_block: Optional[int] = None
+    # Force/disable interpreter-mode Pallas (None = auto: interpret
+    # everywhere except on real TPU backends). Interpret mode keeps the
+    # kernel runnable — and the bitwise-identity gate green — on CPU.
+    pallas_interpret: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.packed:
+            if self.n_nodes > 127:
+                raise ValueError(
+                    f"EngineConfig(packed=True) stores node ids in int8: "
+                    f"n_nodes={self.n_nodes} exceeds 127. Use "
+                    f"packed=False (the int32 reference profile) for "
+                    f"wider clusters.")
+            if self.queue_cap > 32767:
+                raise ValueError(
+                    f"EngineConfig(packed=True) carries queue depths in "
+                    f"int16: queue_cap={self.queue_cap} exceeds 32767. "
+                    f"Use packed=False for deeper queues.")
+        if self.pallas_block is not None and self.pallas_block <= 0:
+            raise ValueError("pallas_block must be a positive world count")
+
+    @property
+    def lanes(self) -> Lanes:
+        """The lane dtype registry this config compiles against."""
+        return PACKED if self.packed else WIDE
 
     @property
     def m(self) -> int:
@@ -161,7 +221,8 @@ class WorldState(NamedTuple):
     queue: EventQueue
     rng: DevRng
     alive: jnp.ndarray        # (N,) bool
-    gen: jnp.ndarray          # (N,) int32 — bumped on kill/restart
+    gen: jnp.ndarray          # (N,) code lane (i8 packed / i32 wide) —
+                              # bumped on kill/restart, compared mod 256
     paused: jnp.ndarray       # (N,) bool — deliveries buffered while set
     clog_node: jnp.ndarray    # (N,) bool
     clog_link: jnp.ndarray    # (N, N) bool, [src, dst]
@@ -171,10 +232,11 @@ class WorldState(NamedTuple):
     delivered: jnp.ndarray    # int32
     dropped: jnp.ndarray      # int32
     overflow: jnp.ndarray     # bool — event queue overflowed (diagnostic)
-    qdepth: jnp.ndarray       # int32 — carried queue depth (== depth(queue);
-                              # maintained by pop/push_many, so qmax needs no
-                              # O(Q) reduction per step)
-    qmax: jnp.ndarray         # int32 — queue depth high-water mark
+    qdepth: jnp.ndarray       # slot lane (i16 packed / i32 wide) — carried
+                              # queue depth (== depth(queue); maintained by
+                              # pop/push_many, so qmax needs no O(Q)
+                              # reduction per step)
+    qmax: jnp.ndarray         # slot lane — queue depth high-water mark
     bug: jnp.ndarray          # bool — invariant violation observed
     bug_time: jnp.ndarray     # int32 µs of first bug, INF_TIME if none
     # Per-world network model (runtime data — the batched sweep axis and
@@ -239,7 +301,18 @@ class DeviceEngine:
         self.actor = actor
         self.cfg = cfg
         self._step_one = self._build_step()
-        self.step = jax.jit(jax.vmap(self._step_one))
+        # The batched step the run loops iterate: a plain vmap of the
+        # per-world step, or — with cfg.pallas — the same step fused
+        # into one pl.pallas_call (engine/pallas_step.py) so every lane
+        # update shares one VMEM residency. Bitwise identical by
+        # construction: the kernel body IS the vmapped step.
+        if cfg.pallas:
+            from .pallas_step import make_pallas_step
+
+            self._batched_step = make_pallas_step(self._step_one, cfg)
+        else:
+            self._batched_step = jax.vmap(self._step_one)
+        self.step = jax.jit(self._batched_step)
         # The run loops DONATE their input state: XLA aliases the output
         # onto the argument buffers and updates the 200-400 MB world state
         # in place instead of double-buffering it — roughly doubling the W
@@ -317,9 +390,15 @@ class DeviceEngine:
             set_loss = live & (ops == FAULT_SET_LOSS)
             if np.any(set_loss & ((a < 0) | (a > 1_000_000))):
                 raise ValueError("FAULT_SET_LOSS rate must be 0..1e6 ppm")
-            if np.any(set_lat | set_loss) and self.cfg.payload_words < 2:
+            # Packed payload words are int16, so each full-width net
+            # param spans two words (lanes.split_wide): [a_lo, a_hi,
+            # b_lo, b_hi] instead of [a, b].
+            need_words = 4 if self.cfg.packed else 2
+            if np.any(set_lat | set_loss) and \
+                    self.cfg.payload_words < need_words:
                 raise ValueError("net-config fault rows carry their params "
-                                 "in the payload: payload_words must be >= 2")
+                                 f"in the payload: payload_words must be "
+                                 f">= {need_words} (packed={self.cfg.packed})")
 
         if configs is None:
             configs = np.array([self.cfg.latency_min_us,
@@ -339,11 +418,37 @@ class DeviceEngine:
                                   jnp.asarray(faults), jnp.asarray(lat_min),
                                   jnp.asarray(lat_max), jnp.asarray(loss))
 
+    def _net_fault_payload_batch(self, rows, n_faults):
+        """(F, P) int32 payload table for fault rows: net-config params
+        ride the payload (src/dst are 8-bit packed and would truncate
+        µs). Packed profile: each param splits across two int16-range
+        words (lanes.split_wide) since the at-rest payload lane is i16."""
+        cfg = self.cfg
+        is_net = (rows[:, 1] == FAULT_SET_LATENCY) \
+            | (rows[:, 1] == FAULT_SET_LOSS)
+        a = jnp.where(is_net, rows[:, 2], 0)
+        b = jnp.where(is_net, rows[:, 3], 0)
+        pay = jnp.zeros((n_faults, cfg.payload_words), jnp.int32)
+        if cfg.packed:
+            a_lo, a_hi = split_wide(a)
+            pay = pay.at[:, 0].set(a_lo)
+            if cfg.payload_words >= 2:
+                pay = pay.at[:, 1].set(a_hi)
+            if cfg.payload_words >= 4:
+                b_lo, b_hi = split_wide(b)
+                pay = pay.at[:, 2].set(b_lo).at[:, 3].set(b_hi)
+        else:
+            pay = pay.at[:, 0].set(a)
+            if cfg.payload_words >= 2:
+                pay = pay.at[:, 1].set(b)
+        return is_net, pay
+
     def _init_one(self, seed_lo, seed_hi, fault_rows, lat_min, lat_max, loss):
         cfg = self.cfg
         n_faults = fault_rows.shape[0]  # static under jit (shape-keyed cache)
         rng = make_rng(seed_lo, seed_hi, STREAM_DEVICE)
-        q = empty_queue(cfg.queue_cap, cfg.payload_words)
+        q = empty_queue(cfg.queue_cap, cfg.payload_words,
+                        payload_dtype=cfg.lanes.payload)
         astate, events, rng = self.actor.init(cfg, rng)
         overflow = jnp.asarray(False)
         if cfg.sequential_insert:
@@ -357,14 +462,9 @@ class DeviceEngine:
         if n_faults and not cfg.sequential_insert:
             rows = fault_rows
             # Net-config params exceed the packed 8-bit src/dst fields, so
-            # they ride the (full-width int32) payload; node ops keep using
-            # src/dst, whose 8 bits the init-time validation guards.
-            is_net = (rows[:, 1] == FAULT_SET_LATENCY) \
-                | (rows[:, 1] == FAULT_SET_LOSS)
-            pay = jnp.zeros((n_faults, cfg.payload_words), jnp.int32)
-            pay = pay.at[:, 0].set(jnp.where(is_net, rows[:, 2], 0))
-            if cfg.payload_words >= 2:
-                pay = pay.at[:, 1].set(jnp.where(is_net, rows[:, 3], 0))
+            # they ride the payload; node ops keep using src/dst, whose
+            # 8 bits the init-time validation guards.
+            is_net, pay = self._net_fault_payload_batch(rows, n_faults)
             zeros = jnp.zeros((n_faults,), jnp.int32)
             fevs = Event(time=rows[:, 0], kind=rows[:, 1],
                          flags=jnp.full((n_faults,), FLAG_FAULT, jnp.int32),
@@ -374,35 +474,39 @@ class DeviceEngine:
             q, oks, _ = push_many(q, fevs, enable=rows[:, 0] >= 0)
             overflow = overflow | ~jnp.all(oks)
         elif n_faults:
-            for f in range(n_faults):  # static unroll (sequential_insert)
+            # Static unroll (sequential_insert); the payload layout is
+            # shared with the batched branch above.
+            is_net_all, pay_all = self._net_fault_payload_batch(
+                fault_rows, n_faults)
+            for f in range(n_faults):
                 row = fault_rows[f]
-                is_net = (row[1] == FAULT_SET_LATENCY) \
-                    | (row[1] == FAULT_SET_LOSS)
-                pay = jnp.zeros((cfg.payload_words,), jnp.int32)
-                pay = pay.at[0].set(jnp.where(is_net, row[2], 0))
-                pay = pay.at[1].set(jnp.where(is_net, row[3], 0))
                 zero = jnp.int32(0)
                 fev = Event(time=row[0], kind=row[1],
                             flags=jnp.int32(FLAG_FAULT),
-                            src=jnp.where(is_net, zero, row[2]),
-                            dst=jnp.where(is_net, zero, row[3]),
-                            gen=jnp.int32(0), payload=pay)
+                            src=jnp.where(is_net_all[f], zero, row[2]),
+                            dst=jnp.where(is_net_all[f], zero, row[3]),
+                            gen=jnp.int32(0), payload=pay_all[f])
                 q, ok = push(q, fev, enable=row[0] >= 0)
                 overflow = overflow | ~ok
         n = cfg.n_nodes
         # One O(Q) reduction at init seeds the carried depth; every step
         # after this maintains it incrementally (pop/push_many deltas).
-        qd = queue_depth(q)
+        # The carried lane rides the (int16-capable) slot dtype; the
+        # metrics block keeps the wide count.
+        qd32 = queue_depth(q)
+        qd = narrow(qd32, cfg.lanes.slot)
         # Metrics start from the init-time queue contents: the actor's
         # seed events and the fault rows count as enqueued.
-        mb = (MetricsBlock.zeros(self.actor.num_kinds)._replace(enqueued=qd)
+        mb = (MetricsBlock.zeros(self.actor.num_kinds)._replace(enqueued=qd32)
               if cfg.metrics else None)
         return WorldState(
             now=jnp.int32(0),
             queue=q,
             rng=rng,
             alive=jnp.ones((n,), bool),
-            gen=jnp.zeros((n,), jnp.int32),
+            # Generations compare mod 256 (queue.GEN_MASK), so the lane
+            # rides the i8 code dtype with WRAP semantics.
+            gen=jnp.zeros((n,), cfg.lanes.code),
             paused=jnp.zeros((n,), bool),
             clog_node=jnp.zeros((n,), bool),
             clog_link=jnp.zeros((n, n), bool),
@@ -459,6 +563,22 @@ class DeviceEngine:
         actor = self.actor
         num_kinds = int(actor.num_kinds)  # kind_hist width (metrics)
 
+        def net_params(payload):
+            """Net-config fault params from an event payload — [a, b]
+            full-width in the wide profile, [a_lo, a_hi, b_lo, b_hi]
+            int16-range halves in the packed one (the at-rest payload
+            lane is i16; _net_fault_payload_batch is the encoder).
+            Short payloads return zeros: init() rejects net rows that
+            would not fit, so the params are never read then."""
+            if cfg.packed:
+                if cfg.payload_words >= 4:
+                    return (join_wide(payload[0], payload[1]),
+                            join_wide(payload[2], payload[3]))
+                return jnp.int32(0), jnp.int32(0)
+            if cfg.payload_words >= 2:
+                return payload[0], payload[1]
+            return payload[0], jnp.int32(0)
+
         def apply_fault(ws: WorldState, ev: Event) -> Tuple[WorldState, Outbox]:
             op, a, b = ev.kind, ev.src, ev.dst
             is_kill = op == FAULT_KILL
@@ -466,8 +586,12 @@ class DeviceEngine:
             alive = upd(ws.alive, a, jnp.where(
                 is_kill, False,
                 jnp.where(is_restart, True, take_small(ws.alive, a))))
-            gen = upd(ws.gen, a, take_small(ws.gen, a)
-                      + (is_kill | is_restart).astype(jnp.int32))
+            # Wide read, wrapping narrow write: generations are mod-256
+            # by contract (GEN_MASK), so the i8 lane wraps — never
+            # saturates (lanes.narrow_wrap, not narrow).
+            gen = upd(ws.gen, a, narrow_wrap(
+                widen(take_small(ws.gen, a))
+                + (is_kill | is_restart).astype(jnp.int32), ws.gen.dtype))
             # Pause buffers; resume releases. Kill/restart clear the pause
             # (the reference swaps in a fresh NodeInfo, `task.rs:211-240`).
             paused = upd(ws.paused, a, jnp.where(
@@ -488,7 +612,7 @@ class DeviceEngine:
             # the payload — src/dst are 8-bit packed and would truncate µs.
             set_lat = op == FAULT_SET_LATENCY
             set_loss = op == FAULT_SET_LOSS
-            pa, pb = ev.payload[0], ev.payload[1]
+            pa, pb = net_params(ev.payload)
             lat_min = jnp.where(set_lat, pa, ws.lat_min)
             lat_max = jnp.where(set_lat, pb, ws.lat_max)
             loss = jnp.where(set_loss,
@@ -526,7 +650,7 @@ class DeviceEngine:
             delay = jnp.maximum(jnp.where(ob.is_timer, ob.delay_us, lat), 0)
             t = ws.now + jnp.minimum(delay, INF_TIME - ws.now)
             flags = jnp.where(ob.is_timer, FLAG_TIMER, 0).astype(jnp.int32)
-            gen_dst = take_small(ws.gen, dst)
+            gen_dst = widen(take_small(ws.gen, dst))  # wide in flight
             # Gated on the world's (pre-step) active flag: frozen worlds
             # write nothing into the queue, which is what lets the step's
             # tail skip the whole-state frozen-world restore select.
@@ -541,10 +665,11 @@ class DeviceEngine:
                                gen=gen_dst[i], payload=ob.payload[i])
                     q, ok = push(q, ev, enable=enable[i])
                     overflow = overflow | ~ok
-                qdepth = queue_depth(q)
+                qd32 = queue_depth(q)
                 # Inserted count via the carried-depth invariant (the
                 # chain exposes no n_ins): metrics stay path-independent.
-                n_ins = qdepth - ws.qdepth
+                n_ins = qd32 - widen(ws.qdepth)
+                qdepth = narrow(qd32, ws.qdepth.dtype)
             else:
                 # Single fused pass (queue.push_many): rank-matched M-row
                 # scatter of the compacted outbox — M·(2+P) element
@@ -563,7 +688,9 @@ class DeviceEngine:
                 # and the pop's separate cleared lane becomes dead code.
                 q, oks, n_ins = push_many(pre_q, evs, enable, clear=clear)
                 overflow = ws.overflow | ~jnp.all(oks)
-                qdepth = ws.qdepth + n_ins
+                # n_ins <= M by construction, so the narrowing cast into
+                # the carried slot lane cannot saturate.
+                qdepth = ws.qdepth + narrow(n_ins, ws.qdepth.dtype)
             qmax = jnp.maximum(ws.qmax, qdepth)
             metrics = ws.metrics
             if cfg.metrics:
@@ -599,14 +726,15 @@ class DeviceEngine:
             now = jnp.where(found, jnp.maximum(ws.now, ev.time), ws.now)
             in_time = now < jnp.int32(cfg.t_limit_us)
             ws1 = ws._replace(queue=q, now=now, steps=ws.steps + 1,
-                              qdepth=ws.qdepth - found.astype(jnp.int32))
+                              qdepth=ws.qdepth
+                              - found.astype(ws.qdepth.dtype))
 
             dst = jnp.clip(ev.dst, 0, cfg.n_nodes - 1)
             is_fault = (ev.flags & FLAG_FAULT) != 0
             is_timer = (ev.flags & FLAG_TIMER) != 0
             # Generations compare modulo the packed width (queue.GEN_MASK).
-            stale = is_timer & (ev.gen != (take_small(ws1.gen, dst)
-                                            & GEN_MASK))
+            stale = is_timer & (ev.gen != (widen(take_small(ws1.gen, dst))
+                                           & GEN_MASK))
             dead = ~take_small(ws1.alive, dst)
             deliver = found & in_time & ~is_fault & ~stale & ~dead
             do_fault = found & in_time & is_fault
@@ -681,7 +809,7 @@ class DeviceEngine:
     # Batched run loops
     # ------------------------------------------------------------------
     def _run_steps_impl(self, state: WorldState, k: int) -> WorldState:
-        batched = jax.vmap(self._step_one)
+        batched = self._batched_step
 
         def body(s, _):
             return batched(s), None
@@ -803,7 +931,7 @@ class DeviceEngine:
         return state, any_bug, n_active, k_done, hist
 
     def _run_impl(self, state: WorldState, max_steps: int) -> WorldState:
-        batched = jax.vmap(self._step_one)
+        batched = self._batched_step
 
         def cond(carry):
             s, i = carry
@@ -858,7 +986,7 @@ class DeviceEngine:
             dst_c = jnp.clip(ev.dst, 0, self.cfg.n_nodes - 1)
             is_fault = (ev.flags & FLAG_FAULT) != 0
             stale = ((ev.flags & FLAG_TIMER) != 0) & \
-                (ev.gen != (take_small(s2.gen, dst_c) & GEN_MASK))
+                (ev.gen != (widen(take_small(s2.gen, dst_c)) & GEN_MASK))
             dead = ~take_small(s2.alive, dst_c)
             delivered = ~is_fault & ~stale & ~dead
             rec = (found & s.active & in_time, ev.time, ev.kind, ev.flags,

@@ -19,12 +19,141 @@ The doctrine, refined by measurement over two perf rounds
 
 Anything not covered above goes through these helpers rather than raw
 ``x[i]`` / ``.at[i]`` so the layout decisions keep exactly one home.
+
+Round 2 (docs/perf.md "Roofline round 2") adds the **lane dtype
+registry**: most engine lanes carry values that fit 8 or 16 bits —
+node ids, role/decision codes, queue slot indices and depths, log
+positions, payload words — but historically rode int32, so the step's
+HBM traffic (and the worlds-per-chip ceiling) was ~2x what the data
+needs. :class:`Lanes` names one dtype per lane *category*; the packed
+profile (``EngineConfig(packed=True)``, the default) narrows them,
+while virtual time, RNG cursors and unbounded counters stay wide.
+Discipline, enforced by tracelint TRC005 on the registered packed
+programs:
+
+- **wide in flight, narrow at rest**: queue/outbox events and all
+  handler arithmetic stay int32; storage lanes narrow. Every narrow
+  *read* is widened HERE (:func:`widen` — the one sanctioned
+  narrow-to-wide conversion site), every narrow *write* goes through a
+  saturating :func:`narrow` (or the wrapping :func:`narrow_wrap` for
+  the mod-256 generation lane), so overflow behavior is explicit at
+  every boundary rather than an accident of two's-complement wrap.
+- the reference int32 profile stays alive behind
+  ``EngineConfig(packed=False)`` for bitwise crosscheck, exactly like
+  ``sequential_insert`` does for the fused queue insert.
 """
 from __future__ import annotations
+
+from typing import Any, NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+
+class Lanes(NamedTuple):
+    """Dtype registry for the engine's state lanes, by category.
+
+    - ``node``: node ids (``src``/``dst``/``voted_for``; -1 sentinels
+      included). Packed: int8 — EngineConfig rejects ``n_nodes > 127``.
+    - ``code``: small enumerations — event kinds, fault ops, drop-cause
+      codes, role/decision codes, the mod-256 generation lane. Packed:
+      int8 (event kinds are already capped at 64 by DeviceEngine).
+    - ``slot``: queue slot indices and depths, log indices, terms,
+      views, epochs — anything bounded by a capacity knob. Packed:
+      int16 — EngineConfig rejects ``queue_cap > 32767``.
+    - ``payload``: queue payload words *at rest*. Packed: int16; wide
+      values the engine itself stores (net-config fault params) are
+      split across two words (:func:`split_wide`/:func:`join_wide`),
+      actor payloads saturate at the push boundary.
+    - ``time`` / ``counter``: virtual-time microseconds and unbounded
+      counters — ALWAYS int32 (as are RNG lanes, uint32). Listed so the
+      registry names every category, not just the narrowed ones.
+
+    Bitmask lanes (vote/ack sets, ``won_terms`` words) stay int32 in
+    both profiles: their width is the bit capacity, not a value range.
+    """
+
+    node: Any
+    code: Any
+    slot: Any
+    payload: Any
+    time: Any = jnp.int32
+    counter: Any = jnp.int32
+
+
+#: Reference profile: every lane rides int32 (the pre-round-2 layout).
+WIDE = Lanes(node=jnp.int32, code=jnp.int32, slot=jnp.int32,
+             payload=jnp.int32)
+
+#: Packed profile: ~0.6x the state bytes of :data:`WIDE` on the
+#: canonical raft config (the ledgered ``state_bytes_per_world``).
+PACKED = Lanes(node=jnp.int8, code=jnp.int8, slot=jnp.int16,
+               payload=jnp.int16)
+
+
+def widen(x) -> jnp.ndarray:
+    """Narrow-lane read: widen to int32.
+
+    THE sanctioned narrow-to-wide conversion site (tracelint TRC005
+    flags any i8/i16-to-i32 convert in a registered packed program that
+    does not originate here): all handler arithmetic runs int32, so
+    every narrow state read passes through this exactly once. Pinned to
+    int32 explicitly — never a weak Python int — so the x64 flag cannot
+    widen it further (TRC003).
+    """
+    return jnp.asarray(x).astype(jnp.int32)
+
+
+def narrow(x, dtype) -> jnp.ndarray:
+    """Narrow-lane write: saturate into ``dtype``.
+
+    The explicit guard at every narrow write boundary: values are
+    clipped to the target's representable range before the cast, so an
+    out-of-range value (a term past 32767, an oversized actor payload
+    word) pins at the rail instead of wrapping silently. When ``dtype``
+    is not strictly narrower (the WIDE profile), this is a plain cast —
+    the reference path pays zero extra ops.
+    """
+    x = jnp.asarray(x)
+    dt = jnp.dtype(dtype)
+    if x.dtype == dt:
+        return x
+    if (jnp.issubdtype(x.dtype, jnp.integer) and jnp.issubdtype(dt, jnp.integer)
+            and jnp.iinfo(dt).bits < jnp.iinfo(x.dtype).bits):
+        info = jnp.iinfo(dt)
+        x = jnp.clip(x, info.min, info.max)
+    return x.astype(dt)
+
+
+def narrow_wrap(x, dtype) -> jnp.ndarray:
+    """Narrow-lane write with WRAP semantics — for lanes whose contract
+    is modular arithmetic (the generation lane compares mod 256,
+    ``queue.GEN_MASK``): a two's-complement truncating cast, explicit at
+    the call site so wrap-vs-saturate is a stated decision, never a
+    default."""
+    return jnp.asarray(x).astype(dtype)
+
+
+def split_wide(v):
+    """Split an int32 value into two int16-range words ``(lo, hi)``.
+
+    The engine's own wide payloads (net-config fault params: latency µs
+    up to 2^31, loss ppm up to 1e6) ride the packed payload lane as two
+    words. The low half is sign-folded into [-32768, 32767] so it
+    passes the saturating :func:`narrow` untouched; :func:`join_wide`
+    reassembles exactly.
+    """
+    v = jnp.asarray(v, jnp.int32)
+    lo = ((v & 0xFFFF) ^ 0x8000) - 0x8000
+    hi = v >> 16
+    return lo, hi
+
+
+def join_wide(lo, hi) -> jnp.ndarray:
+    """Inverse of :func:`split_wide` (operands already widened int32)."""
+    return (jnp.asarray(lo, jnp.int32) & 0xFFFF) \
+        | (jnp.asarray(hi, jnp.int32) << 16)
 
 
 def onehot(i, n: int) -> jnp.ndarray:
@@ -131,13 +260,19 @@ def take_small(x: jnp.ndarray, idxs: jnp.ndarray) -> jnp.ndarray:
 
 
 def upd(x: jnp.ndarray, i, v) -> jnp.ndarray:
-    """``x.at[i].set(v)`` over axis 0 without a scatter."""
+    """``x.at[i].set(v)`` over axis 0 without a scatter.
+
+    The written value passes through the saturating :func:`narrow` when
+    ``x`` carries a packed lane dtype — every one-hot write is thereby a
+    guarded narrow-write boundary for free (wrap-semantics lanes
+    pre-wrap via :func:`narrow_wrap` before calling)."""
     m = _shaped(onehot(i, x.shape[0]), x.ndim)
-    return jnp.where(m, jnp.asarray(v, x.dtype), x)
+    return jnp.where(m, narrow(v, x.dtype), x)
 
 
 def upd2(x: jnp.ndarray, i, j, v) -> jnp.ndarray:
-    """``x.at[i, j].set(v)`` over the two leading axes."""
+    """``x.at[i, j].set(v)`` over the two leading axes (saturating like
+    :func:`upd`)."""
     m = (_shaped(onehot(i, x.shape[0]), x.ndim)
          & _shaped(onehot(j, x.shape[1]), x.ndim - 1)[None])
-    return jnp.where(m, jnp.asarray(v, x.dtype), x)
+    return jnp.where(m, narrow(v, x.dtype), x)

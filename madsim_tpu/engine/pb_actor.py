@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from .actor_util import bcast_payload, make_outbox, pad_payload
 from .core import EngineConfig, Outbox
-from .lanes import sel, sel2, upd, upd2
+from .lanes import narrow, sel, sel2, upd, upd2, widen
 from .queue import Event, FLAG_TIMER, INF_TIME
 from .rng import DevRng, uniform_u32
 
@@ -65,14 +65,21 @@ class PBDeviceConfig:
 
 
 class PBState(NamedTuple):
-    view: jnp.ndarray        # (N,) i32 — each node's current view
-    log_len: jnp.ndarray     # (N,) i32
-    log_cmd: jnp.ndarray     # (N, L) i32
-    commit: jnp.ndarray      # (N,) i32 — entries each node knows committed
+    """Lane dtypes follow ``EngineConfig.lanes`` (engine/lanes.py):
+    views/indices/epochs ride the slot lane (i16 packed), log commands
+    the payload lane; ack bitmasks and the wide counters stay i32.
+    Reads widen, writes saturate (the raft actor's discipline)."""
+
+    view: jnp.ndarray        # (N,) slot lane — each node's current view
+    log_len: jnp.ndarray     # (N,) slot lane
+    log_cmd: jnp.ndarray     # (N, L) payload lane
+    commit: jnp.ndarray      # (N,) slot lane — entries each node knows
+                             # committed
     acks: jnp.ndarray        # (N, L) i32 bitmask of backup acks (primary rows)
-    wd_epoch: jnp.ndarray    # (N,) i32 — invalidates stale watchdog timers
-    committed_cmd: jnp.ndarray   # (L,) i32 — globally committed prefix record
-    committed_max: jnp.ndarray   # i32 — high-water committed index
+    wd_epoch: jnp.ndarray    # (N,) slot lane — invalidates stale watchdogs
+    committed_cmd: jnp.ndarray   # (L,) payload lane — globally committed
+                                 # prefix record
+    committed_max: jnp.ndarray   # slot lane — high-water committed index
     views_changed: jnp.ndarray   # i32
     writes_done: jnp.ndarray     # i32
 
@@ -98,15 +105,16 @@ class PBActor:
             raise ValueError("PBActor needs outbox_cap == n + 1")
         if cfg.payload_words < 4:
             raise ValueError("PBActor needs payload_words >= 4")
+        lt = cfg.lanes
         s = PBState(
-            view=jnp.zeros((n,), jnp.int32),
-            log_len=jnp.zeros((n,), jnp.int32),
-            log_cmd=jnp.zeros((n, L), jnp.int32),
-            commit=jnp.zeros((n,), jnp.int32),
+            view=jnp.zeros((n,), lt.slot),
+            log_len=jnp.zeros((n,), lt.slot),
+            log_cmd=jnp.zeros((n, L), lt.payload),
+            commit=jnp.zeros((n,), lt.slot),
             acks=jnp.zeros((n, L), jnp.int32),
-            wd_epoch=jnp.zeros((n,), jnp.int32),
-            committed_cmd=jnp.zeros((L,), jnp.int32),
-            committed_max=jnp.int32(0),
+            wd_epoch=jnp.zeros((n,), lt.slot),
+            committed_cmd=jnp.zeros((L,), lt.payload),
+            committed_max=jnp.zeros((), lt.slot),
             views_changed=jnp.int32(0),
             writes_done=jnp.int32(0),
         )
@@ -137,7 +145,7 @@ class PBActor:
         me = jnp.clip(node, 0, n - 1)
         # Log and commit are persistent (disk); view is too. Volatile ack
         # bookkeeping resets; the watchdog re-arms.
-        epoch2 = sel(s.wd_epoch, me) + 1
+        epoch2 = widen(sel(s.wd_epoch, me)) + 1
         s2 = s._replace(
             acks=upd(s.acks, me, jnp.zeros((p.log_cap,), jnp.int32)),
             wd_epoch=upd(s.wd_epoch, me, epoch2),
@@ -150,7 +158,7 @@ class PBActor:
             msg_payload=jnp.zeros((n, cfg.payload_words), jnp.int32),
             timer_valid=jnp.asarray(True), timer_kind=jnp.int32(K_WATCHDOG),
             timer_dst=me, timer_delay=delay,
-            timer_payload=self._pad(cfg, [sel(s2.view, me), epoch2]))
+            timer_payload=self._pad(cfg, [widen(sel(s2.view, me)), epoch2]))
         return s2, ob, rng
 
     # ------------------------------------------------------------------
@@ -174,10 +182,12 @@ class PBActor:
         is_hb = kind == K_HEARTBEAT
         is_wd = kind == K_WATCHDOG
 
-        view_me = sel(s.view, me)
-        llen = sel(s.log_len, me)
-        epoch_me = sel(s.wd_epoch, me)
-        commit_me = sel(s.commit, me)
+        # Narrow-lane reads widen to i32 (the wide-in-flight discipline,
+        # engine/lanes.py); writes saturate back through upd/upd2.
+        view_me = widen(sel(s.view, me))
+        llen = widen(sel(s.log_len, me))
+        epoch_me = widen(sel(s.wd_epoch, me))
+        commit_me = widen(sel(s.commit, me))
         arange_n = jnp.arange(n)
         i_am_primary = me == self._primary_of(view_me)
 
@@ -234,7 +244,7 @@ class PBActor:
 
         # -- combined single-position log/acks writes --
         pos = jnp.where(is_rep, pos_r, jnp.where(is_ack, pos_a, pos_w))
-        cmd_at = sel2(s.log_cmd, me, pos)
+        cmd_at = widen(sel2(s.log_cmd, me, pos))
         ack_at = sel2(s.acks, me, pos)
         log_cmd_new = jnp.where(in_order, cmd_rep,
                                 jnp.where(accept, pl[0], cmd_at))
@@ -254,9 +264,14 @@ class PBActor:
                 is_ack, commit_a, jnp.where(is_cm, commit_c, commit_me))),
             wd_epoch=upd(s.wd_epoch, me, jnp.where(
                 is_rep | is_wd, epoch2, epoch_me)),
+            # Same-dtype payload-lane select (no widen needed); the
+            # high-water index is a direct _replace, so it narrows
+            # explicitly rather than through upd.
             committed_cmd=jnp.where(fill, sel(s.log_cmd, me), s.committed_cmd),
-            committed_max=jnp.maximum(s.committed_max,
-                                      jnp.where(committed, pl[1], 0)),
+            committed_max=narrow(
+                jnp.maximum(widen(s.committed_max),
+                            jnp.where(committed, pl[1], 0)),
+                s.committed_max.dtype),
             views_changed=s.views_changed + fire.astype(jnp.int32),
             writes_done=s.writes_done + accept.astype(jnp.int32),
         )
@@ -299,11 +314,11 @@ class PBActor:
         ever reported committed, verbatim."""
         p = self.pcfg
         n, L = p.n, p.log_cap
-        primary = jnp.max(s.view) % n
+        primary = widen(jnp.max(s.view)) % n
         k = jnp.arange(L)
-        mask = k < s.committed_max
-        plog = sel(s.log_cmd, primary)                    # (L,)
-        plen = sel(s.log_len, primary)
+        mask = k < widen(s.committed_max)
+        plog = sel(s.log_cmd, primary)                    # (L,) payload lane
+        plen = widen(sel(s.log_len, primary))
         missing = jnp.any(mask & ((k >= plen) | (plog != s.committed_cmd)))
         return missing
 

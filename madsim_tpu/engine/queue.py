@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .lanes import onehot, prefix_count, take_small
+from .lanes import narrow, onehot, prefix_count, take_small, widen
 
 INF_TIME = jnp.int32(2**31 - 1)
 
@@ -102,11 +102,16 @@ class EventQueue(NamedTuple):
     payload: jnp.ndarray
 
 
-def empty_queue(capacity: int, payload_words: int) -> EventQueue:
+def empty_queue(capacity: int, payload_words: int,
+                payload_dtype=jnp.int32) -> EventQueue:
+    """``payload_dtype``: the at-rest payload lane dtype — int16 under
+    the packed profile (``EngineConfig.lanes``), int32 in the reference
+    path and for standalone callers. The time and meta lanes are always
+    int32 (time is a wide lane; meta is already bit-packed)."""
     return EventQueue(
         time=jnp.full((capacity,), INF_TIME, jnp.int32),
         meta=jnp.zeros((capacity,), jnp.int32),
-        payload=jnp.zeros((capacity, payload_words), jnp.int32),
+        payload=jnp.zeros((capacity, payload_words), payload_dtype),
     )
 
 
@@ -144,7 +149,11 @@ def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray
         time=jnp.where(do, jnp.asarray(ev.time, jnp.int32), q.time),
         meta=jnp.where(do, pack_meta(ev.kind, ev.flags, ev.src, ev.dst,
                                      ev.gen), q.meta),
-        payload=jnp.where(do[:, None], ev.payload[None, :], q.payload),
+        # In-flight payloads are int32; the write saturates into the
+        # at-rest lane dtype (a no-op cast on the wide profile).
+        payload=jnp.where(do[:, None],
+                          narrow(ev.payload, q.payload.dtype)[None, :],
+                          q.payload),
     )
     return q, ok
 
@@ -256,7 +265,11 @@ def push_many(q: EventQueue, evs: Event, enable=None,
     q = EventQueue(
         time=base_time.at[slots].set(ct, mode="drop"),
         meta=q.meta.at[slots].set(cmeta, mode="drop"),
-        payload=q.payload.at[slots].set(cpay, mode="drop"),
+        # Saturating narrow at the scatter boundary (packed payload
+        # lane); engine-split wide params (lanes.split_wide) are in
+        # range by construction, so the clip never bites them.
+        payload=q.payload.at[slots].set(narrow(cpay, q.payload.dtype),
+                                        mode="drop"),
     )
     return q, ok, jnp.minimum(n_en, n_free)
 
@@ -303,7 +316,10 @@ def pop_indexed(q: EventQueue, eligible=None
     kind, flags, src, dst, gen = unpack_meta(take_small(q.meta, slot))
     ev = Event(
         time=tmin, kind=kind, flags=flags, src=src, dst=dst, gen=gen,
-        payload=take_small(q.payload, slot),
+        # Wide in flight: the popped row is widened back to int32 here
+        # (lanes.widen — one (P,)-sized convert per step), so handlers
+        # and apply_fault never see a narrow payload.
+        payload=widen(take_small(q.payload, slot)),
     )
     q = q._replace(time=jnp.where(mask & found, INF_TIME, q.time))
     return q, ev, found, slot

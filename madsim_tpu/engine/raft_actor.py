@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from .actor_util import bcast_payload, make_outbox, pad_payload
 from .core import EngineConfig, Outbox
-from .lanes import take_small, upd, upd2
+from .lanes import take_small, upd, upd2, widen
 from .queue import Event, FLAG_TIMER, INF_TIME
 from .rng import DevRng, uniform_u32
 
@@ -71,17 +71,25 @@ class RaftDeviceConfig:
 
 
 class RaftState(NamedTuple):
-    term: jnp.ndarray        # (N,) i32
-    voted_for: jnp.ndarray   # (N,) i32, -1 = none
-    role: jnp.ndarray        # (N,) i32
+    """Lane dtypes follow ``EngineConfig.lanes`` (engine/lanes.py): the
+    packed profile rides terms/indices/epochs on the i16 slot lane,
+    node ids on i8, role codes on i8, and log commands on the i16
+    payload lane; bitmask lanes (``votes``, ``won_terms``) and the wide
+    time/counter scalars stay i32. Reads widen (lanes.widen), writes
+    saturate through upd/upd2."""
+
+    term: jnp.ndarray        # (N,) slot lane
+    voted_for: jnp.ndarray   # (N,) node lane, -1 = none
+    role: jnp.ndarray        # (N,) code lane
     votes: jnp.ndarray       # (N,) i32 bitmask of granted votes
-    commit: jnp.ndarray      # (N,) i32
-    log_len: jnp.ndarray     # (N,) i32
-    log_term: jnp.ndarray    # (N, L) i32
-    log_cmd: jnp.ndarray     # (N, L) i32
-    next_idx: jnp.ndarray    # (N, N) i32 [leader, peer]
-    match_idx: jnp.ndarray   # (N, N) i32 [leader, peer]
-    elect_epoch: jnp.ndarray  # (N,) i32 — invalidates stale election timers
+    commit: jnp.ndarray      # (N,) slot lane
+    log_len: jnp.ndarray     # (N,) slot lane
+    log_term: jnp.ndarray    # (N, L) slot lane
+    log_cmd: jnp.ndarray     # (N, L) payload lane
+    next_idx: jnp.ndarray    # (N, N) slot lane [leader, peer]
+    match_idx: jnp.ndarray   # (N, N) slot lane [leader, peer]
+    elect_epoch: jnp.ndarray  # (N,) slot lane — invalidates stale election
+                              # timers
     first_leader_time: jnp.ndarray  # i32 µs, INF if never
     elections_won: jnp.ndarray      # i32
     # Historical election-safety record: bitset of terms each node has EVER
@@ -120,18 +128,19 @@ class RaftActor:
                              "(n-1 peer messages + 1 timer per handler)")
         if cfg.payload_words < 8:
             raise ValueError("RaftActor needs payload_words >= 8")
+        lt = cfg.lanes
         s = RaftState(
-            term=jnp.zeros((n,), jnp.int32),
-            voted_for=jnp.full((n,), -1, jnp.int32),
-            role=jnp.zeros((n,), jnp.int32),
+            term=jnp.zeros((n,), lt.slot),
+            voted_for=jnp.full((n,), -1, lt.node),
+            role=jnp.zeros((n,), lt.code),
             votes=jnp.zeros((n,), jnp.int32),
-            commit=jnp.zeros((n,), jnp.int32),
-            log_len=jnp.zeros((n,), jnp.int32),
-            log_term=jnp.zeros((n, L), jnp.int32),
-            log_cmd=jnp.zeros((n, L), jnp.int32),
-            next_idx=jnp.ones((n, n), jnp.int32),
-            match_idx=jnp.zeros((n, n), jnp.int32),
-            elect_epoch=jnp.zeros((n,), jnp.int32),
+            commit=jnp.zeros((n,), lt.slot),
+            log_len=jnp.zeros((n,), lt.slot),
+            log_term=jnp.zeros((n, L), lt.slot),
+            log_cmd=jnp.zeros((n, L), lt.payload),
+            next_idx=jnp.ones((n, n), lt.slot),
+            match_idx=jnp.zeros((n, n), lt.slot),
+            elect_epoch=jnp.zeros((n,), lt.slot),
             first_leader_time=INF_TIME,
             elections_won=jnp.int32(0),
             won_terms=jnp.zeros((n, WON_WORDS), jnp.int32),
@@ -158,7 +167,7 @@ class RaftActor:
         r = self.rcfg
         n = r.n
         me = jnp.clip(node, 0, n - 1)
-        epoch2 = take_small(s.elect_epoch, me) + 1
+        epoch2 = widen(take_small(s.elect_epoch, me)) + 1
         s = s._replace(
             role=upd(s.role, me, FOLLOWER),
             votes=upd(s.votes, me, 0),
@@ -215,28 +224,32 @@ class RaftActor:
         is_pr = kind == K_PROPOSE
 
         # -- shared step-down (the four message kinds carrying a term) --
+        # Narrow-lane reads widen to i32 here (lanes.widen — the
+        # wide-in-flight discipline, tracelint TRC005); the upd writes
+        # below saturate back into the packed lanes.
         sd = is_rv | is_vr | is_ap | is_ar
-        term_pre = take_small(s.term, me)
-        role_pre = take_small(s.role, me)
+        term_pre = widen(take_small(s.term, me))
+        role_pre = widen(take_small(s.role, me))
         higher = sd & (t > term_pre)
         demote = higher | (is_ap & (t == term_pre) & (role_pre == CANDIDATE))
         s = s._replace(
             term=upd(s.term, me, jnp.where(higher, t, term_pre)),
             voted_for=upd(s.voted_for, me,
-                          jnp.where(higher, -1, take_small(s.voted_for, me))),
+                          jnp.where(higher, -1,
+                                    widen(take_small(s.voted_for, me)))),
             role=upd(s.role, me, jnp.where(demote, FOLLOWER, role_pre)),
         )
 
-        # -- shared views of the post-step-down row --
-        term_me = take_small(s.term, me)
-        role_me = take_small(s.role, me)
-        voted_me = take_small(s.voted_for, me)
-        votes_me = take_small(s.votes, me)
-        commit_me = take_small(s.commit, me)
-        llen_me = take_small(s.log_len, me)
-        epoch_me = take_small(s.elect_epoch, me)
-        log_term_row = take_small(s.log_term, me)   # (L,)
-        log_cmd_row = take_small(s.log_cmd, me)     # (L,)
+        # -- shared views of the post-step-down row (widened; see above) --
+        term_me = widen(take_small(s.term, me))
+        role_me = widen(take_small(s.role, me))
+        voted_me = widen(take_small(s.voted_for, me))
+        votes_me = take_small(s.votes, me)          # bitmask lane: i32
+        commit_me = widen(take_small(s.commit, me))
+        llen_me = widen(take_small(s.log_len, me))
+        epoch_me = widen(take_small(s.elect_epoch, me))
+        log_term_row = widen(take_small(s.log_term, me))   # (L,)
+        log_cmd_row = widen(take_small(s.log_cmd, me))     # (L,)
         my_last_term = self._row_term_at(log_term_row, llen_me)
         reject = t < term_me  # rv/ap stale-term test
 
@@ -306,8 +319,8 @@ class RaftActor:
         live_ar = is_ar & (role_me == LEADER) & (t == term_me)
         ok_ar = live_ar & (p[1] != 0)
         fail_ar = live_ar & (p[1] == 0)
-        cur_match = take_small(take_small(s.match_idx, me), follower)
-        cur_next = take_small(take_small(s.next_idx, me), follower)
+        cur_match = widen(take_small(take_small(s.match_idx, me), follower))
+        cur_next = widen(take_small(take_small(s.next_idx, me), follower))
         match2 = jnp.maximum(cur_match, p[2])
 
         # -- one combined log write (append XOR propose position) --
@@ -321,8 +334,8 @@ class RaftActor:
         # -- per-row combines --
         arange_n = jnp.arange(n)
         oh_follower = arange_n == follower
-        match_row0 = take_small(s.match_idx, me)
-        next_row0 = take_small(s.next_idx, me)
+        match_row0 = widen(take_small(s.match_idx, me))
+        next_row0 = widen(take_small(s.next_idx, me))
         match_row = jnp.where(
             win, jnp.where(arange_n == me, llen_me, 0),
             jnp.where(is_ar & oh_follower,
@@ -455,7 +468,9 @@ class RaftActor:
         bad = jnp.asarray(False)
         for i in range(n):
             for j in range(i + 1, n):
-                lim = jnp.minimum(s.commit[i], s.commit[j])
+                # Same-dtype compares stay narrow; only the arange
+                # comparison needs the widened commit bound.
+                lim = widen(jnp.minimum(s.commit[i], s.commit[j]))
                 diff = (s.log_term[i] != s.log_term[j]) | \
                        (s.log_cmd[i] != s.log_cmd[j])
                 bad = bad | jnp.any((k < lim) & diff)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from .actor_util import bcast_payload, make_outbox, pad_payload
 from .core import EngineConfig, Outbox
-from .lanes import sel, sel2, upd, upd2
+from .lanes import sel, sel2, upd, upd2, widen
 from .queue import Event
 from .rng import DevRng, next_u32
 
@@ -67,10 +67,15 @@ class TPCDeviceConfig:
 
 
 class TPCState(NamedTuple):
-    decision: jnp.ndarray    # (N, T) i32 — applied outcome per node
-    voted: jnp.ndarray       # (N, T) i32 — participant's sent vote (NONE/COMMIT=yes/ABORT=no)
+    """Decision/vote codes ride the i8 code lane under the packed
+    profile (``EngineConfig.lanes``); the yes-bitmask and counters stay
+    i32. Reads widen, writes saturate (the raft actor's discipline)."""
+
+    decision: jnp.ndarray    # (N, T) code lane — applied outcome per node
+    voted: jnp.ndarray       # (N, T) code lane — participant's sent vote
+                             # (NONE/COMMIT=yes/ABORT=no)
     votes_yes: jnp.ndarray   # (T,) i32 — coordinator's yes bitmask
-    decided: jnp.ndarray     # (T,) i32 — coordinator's decision record
+    decided: jnp.ndarray     # (T,) code lane — coordinator's decision record
     txns_seen: jnp.ndarray   # i32
     commits: jnp.ndarray     # i32 — coordinator-side COMMIT decisions
     aborts: jnp.ndarray      # i32
@@ -98,11 +103,12 @@ class TPCActor:
             raise ValueError("TPCActor needs payload_words >= 3")
         if n < 2 or n > 31:
             raise ValueError("TPCActor needs 2..31 nodes (int32 vote bitmask)")
+        lt = cfg.lanes
         s = TPCState(
-            decision=jnp.zeros((n, T), jnp.int32),
-            voted=jnp.zeros((n, T), jnp.int32),
+            decision=jnp.zeros((n, T), lt.code),
+            voted=jnp.zeros((n, T), lt.code),
             votes_yes=jnp.zeros((T,), jnp.int32),
-            decided=jnp.zeros((T,), jnp.int32),
+            decided=jnp.zeros((T,), lt.code),
             txns_seen=jnp.int32(0),
             commits=jnp.int32(0),
             aborts=jnp.int32(0),
@@ -142,7 +148,8 @@ class TPCActor:
         is_to = kind == K_TIMEOUT
 
         at_coord = me == COORD
-        decided_t = sel(s.decided, txn)
+        # Narrow-lane reads widen to i32 (engine/lanes.py discipline).
+        decided_t = widen(sel(s.decided, txn))
 
         # One draw per step (static shape); only PREPARE consumes it.
         u, rng_drawn = next_u32(rng)
@@ -153,9 +160,9 @@ class TPCActor:
         start = is_txn & at_coord & (decided_t == NONE)
 
         # -- K_PREPARE (participant): vote once, abort locally on no --
-        my_vote = sel2(s.voted, me, txn)
+        my_vote = widen(sel2(s.voted, me, txn))
         fresh = is_prep & ~at_coord & (my_vote == NONE) & \
-            (sel2(s.decision, me, txn) == NONE)
+            (widen(sel2(s.decision, me, txn)) == NONE)
         vote_no = (u % jnp.uint32(256)) < jnp.uint32(t.no_vote_num)
         vote_val = jnp.where(vote_no, ABORT, COMMIT)  # ABORT code == "no"
         # A no-voter aborts unilaterally at vote time.
@@ -182,7 +189,7 @@ class TPCActor:
         # -- K_DECIDE (participant): apply, unless it aborted unilaterally
         # and the coordinator says COMMIT — that conflict IS the apply-time
         # state; the invariant reads it.
-        applied = sel2(s.decision, me, txn)
+        applied = widen(sel2(s.decision, me, txn))
         apply_dec = is_dec & ~at_coord & (applied == NONE)
 
         # -- state writes (one per field) --

@@ -24,6 +24,16 @@ This module deliberately imports nothing from :mod:`madsim_tpu.engine`
 (the engine imports *it*); the fault-kind count mirrors the
 ``FAULT_KILL..FAULT_RESUME`` op range in engine/core.py and is asserted
 against it in tests/test_obs.py.
+
+Packed-lane interplay (engine/lanes.py, docs/perf.md "Roofline
+round 2"): the counters stay **int32 in both dtype profiles** — they
+are unbounded counts (the registry's wide ``counter`` category), not
+value-bounded lanes — while the narrow code lanes feed them only
+through the engine's widened in-flight values (``ev.kind`` is i32 by
+the time it indexes ``kind_hist``/``fault_hist``). That keeps the
+``m_*`` observations bit-identical between ``packed=True`` and the i32
+reference profile, which the packed crosscheck matrix in
+tests/test_obs.py relies on.
 """
 from __future__ import annotations
 

@@ -147,6 +147,48 @@ def test_metrics_on_sweep_bitwise_identical(engines, family, mode):
     assert agg["drop_loss"] <= agg["msgs_sent"]
 
 
+@pytest.fixture(scope="module")
+def wide_engines():
+    """The int32 reference profile (EngineConfig(packed=False)) per
+    family — the crosscheck twin of the packed-by-default ``engines``
+    fixture (PR "Roofline round 2"; the sequential_insert pattern
+    applied to lane dtypes)."""
+    out = {}
+    for name, (make_actor, cfg, faults) in _FAMILIES.items():
+        out[name] = (DeviceEngine(make_actor(),
+                                  dataclasses.replace(cfg, packed=False)),
+                     faults)
+    return out
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+@pytest.mark.parametrize("mode", sorted(_MODES))
+def test_packed_sweep_bitwise_identical_to_wide(engines, wide_engines,
+                                                family, mode):
+    """Packed lane dtypes are trajectory-invisible: a packed sweep (the
+    default profile — i8/i16 node/code/slot/payload lanes) walks
+    bit-identical trajectories to the int32 reference profile, for
+    every actor family across the plain/recycled/pipelined orchestration
+    modes. Only the at-rest dtypes differ; every observed value, the
+    occupancy story, and the failing-seed set must match exactly."""
+    eng_packed, _on, faults = engines[family]
+    eng_wide, _ = wide_engines[family]
+    assert eng_packed.cfg.packed and not eng_wide.cfg.packed
+    seeds = np.arange(40)
+    kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+              **_MODES[mode])
+    res_p = sweep(None, eng_packed.cfg, seeds, engine=eng_packed, **kw)
+    res_w = sweep(None, eng_wide.cfg, seeds, engine=eng_wide, **kw)
+    assert set(res_p.observations) == set(res_w.observations)
+    for k, v in res_w.observations.items():
+        np.testing.assert_array_equal(np.asarray(res_p.observations[k]),
+                                      np.asarray(v), err_msg=k)
+    np.testing.assert_array_equal(res_p.n_active_history,
+                                  res_w.n_active_history)
+    assert res_p.failing_seeds == res_w.failing_seeds
+    assert res_p.steps_run == res_w.steps_run
+
+
 def test_metrics_survive_checkpoint_resume(engines, tmp_path):
     """The extra leaf rides the checkpoint format unchanged: a resumed
     metrics-on sweep equals the unbroken run — every MetricsBlock

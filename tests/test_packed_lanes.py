@@ -1,0 +1,291 @@
+"""Packed lane dtypes (engine/lanes.py Lanes registry, PR "Roofline
+round 2").
+
+Contracts under test:
+
+- **bitwise crosscheck**: `EngineConfig(packed=True)` (the default)
+  walks bit-identical trajectories to the int32 reference profile
+  (`packed=False`) — the sweep-level matrix rides tests/test_obs.py;
+  here the targeted engine-level cases live (generation-lane wrap,
+  net-param split encoding).
+- **state bytes**: the packed profile is <= 0.6x the wide profile on
+  the canonical ledger config, and the checked-in ledger's
+  `state_bytes_per_world` equals what the state pytree actually weighs.
+- **dtype-boundary guards**: capacity knobs that would overflow a
+  narrow lane are rejected with pointed ValueErrors at EngineConfig
+  construction; saturating/wrapping narrows behave as documented.
+- **TRC005**: the tracelint narrow-dtype discipline rule flags
+  unannotated i8/i16 -> i32 widenings and sanctions lanes.widen.
+- **tools/update_budgets.py** refuses to clobber a dirty ledger.
+"""
+import dataclasses
+import json
+import os
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import (
+    DeviceEngine,
+    EngineConfig,
+    FAULT_KILL,
+    FAULT_RESTART,
+    RaftActor,
+    RaftDeviceConfig,
+)
+from madsim_tpu.engine.lanes import (
+    PACKED,
+    WIDE,
+    join_wide,
+    narrow,
+    narrow_wrap,
+    split_wide,
+    widen,
+)
+
+
+def _state_bytes_per_world(state, w):
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(state)) / w
+
+
+# ---------------------------------------------------------------------------
+# EngineConfig dtype-boundary guards
+# ---------------------------------------------------------------------------
+
+def test_packed_rejects_node_count_over_127():
+    with pytest.raises(ValueError, match="int8.*127.*packed=False"):
+        EngineConfig(n_nodes=128)
+    # The escape hatch takes the same cluster width.
+    assert EngineConfig(n_nodes=128, packed=False).n_nodes == 128
+    # And the engine-level 256 ceiling still backs the wide profile.
+    assert EngineConfig(n_nodes=127).packed
+
+
+def test_packed_rejects_queue_cap_over_i16():
+    with pytest.raises(ValueError, match="int16.*32767.*packed=False"):
+        EngineConfig(n_nodes=3, queue_cap=32_768)
+    assert EngineConfig(n_nodes=3, queue_cap=32_767).queue_cap == 32_767
+    assert EngineConfig(n_nodes=3, queue_cap=40_000,
+                        packed=False).queue_cap == 40_000
+
+
+def test_event_kind_range_guard_covers_i8_codes():
+    # Event kinds (and fault/drop-cause codes, which share the code
+    # lane) are capped at 64 by DeviceEngine — comfortably inside i8.
+    class WideKinds:
+        num_kinds = 65
+
+    with pytest.raises(ValueError, match="num_kinds must be <= 64"):
+        DeviceEngine(WideKinds(), EngineConfig(n_nodes=3))
+
+
+def test_lane_registry_profiles():
+    assert PACKED.node == jnp.int8 and PACKED.code == jnp.int8
+    assert PACKED.slot == jnp.int16 and PACKED.payload == jnp.int16
+    assert PACKED.time == jnp.int32 and PACKED.counter == jnp.int32
+    assert all(d == jnp.int32 for d in
+               (WIDE.node, WIDE.code, WIDE.slot, WIDE.payload))
+    assert EngineConfig(n_nodes=3).lanes == PACKED
+    assert EngineConfig(n_nodes=3, packed=False).lanes == WIDE
+
+
+# ---------------------------------------------------------------------------
+# Saturate / wrap / split helpers
+# ---------------------------------------------------------------------------
+
+def test_narrow_saturates_and_wide_is_identity():
+    v = jnp.asarray([-40_000, -1, 0, 127, 128, 32_767, 32_768, 2**31 - 1],
+                    jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(narrow(v, jnp.int16)),
+        [-32768, -1, 0, 127, 128, 32767, 32767, 32767])
+    np.testing.assert_array_equal(
+        np.asarray(narrow(v, jnp.int8)),
+        [-128, -1, 0, 127, 127, 127, 127, 127])
+    # Wide profile: identity (no clip, no cast — zero-cost reference path).
+    same = narrow(v, jnp.int32)
+    assert same.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(v))
+    assert narrow(v, jnp.int16).dtype == jnp.int16
+
+
+def test_narrow_wrap_is_modular():
+    v = jnp.asarray([0, 127, 128, 255, 256, 511], jnp.int32)
+    w = np.asarray(narrow_wrap(v, jnp.int8))
+    # The contract the generation lane relies on: widened & 0xFF == mod 256.
+    np.testing.assert_array_equal(np.asarray(widen(w)) & 0xFF,
+                                  np.asarray(v) % 256)
+
+
+def test_split_join_roundtrip_covers_full_int32_range():
+    vals = jnp.asarray([0, 1, 5_000, 32_767, 32_768, 65_535, 65_536,
+                        1_000_000, 2_000_000_000, 2**31 - 1], jnp.int32)
+    lo, hi = split_wide(vals)
+    # Both halves must survive the saturating int16 narrow untouched —
+    # that is what lets them ride the packed payload lane.
+    np.testing.assert_array_equal(np.asarray(narrow(lo, jnp.int16)),
+                                  np.asarray(lo))
+    np.testing.assert_array_equal(np.asarray(narrow(hi, jnp.int16)),
+                                  np.asarray(hi))
+    np.testing.assert_array_equal(np.asarray(join_wide(lo, hi)),
+                                  np.asarray(vals))
+
+
+# ---------------------------------------------------------------------------
+# Generation-lane wrap: i8 gen must agree with the i32 reference mod 256
+# ---------------------------------------------------------------------------
+
+def test_gen_lane_wraps_identically_to_wide_reference():
+    """120 kill/restart pairs push node 0's generation to 240 — through
+    the int8 sign boundary at 127 — while a pending-timer workload keeps
+    exercising the stale-timer compare. Packed and wide must agree on
+    every observation (generations compare mod 256 in both profiles)."""
+    rows = []
+    for i in range(120):
+        t = 10_000 + i * 4_000
+        rows.append([t, FAULT_KILL, 0, 0])
+        rows.append([t + 2_000, FAULT_RESTART, 0, 0])
+    faults = np.asarray(rows, np.int32)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=256,
+                       t_limit_us=900_000, stop_on_bug=False)
+    mk = lambda: RaftActor(RaftDeviceConfig(n=3))  # noqa: E731
+    ep = DeviceEngine(mk(), cfg)
+    ew = DeviceEngine(mk(), dataclasses.replace(cfg, packed=False))
+    sp = ep.run(ep.init(np.arange(8), faults=faults), 3_000)
+    sw = ew.run(ew.init(np.arange(8), faults=faults), 3_000)
+    assert sp.gen.dtype == jnp.int8 and sw.gen.dtype == jnp.int32
+    # The wide gen really did pass the i8 sign boundary.
+    assert int(np.asarray(sw.gen).max()) > 127
+    np.testing.assert_array_equal(np.asarray(sp.gen, np.int32) & 0xFF,
+                                  np.asarray(sw.gen) & 0xFF)
+    op, ow = ep.observe(sp), ew.observe(sw)
+    for k in ow:
+        np.testing.assert_array_equal(op[k], ow[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# State bytes: the 0.6x contract and the ledger's honesty
+# ---------------------------------------------------------------------------
+
+def test_packed_state_bytes_at_most_0_6x_wide():
+    # The canonical ledger config (analysis/budgets.json engine.run).
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000, stop_on_bug=False)
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    w = 8
+    packed = _state_bytes_per_world(
+        DeviceEngine(RaftActor(rcfg), cfg).init(np.arange(w)), w)
+    wide = _state_bytes_per_world(
+        DeviceEngine(RaftActor(rcfg),
+                     dataclasses.replace(cfg, packed=False))
+        .init(np.arange(w)), w)
+    assert packed <= 0.6 * wide, (
+        f"packed state weighs {packed:.0f} B/world vs wide {wide:.0f} — "
+        f"ratio {packed / wide:.4f} broke the 0.6x contract: a narrow "
+        "lane regressed to a wide dtype")
+
+    from madsim_tpu.analysis import budgets as B
+
+    entry = B.load_ledger()["programs"]["engine.run"]
+    ledger_val = entry["state_bytes_per_world"]["measured"]
+    # XLA's argument accounting and the pytree's nbytes must agree —
+    # if they drift, the ledger is measuring something else.
+    assert ledger_val == pytest.approx(packed), (
+        f"ledger state_bytes_per_world {ledger_val} != measured {packed}")
+    assert B.budget_for(B.load_ledger(), "engine.run",
+                        "state_bytes_per_world") is not None
+
+
+# ---------------------------------------------------------------------------
+# TRC005: narrow-dtype discipline
+# ---------------------------------------------------------------------------
+
+def test_trc005_flags_unannotated_widening_and_sanctions_lanes():
+    from madsim_tpu.analysis.tracelint import check_narrow_discipline
+
+    def leaky(x):
+        return x + jnp.int32(1)  # implicit i16 -> i32 promotion
+
+    findings = check_narrow_discipline(
+        "scratch", jax.make_jaxpr(leaky)(jnp.zeros((4,), jnp.int16)).jaxpr)
+    assert len(findings) == 1 and findings[0].rule == "TRC005"
+    assert "int16 -> int32" in findings[0].message
+
+    def disciplined(x):
+        return widen(x) + jnp.int32(1)
+
+    assert not check_narrow_discipline(
+        "scratch",
+        jax.make_jaxpr(disciplined)(jnp.zeros((4,), jnp.int16)).jaxpr)
+
+    def narrowing(x):  # wide -> narrow is the write direction: not flagged
+        return narrow(x, jnp.int16)
+
+    assert not check_narrow_discipline(
+        "scratch",
+        jax.make_jaxpr(narrowing)(jnp.zeros((4,), jnp.int32)).jaxpr)
+
+
+def test_trc005_applies_to_the_packed_programs():
+    from madsim_tpu.analysis.tracelint import registry
+
+    regs = registry()
+    assert regs["engine.run"].packed
+    assert regs["engine.pallas_step"].packed
+    assert regs["engine.pallas_step"].budget  # own ledger entries
+
+
+# ---------------------------------------------------------------------------
+# tools/update_budgets.py: dirty-ledger refusal
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   capture_output=True, text=True)
+
+
+def test_update_budgets_refuses_dirty_ledger(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import update_budgets
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    ledger = repo / "budgets.json"
+    ledger.write_text(json.dumps(
+        {"schema": "madsim.tracelint.budgets/1", "justification": "seed",
+         "programs": {}}))
+    _git(repo, "add", "budgets.json")
+    _git(repo, "commit", "-qm", "seed ledger")
+
+    assert not update_budgets.ledger_dirty(str(ledger))
+    original = ledger.read_text()
+    ledger.write_text(original.replace("seed", "concurrent edit"))
+    assert update_budgets.ledger_dirty(str(ledger))
+
+    # The refusal happens before any measurement: instant, rc=2, and the
+    # concurrent edit survives verbatim.
+    rc = update_budgets.main(["--reason", "x", "--budgets", str(ledger)])
+    assert rc == 2
+    assert "concurrent edit" in ledger.read_text()
+
+    # Untracked ledgers (no committed baseline) do not trip the guard.
+    fresh = repo / "fresh.json"
+    fresh.write_text(original)
+    assert not update_budgets.ledger_dirty(str(fresh))
+
+    # The repo's own ledger must be committed-clean for `make lint` to
+    # regenerate without --force; this doubles as a reminder to commit
+    # budgets.json in the same PR as any budget-moving change.
+    from madsim_tpu.analysis import budgets as B
+
+    here_dirty = update_budgets.ledger_dirty(B.DEFAULT_LEDGER)
+    assert here_dirty in (True, False)  # callable against the real repo

@@ -50,6 +50,8 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.madraft_5node.xla_cost.flops_per_world_step", False),
     ("5node bytes/step",
      "configs.madraft_5node.xla_cost.bytes_accessed_per_step", False),
+    ("5node state bytes/world",
+     "configs.madraft_5node.xla_cost.state_bytes_per_world", False),
     ("5node peak/state",
      "configs.madraft_5node.xla_cost.peak_over_state", False),
     ("5node chunks/dispatch",
@@ -64,6 +66,8 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.time_to_first_bug.device_seeds_per_sec", True),
     ("ttfb flops/world-step",
      "configs.time_to_first_bug.xla_cost.flops_per_world_step", False),
+    ("ttfb state bytes/world",
+     "configs.time_to_first_bug.xla_cost.state_bytes_per_world", False),
     ("ttfb peak/state",
      "configs.time_to_first_bug.xla_cost.peak_over_state", False),
     ("ttfb hunt utilization",
@@ -212,6 +216,9 @@ def ledger_rows(round_doc: dict, round_name: str) -> List[str]:
     pairs = [
         ("engine.run flops/world-step", "engine.run", "flops_per_world",
          "configs.time_to_first_bug.xla_cost.flops_per_world_step"),
+        ("engine.run state bytes/world", "engine.run",
+         "state_bytes_per_world",
+         "configs.time_to_first_bug.xla_cost.state_bytes_per_world"),
         ("engine.run peak/state", "engine.run", "peak_over_arg",
          "configs.time_to_first_bug.xla_cost.peak_over_state"),
     ]

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""The `make smoke` pallas-interpret leg: kernel-vs-lax bitwise identity.
+
+Runs one raft config — the canonical bug config, plus an
+overflow-mid-batch variant — through the lax step path and the fused
+Pallas step kernel (``EngineConfig(pallas=True)``, interpret mode on
+CPU) and demands bit-identical final state on EVERY leaf. This is the
+executable form of the kernel's one contract (docs/perf.md "Roofline
+round 2"): the kernel body *is* the step function, so any divergence
+means the Pallas plumbing (const hoisting, aliasing, block specs)
+corrupted state. Nonzero exit on any mismatch.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main() -> int:
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from madsim_tpu.engine import (DeviceEngine, EngineConfig, RaftActor,
+                                   RaftDeviceConfig)
+
+    configs = [
+        ("raft_bug", RaftDeviceConfig(n=3, buggy_double_vote=True),
+         EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                      t_limit_us=1_000_000, stop_on_bug=False)),
+        ("raft_overflow", RaftDeviceConfig(n=3, n_proposals=2),
+         EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=8,
+                      t_limit_us=1_000_000, stop_on_bug=False)),
+    ]
+    seeds = np.arange(8)
+    failures = 0
+    for name, rcfg, cfg in configs:
+        lax_eng = DeviceEngine(RaftActor(rcfg), cfg)
+        pls_eng = DeviceEngine(RaftActor(rcfg),
+                               dataclasses.replace(cfg, pallas=True))
+        s_lax = lax_eng.run(lax_eng.init(seeds), max_steps=1_500)
+        s_pls = pls_eng.run(pls_eng.init(seeds), max_steps=1_500)
+        paths = [jax.tree_util.keystr(p) for p, _
+                 in jax.tree_util.tree_flatten_with_path(s_lax)[0]]
+        mismatched = [
+            pth for pth, a, b in zip(paths, jax.tree.leaves(s_lax),
+                                     jax.tree.leaves(s_pls))
+            if not np.array_equal(np.asarray(a), np.asarray(b))]
+        obs = lax_eng.observe(s_lax)
+        extra = ""
+        if name == "raft_overflow" and not obs["overflow"].any():
+            mismatched.append("<config failed to overflow — the "
+                              "overflow path went unexercised>")
+        if mismatched:
+            failures += 1
+            print(f"pallas_smoke: {name} DIVERGED on {mismatched}",
+                  file=sys.stderr)
+        else:
+            interest = ("bug" if name == "raft_bug" else "overflow")
+            extra = f", {interest}={int(obs[interest].sum())}/{len(seeds)}"
+            print(f"pallas_smoke: {name} bitwise identical "
+                  f"(kernel == lax, {len(paths)} leaves{extra})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,9 +17,16 @@ ledger so every budget bump carries its justification in-tree.
 ``--check`` runs the full tracelint gate instead (trace rules + ledger
 diff) — exactly what ``make tracelint`` executes — and exits nonzero on
 any finding. CI uses this mode.
+
+Regeneration REFUSES to run while the target ledger has uncommitted
+modifications in git: regeneration rewrites the whole file, so a
+concurrent hand edit (another branch's budget bump mid-review, a
+``--reason`` line being drafted) would be silently clobbered. Commit or
+stash the ledger first, or pass ``--force`` to overwrite deliberately.
 """
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -29,6 +36,23 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def ledger_dirty(path: str) -> bool:
+    """True iff ``path`` is a git-tracked file with uncommitted
+    modifications (staged or not). Untracked files and non-repo paths
+    return False: there is no committed baseline to clobber there."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--", os.path.abspath(path)],
+            cwd=directory, capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    if out.returncode != 0:
+        return False
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    return any(not ln.startswith("??") for ln in lines)
 
 
 def main(argv=None) -> int:
@@ -46,6 +70,9 @@ def main(argv=None) -> int:
                     help="with --check: machine-readable findings")
     ap.add_argument("--format", choices=("text", "json", "github"),
                     default="text")
+    ap.add_argument("--force", action="store_true",
+                    help="regenerate even over uncommitted ledger edits "
+                         "(they WILL be overwritten)")
     args = ap.parse_args(argv)
 
     if args.check:
@@ -67,9 +94,20 @@ def main(argv=None) -> int:
         return 2
 
     from madsim_tpu.analysis import budgets as B
+
+    # Refuse to clobber uncommitted ledger edits — BEFORE any (slow)
+    # measurement, so the refusal is instant and nothing is half-done.
+    guard_path = args.budgets or B.DEFAULT_LEDGER
+    if not args.force and ledger_dirty(guard_path):
+        print(f"update_budgets: {guard_path} has uncommitted "
+              "modifications; regeneration rewrites the whole file and "
+              "would silently clobber them. Commit/stash the ledger "
+              "first, or pass --force to overwrite.", file=sys.stderr)
+        return 2
+
     from madsim_tpu.analysis.tracelint import (measure_program, registry)
 
-    path = args.budgets or B.DEFAULT_LEDGER
+    path = guard_path
     try:
         prev = B.load_ledger(path).get("programs", {})
     except (FileNotFoundError, ValueError):
