@@ -10,7 +10,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint detlint tracelint test smoke dryrun determinism \
         dualmode native clean replay-demo bench-diff chaos chaos-full \
-        triage-demo
+        triage-demo fuzz-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -77,6 +77,17 @@ smoke:
 	    ('time_to_first_bug','madraft_5node')]; \
 	assert all(isinstance(x,dict) and x.get('distinct_behaviors',0)>1 \
 	           for x in cv), f'coverage records missing/flat: {cv}'; \
+	gh=d['configs'].get('guided_hunt'); \
+	assert isinstance(gh,dict) and {'pair','raft'}<=set(gh), \
+	    f'guided_hunt record missing/incomplete: {gh}'; \
+	p=gh['pair']; \
+	assert p.get('guided_seeds_to_bug') and \
+	    (p.get('random_seeds_to_bug') is None or \
+	     p['guided_seeds_to_bug']<p['random_seeds_to_bug']), \
+	    f'guided search did not beat random on the pair family: {p}'; \
+	rneed={'guided_bugs_found','random_bugs_found', \
+	       'guided_novelty_area','random_novelty_area'}; \
+	assert rneed<=set(gh['raft']), f'guided_hunt raft leg: {gh[\"raft\"]}'; \
 	print('bench_results.json ok:', d['metric'])"
 	$(CPU_ENV) $(PY) tools/pallas_smoke.py
 
@@ -103,6 +114,17 @@ chaos-full:
 # reproduces from the minimized schedule. CI runs this after chaos.
 triage-demo:
 	$(CPU_ENV) $(PY) tools/triage_demo.py
+
+# The closed fuzzer loop end to end (docs/search.md; ROADMAP item 2):
+# inject the pair-restart family (bug reachable ONLY through schedule
+# mutation), run the coverage-guided hunt vs the matched random-mutation
+# baseline — guided must reach the bug in strictly fewer seeds — then
+# triage the find to a verified 1-minimal bundle and replay it in a
+# fresh process; plus the seeded raft double-vote leg, where guided must
+# out-hunt random (failing seeds at the same budget). Nonzero exit on
+# any miss. CI runs this after triage-demo.
+fuzz-demo:
+	$(CPU_ENV) $(PY) tools/fuzz_demo.py
 
 # Regression table between two bench rounds (tools/bench_diff.py):
 # compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls

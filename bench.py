@@ -715,6 +715,67 @@ def bench_fleet_sweep(n_worlds: int) -> dict:
     return out
 
 
+def bench_guided_hunt(budget: int) -> dict:
+    """Coverage-guided schedule search vs the matched random-mutation
+    baseline (docs/search.md; search/hunts.py), on the two canonical
+    hunts the ROADMAP item-2 gate names:
+
+    - pair family: seeds-to-bug under ``stop_on_first_bug`` (the bug is
+      reachable ONLY through mutation; guided ~73 vs random ~409);
+    - seeded raft double-vote: failing seeds found at the full budget
+      (first-bug ties are expected — generation-1 children are shared
+      by construction — so the hunting-power metric is bugs-at-budget).
+
+    Both legs also record the novelty-curve area (sum of the per-chunk
+    cumulative distinct-behavior counts — a bigger area = coverage grew
+    earlier), tracked round over round by tools/bench_diff.py. The
+    pair-leg ordering (guided strictly first) is asserted inline; the
+    raft margin is gated end-to-end by `make fuzz-demo`.
+    """
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.parallel.sweep import sweep
+    from madsim_tpu.search.hunts import pair_hunt, raft_hunt
+
+    def leg(hunt, stop_first: bool) -> dict:
+        eng = DeviceEngine(hunt.actor, hunt.cfg)
+        out = {"budget": budget}
+        for mode, guided in (("guided", True), ("random", False)):
+            t0 = walltime.perf_counter()
+            res = sweep(None, hunt.cfg, np.arange(budget), engine=eng,
+                        faults=hunt.template, stop_on_first_bug=stop_first,
+                        search=hunt.search(guided), **hunt.sweep_kw)
+            dt = walltime.perf_counter() - t0
+            f = res.failing_seeds
+            out[f"{mode}_seeds_to_bug"] = (int(f[0]) + 1) if f else None
+            out[f"{mode}_bugs_found"] = len(f)
+            out[f"{mode}_novelty_area"] = int(
+                res.coverage.novelty_curve.sum())
+            out[f"{mode}_generations"] = int(res.search.generations)
+            out[f"{mode}_corpus_size"] = int(res.search.corpus_size)
+            out[f"{mode}_wall_s"] = round(dt, 3)
+        g, r = out["guided_seeds_to_bug"], out["random_seeds_to_bug"]
+        # seeds-to-bug ratio; an un-found random leg counts as budget+1
+        # (a lower bound on the true gap).
+        if g is not None:
+            out["speedup_lower_bound"] = round(
+                (r if r is not None else budget + 1) / g, 2)
+        return out
+
+    pair = leg(pair_hunt(), stop_first=True)
+    assert pair["guided_seeds_to_bug"] is not None, \
+        "guided search missed the pair-family bug inside the budget"
+    r = pair["random_seeds_to_bug"]
+    assert r is None or pair["guided_seeds_to_bug"] < r, \
+        f"guided ({pair['guided_seeds_to_bug']}) did not beat random " \
+        f"({r}) on the pair family"
+    raft = leg(raft_hunt(), stop_first=False)
+    out = {"n_seed_budget": budget, "pair": pair, "raft": raft}
+    log(f"guided_hunt[{jax.default_backend()}]: {out}")
+    return out
+
+
 def bench_minimize_bug(n_rows: int) -> dict:
     """Batched ddmin schedule minimization on the known-minimal
     synthetic bug (docs/triage.md; triage/synthetic.py): an ``n_rows``
@@ -1126,6 +1187,8 @@ _CONFIGS = [
      lambda a: bench_fleet_sweep(128 if a.smoke else 4_096)),
     ("minimize", "minimize_bug",
      lambda a: bench_minimize_bug(16 if a.smoke else 64)),
+    ("guided", "guided_hunt",
+     lambda a: bench_guided_hunt(256 if a.smoke else 512)),
     ("bridge", "bridge_sweep",
      lambda a: bench_bridge_sweep(n_host=16 if a.smoke else 64,
                                   n_bridge=64 if a.smoke else 512)),
@@ -1208,8 +1271,8 @@ def main() -> None:
     ap.add_argument("--host-seeds", type=int, default=None)
     ap.add_argument("--only", type=str, default=None,
                     help="comma list: 3node,rpc,rpc_real,grpc,postgres,"
-                         "5node,fleet,minimize,crosscheck,bug,bridge "
-                         "(3node = the headline)")
+                         "5node,fleet,minimize,guided,crosscheck,bug,"
+                         "bridge (3node = the headline)")
     ap.add_argument("--break-config", type=str, default=None,
                     help="(testing) name of a config to force-fail, proving "
                          "failure isolation keeps the headline alive")

@@ -346,6 +346,51 @@ def _build_refill_select() -> Built:
     return Built(fn=eng._refill_select, args=(mask, fresh, state))
 
 
+# Guided-search generator shape (search/generate.py): the harvest +
+# mutate program one guided refill dispatches — the "search superstep"
+# of the closed fuzzer loop (docs/search.md), at the canonical family
+# hunt shape.
+SEARCH_WORLDS = 32
+SEARCH_ROWS = 6
+
+
+def _build_search_generate() -> Built:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..parallel.mesh import scalar_spec, shard_worlds
+    from ..search.corpus import corpus_init
+    from ..search.generate import searcher
+
+    if "search_eng" not in _ENGINE_CACHE:
+        from ..engine import DeviceEngine
+        from ..search.family import (GuidedPairActor, GuidedPairConfig,
+                                     engine_config)
+
+        acfg = GuidedPairConfig(n=12)
+        _ENGINE_CACHE["search_eng"] = DeviceEngine(
+            GuidedPairActor(acfg), engine_config(acfg))
+    from ..search.family import family_schedule, hunt_search_config
+    from ..search.family import GuidedPairConfig as _GPC
+
+    eng, mesh = _ENGINE_CACHE["search_eng"], _mesh()
+    scfg = hunt_search_config(True)
+    tmpl = family_schedule(SEARCH_ROWS, _GPC(n=12))
+    w = SEARCH_WORLDS
+    runner = searcher(eng, mesh, scfg, w, SEARCH_ROWS)
+    state = shard_worlds(eng.init(np.arange(w), faults=tmpl), mesh)
+    sched = shard_worlds(jnp.asarray(
+        np.broadcast_to(tmpl, (w,) + tmpl.shape).copy()), mesh)
+    idx = shard_worlds(jnp.arange(w, dtype=jnp.int32), mesh)
+    corpus = jax.device_put(corpus_init(int(scfg.corpus), tmpl),
+                            NamedSharding(mesh, scalar_spec()))
+    ids = shard_worlds(jnp.arange(w, dtype=jnp.int32), mesh)
+    return Built(fn=runner, args=(state, sched, idx, corpus,
+                                  jnp.int32(w // 2), ids))
+
+
 # Triage candidate-eval shape (triage/minimize.py): one batch of
 # candidate schedules of the known-minimal synthetic bug, evaluated by
 # the superstep runner compiled for the pair_restart engine — a
@@ -493,6 +538,13 @@ def registry() -> Dict[str, TraceProgram]:
             f"(C={TRIAGE_CANDS} candidate schedules x F={TRIAGE_ROWS} "
             "rows over the pair_restart engine, docs/triage.md)",
             _build_triage_candidate_eval, budget=True, donates=True),
+        TraceProgram(
+            "search.generate", "guided-search harvest + mutate program "
+            f"(W={SEARCH_WORLDS} slots x F={SEARCH_ROWS} rows over the "
+            "guided_pair family engine, docs/search.md; deliberately "
+            "undonated: it only reads the state the refill then "
+            "donates)", _build_search_generate, budget=True,
+            donates=False),
         TraceProgram(
             "bridge.step", "bridge decision-kernel lockstep round "
             f"(W={BRIDGE_SLOTS}, cap={BRIDGE_CAP})", _build_bridge_step,

@@ -536,7 +536,17 @@ class DeviceEngine:
         receive the world initialized from the matching row of
         ``new_seeds`` (length W — rows outside the mask are initialized
         and immediately discarded by the select, so any placeholder seed
-        works there). ``faults``/``configs`` follow :meth:`init`.
+        works there). ``faults``/``configs`` follow :meth:`init`, plus
+        one refill-specific form: a first-class PER-SLOT schedule
+        override, ``(W, F, 4)`` with one fault block per refill slot —
+        the shape the guided-search generator emits (search/generate.py).
+        A per-slot ``faults`` may be a **device array** (``jax.Array``):
+        that path skips the host-side row-value validation — no device
+        sync ever happens inside the refill — under the documented
+        contract that device schedules are valid by construction (the
+        search mutation operators preserve validity; the seeded template
+        was validated by ``init`` at sweep start). Host arrays validate
+        as in ``init``.
 
         Worlds are position-independent, so a refilled slot's trajectory
         is bit-identical to an independent ``init``+run of that seed —
@@ -548,12 +558,62 @@ class DeviceEngine:
         ``state`` (and the internal fresh batch) are **donated** into the
         select: the argument is dead after the call — rebind the result.
         """
-        fresh = self.init(new_seeds, faults=faults, configs=configs)
+        w = int(np.asarray(new_seeds).shape[0])
+        if faults is not None and getattr(faults, "ndim", 0) == 3:
+            # Validate the per-slot leading dim HERE, naming both dims:
+            # a mismatched (m, F, 4) would otherwise surface as an
+            # opaque vmap shape error deep inside _init_batched.
+            if faults.shape[-1] != 4:
+                raise ValueError(
+                    f"per-slot fault schedules must be (n_slots, F, 4) "
+                    f"rows of [time_us, op, a, b]; got shape "
+                    f"{tuple(faults.shape)}")
+            if faults.shape[0] != w:
+                raise ValueError(
+                    f"per-slot fault schedules carry one (F, 4) block "
+                    f"per batch slot: got leading dim {faults.shape[0]} "
+                    f"but the refill batch holds {w} slots")
+        if isinstance(faults, jax.Array) and not isinstance(
+                faults, np.ndarray):
+            if faults.ndim != 3:
+                raise ValueError(
+                    f"a device-resident fault override must be per-slot "
+                    f"(n_slots, F, 4); got {faults.ndim}-D shape "
+                    f"{tuple(faults.shape)} — pass host arrays for the "
+                    "shared-schedule form")
+            fresh = self._init_device(new_seeds, faults, configs)
+        else:
+            fresh = self.init(new_seeds, faults=faults, configs=configs)
         mask = jnp.asarray(np.asarray(slot_mask, bool))
         sharding = getattr(state.now, "sharding", None)
         if isinstance(sharding, jax.sharding.NamedSharding):
             fresh, mask = jax.device_put((fresh, mask), sharding)
         return self._refill_select(mask, fresh, state)
+
+    def _init_device(self, seeds, faults, configs=None) -> WorldState:
+        """:meth:`init` for device-resident per-world fault schedules.
+
+        Identical program (the same jitted ``_init_batched``), but the
+        ``(W, F, 4)`` faults array stays on device — no value
+        validation, because ``np.any`` over a ``jax.Array`` would force
+        a blocking device→host sync in the middle of the sweep loop.
+        Callers own the validity contract (see :meth:`refill`).
+        """
+        seeds = np.asarray(seeds, dtype=np.uint64)
+        w = seeds.shape[0]
+        lo = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (seeds >> np.uint64(32)).astype(np.uint32)
+        if configs is None:
+            configs = np.array([self.cfg.latency_min_us,
+                                self.cfg.latency_max_us,
+                                self.cfg.loss_rate], np.float64)
+        configs = np.broadcast_to(np.asarray(configs, np.float64), (w, 3))
+        return self._init_batched(
+            jnp.asarray(lo), jnp.asarray(hi),
+            jnp.asarray(faults, jnp.int32),
+            jnp.asarray(configs[:, 0].astype(np.int32)),
+            jnp.asarray(configs[:, 1].astype(np.int32)),
+            jnp.asarray(configs[:, 2].astype(np.float32)))
 
     # ------------------------------------------------------------------
     # The per-world step

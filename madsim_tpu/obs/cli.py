@@ -45,6 +45,7 @@ from .timeline import dump_chrome, render_text, trace_to_chrome
 def _actor_registry() -> Dict[str, tuple]:
     from ..engine import (PBActor, PBDeviceConfig, RaftActor,
                           RaftDeviceConfig, TPCActor, TPCDeviceConfig)
+    from ..search.family import GuidedPairActor, GuidedPairConfig
     from ..triage.synthetic import PairRestartActor, PairRestartConfig
 
     return {
@@ -54,6 +55,9 @@ def _actor_registry() -> Dict[str, tuple]:
         # The triage fixture actor (triage/synthetic.py): minimized
         # corpus bundles from tests/demos replay through the same CLI.
         "pair_restart": (PairRestartActor, PairRestartConfig),
+        # The guided-hunt family (search/family.py): bundles triaged out
+        # of a guided sweep (`make fuzz-demo`) replay the same way.
+        "guided_pair": (GuidedPairActor, GuidedPairConfig),
     }
 
 

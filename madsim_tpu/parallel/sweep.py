@@ -288,10 +288,18 @@ class TriageContext(NamedTuple):
     all), the ORIGINAL fault schedule argument, and the mesh. Attached
     to every locally-run SweepResult; absent (None) on results
     reconstructed from checkpoints or merged across a fleet — those
-    must re-run the sweep to minimize."""
+    must re-run the sweep to minimize.
+
+    Guided sweeps (``search=``) attach the MATERIALIZED per-seed
+    ``(n, F, 4)`` schedules here instead of the template argument — each
+    world ran a generated child schedule, and this is what lets every
+    find pipe unchanged through ``triage.triage`` → ddmin → minimized
+    bundles (docs/search.md)."""
 
     engine: Any                 # the DeviceEngine the sweep ran
-    faults: Optional[Any]       # the faults= argument, verbatim
+    faults: Optional[Any]       # the faults= argument (or, under
+                                # search=, the materialized per-seed
+                                # schedules)
     mesh: Any                   # the mesh the sweep ran on
 
 
@@ -468,6 +476,13 @@ class SweepResult:
     # ``novelty_curve`` (cumulative distinct behaviors, aligned
     # entrywise with ``n_active_history``/``n_active_chunks``).
     coverage: Optional[Any] = None
+    # Guided-search report (search/__init__.py SearchReport), present
+    # when the sweep ran ``search=SearchConfig(...)``: final corpus
+    # contents, insert/generation counters, and the materialized
+    # per-seed ``(n, F, 4)`` schedules each world actually ran (also
+    # wired into ``triage_ctx.faults`` so triage needs no special
+    # casing).
+    search: Optional[Any] = None
     # Triage context (triage/): the engine/schedule/mesh refs
     # :meth:`minimize` and ``triage.triage`` re-execute worlds with.
     # None on reconstructed results (fleet merges, checkpoint loads).
@@ -593,7 +608,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           observe: Any = None,
           profile_dir: Optional[str] = None,
           profile_window: Tuple[int, int] = (0, 4),
-          coverage_buckets: Optional[int] = None) -> SweepResult:
+          coverage_buckets: Optional[int] = None,
+          search: Optional[Any] = None) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     The loop is a slot-occupancy model: the device batch is a fixed set of
@@ -722,6 +738,27 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     host pulls mid-loop — and lands on ``SweepResult.coverage`` with the
     per-chunk ``novelty_curve``. Requires metrics; passing an explicit
     value with a metrics-off engine raises ``ValueError``.
+
+    ``search``: a :class:`~madsim_tpu.search.SearchConfig` — coverage-
+    guided fault-schedule evolution (docs/search.md, the closed fuzzer
+    loop of ROADMAP item 2). Requires ``recycle=True`` (the feedback
+    edge IS the refill), ``EngineConfig(metrics=True)`` (novelty hashes
+    the MetricsBlock), and a non-empty ``faults`` template (the fault
+    vocabulary the operators perturb within). At every refill boundary
+    one extra jitted program (search/generate.py, registry
+    ``search.generate``) harvests the retiring slots' behavior
+    signatures into a device-resident parent corpus and generates
+    mutated/crossed-over children, which the refill installs via the
+    per-slot device schedule path of ``DeviceEngine.refill`` — zero new
+    mid-loop host pulls (corpus telemetry rides the retire pulls the
+    loop already pays; tier-1-counted). The whole guided run is a pure
+    function of (seeds, config, SearchConfig.seed): bitwise identical
+    across re-runs and across ``pipeline=True/False``, and checkpoint→
+    resume restores the corpus and per-slot schedules bit-exactly.
+    Results gain ``SweepResult.search`` (final corpus + the
+    materialized per-seed schedules), and ``triage_ctx.faults`` becomes
+    that per-seed array, so ``triage.triage``/``minimize`` work on
+    guided finds unchanged.
     """
     from ..engine import checkpoint as ckpt
 
@@ -750,6 +787,26 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     cov_k = int(coverage_buckets) if coverage_buckets else DEFAULT_BUCKETS
     if cov_on and cov_k < 1:
         raise ValueError("coverage_buckets must be >= 1")
+
+    # Guided schedule search (search/, docs/search.md): validated here,
+    # wired in at the refill boundaries below.
+    search_on = search is not None
+    if search_on:
+        if not recycle:
+            raise ValueError(
+                "search= needs recycle=True (and batch_worlds): guided "
+                "children stream into recycled refill slots — a "
+                "non-recycled sweep has no refill edge to feed")
+        if not cov_on:
+            raise ValueError(
+                "search= requires EngineConfig(metrics=True): the "
+                "novelty signal hashes the MetricsBlock histograms of "
+                "retiring worlds (obs/coverage.py)")
+        if faults is None:
+            raise ValueError(
+                "search= needs a fault-schedule template (faults=): the "
+                "mutation operators perturb within the template's fault "
+                "vocabulary — an empty schedule has nothing to evolve")
 
     # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
     # once; recycled sweeps hold batch_worlds slots and stream the rest.
@@ -894,6 +951,30 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     reordered = False                  # batch rows still == seed order?
     retired: Dict[str, list] = {}      # field → retired observation batches
     retired_rows: List[np.ndarray] = []
+    # -- guided-search state (search/, docs/search.md) --------------------
+    # slot_sched: the (W, F, 4) schedule each slot is CURRENTLY running,
+    # device-resident and permuted/refilled in lockstep with the state —
+    # the attribution that makes generated children replayable. corpus:
+    # the mesh-replicated parent pool (search/corpus.py).
+    slot_sched = corpus = None
+    retired_sched: List[np.ndarray] = []
+    search_host = {"corpus_size": 1, "inserted": 0}
+    if search_on:
+        from ..search.corpus import corpus_init
+        from ..search.generate import searcher as _searcher
+        from ..triage.shrink import normalize as _normalize_sched
+
+        f_rows = int(faults_p.shape[-2])
+        base0 = (faults_p[:w0] if per_world_faults
+                 else np.broadcast_to(faults_p, (w0,) + faults_p.shape))
+        slot_sched = shard_worlds(
+            jnp.asarray(np.ascontiguousarray(base0), jnp.int32), mesh)
+        # Corpus seeded with the (normalized) template: parents always
+        # exist, so generation-1 children mutate the original schedule.
+        template = _normalize_sched(
+            faults_p[0] if per_world_faults else faults_p)
+        corpus = jax.device_put(corpus_init(int(search.corpus), template),
+                                NamedSharding(mesh, scalar_spec()))
     if resumed and recycle:
         # Rehydrate the sweep-level bookkeeping the checkpoint carried:
         # the slot→seed index (device-resident again), the refill
@@ -909,6 +990,39 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             for key in resume_aux:
                 if key.startswith("ret_") and key != "ret_rows":
                     retired[key[4:]] = [np.asarray(resume_aux[key])]
+        if search_on != ("srch_sched" in resume_aux):
+            raise ckpt.CheckpointError(
+                f"checkpoint {checkpoint_path!r} was written by a "
+                f"{'guided' if 'srch_sched' in resume_aux else 'plain'} "
+                f"sweep but this resume is "
+                f"{'guided (search=...)' if search_on else 'plain'}: "
+                "the per-slot schedules and search corpus cannot be "
+                "reconciled — resume with the original search setting")
+        if search_on:
+            # Restore the search state bit-exactly: the per-slot
+            # schedules, the parent corpus (incl. its generation and
+            # insert counters), and the retired-schedule attribution.
+            from ..search.corpus import CorpusState
+
+            slot_sched = shard_worlds(jnp.asarray(
+                np.asarray(resume_aux["srch_sched"], np.int32)), mesh)
+            corpus = jax.device_put(CorpusState(
+                sched=jnp.asarray(np.asarray(resume_aux["srch_c_sched"],
+                                             np.int32)),
+                sig=jnp.asarray(np.asarray(resume_aux["srch_c_sig"],
+                                           np.uint32)),
+                score=jnp.asarray(np.asarray(resume_aux["srch_c_score"],
+                                             np.int32)),
+                filled=jnp.asarray(np.asarray(resume_aux["srch_c_filled"],
+                                              bool)),
+                gen=jnp.asarray(np.asarray(resume_aux["srch_c_gen"],
+                                           np.int32).reshape(())),
+                inserted=jnp.asarray(np.asarray(
+                    resume_aux["srch_c_inserted"], np.int32).reshape(())),
+            ), NamedSharding(mesh, scalar_spec()))
+            if "srch_ret" in resume_aux:
+                retired_sched.append(
+                    np.asarray(resume_aux["srch_ret"], np.int32))
     n_active_hist: List[int] = []
     n_active_chunk: List[int] = []     # chunk index each entry measured at
     issued_slot_steps = 0              # sum over chunks of width*chunk_steps
@@ -989,34 +1103,56 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             rec["coverage_distinct"] = (int(novelty_hist[-1])
                                         if novelty_hist else 0)
             rec["coverage_buckets"] = cov_k
+        if search_on:
+            # Host mirrors of the corpus scalars, refreshed by the
+            # retire pulls (never an extra device sync).
+            rec["search_corpus"] = search_host["corpus_size"]
+            rec["search_inserted"] = search_host["inserted"]
         emit_telemetry(rec)
 
-    def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray) -> None:
+    def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray,
+               sched_slice: Optional[np.ndarray] = None) -> None:
         """Record final observations for rows leaving the batch (dead
-        slots — already retired earlier — are filtered out by idx)."""
+        slots — already retired earlier — are filtered out by idx).
+        ``sched_slice`` (guided sweeps) carries the retiring rows'
+        materialized fault schedules, filtered identically."""
         nonlocal live_world_steps
         keep = rows >= 0
         if not keep.all():
             rows = rows[keep]
             obs_slice = {k: np.asarray(v)[keep] for k, v in obs_slice.items()}
+            if sched_slice is not None:
+                sched_slice = np.asarray(sched_slice)[keep]
         if rows.size == 0:
             return
         live_world_steps += int(np.asarray(obs_slice["steps"]).sum())
         retired_rows.append(rows)
         for k, v in obs_slice.items():
             retired.setdefault(k, []).append(np.asarray(v))
+        if sched_slice is not None:
+            retired_sched.append(np.asarray(sched_slice, np.int32))
 
     def fetch_retire(handles) -> None:
         """Materialize a deferred on-device retirement slice and record
         it. The pull covers ONLY the (bucketed) frozen-tail rows — the
-        full per-world observation arrays never cross to the host."""
-        obs_t, idx_t, tail_len = handles
+        full per-world observation arrays never cross to the host. On a
+        guided sweep the same single ``_fetch`` additionally carries the
+        tail's schedule rows and the corpus telemetry scalars — the
+        "corpus syncs ride the existing cadence" half of the zero-new-
+        syncs contract (tests/test_search.py counts this)."""
+        obs_t, idx_t, tail_len, sched_t, stats_t = handles
         t0 = _clk()
-        obs_h, idx_h = _fetch((obs_t, idx_t))
+        obs_h, idx_h, sched_h, stats_h = _fetch(
+            (obs_t, idx_t, sched_t, stats_t))
         perf["retire_wait_s"] += _clk() - t0
         perf["retire_fetches"] += 1
+        if stats_h is not None:
+            search_host["corpus_size"] = int(stats_h[0])
+            search_host["inserted"] = int(stats_h[1])
         retire({k: np.asarray(v)[:tail_len] for k, v in obs_h.items()},
-               np.asarray(idx_h)[:tail_len])
+               np.asarray(idx_h)[:tail_len],
+               (np.asarray(sched_h)[:tail_len]
+                if sched_h is not None else None))
 
     def do_refill(n_act: int):
         """World recycling: stable active-first partition on device,
@@ -1024,9 +1160,22 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         cursor. Only the n_active scalar (already on host) shapes the
         refill mask; the tail observations are sliced on device and
         returned as un-fetched handles so the pull can overlap later
-        dispatches."""
-        nonlocal state, idx, cursor, reordered
-        state, idx = _compactor(eng, mesh, w_cur, w_cur)(state, idx)
+        dispatches.
+
+        Guided sweeps (``search=``) widen this boundary, still with zero
+        host pulls: the per-slot schedule array compacts alongside the
+        state, the retiring tail's schedules join the deferred handles,
+        and ONE extra jitted dispatch (search/generate.py) harvests the
+        tail into the corpus and generates the children the refill
+        installs through ``DeviceEngine.refill``'s device-schedule
+        path."""
+        nonlocal state, idx, cursor, reordered, slot_sched, corpus
+        if search_on:
+            state, idx, slot_sched = _compactor(
+                eng, mesh, w_cur, w_cur, with_sched=True)(
+                    state, idx, slot_sched)
+        else:
+            state, idx = _compactor(eng, mesh, w_cur, w_cur)(state, idx)
         reordered = True
         tail_len = w_cur - n_act
         rows = min(_pow2_at_least(tail_len), _pow2_at_least(w_cur))
@@ -1040,26 +1189,50 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         mask = np.zeros(w_cur, bool)
         mask[n_act:n_act + take] = True
         fill_ids = np.maximum(repl, 0)
-        state = shard_worlds(
-            eng.refill(state, mask, seeds_p[fill_ids],
-                       faults=batch_faults(fill_ids)), mesh)
+        sched_t = stats_t = None
+        if search_on:
+            sched_t = _sched_tail(eng, mesh, w_cur, rows)(
+                slot_sched, jnp.int32(n_act))
+            new_ids = shard_worlds(
+                jnp.asarray(fill_ids.astype(np.int32)), mesh)
+            children, corpus, stats_t = _searcher(
+                eng, mesh, search, w_cur, f_rows)(
+                    state, slot_sched, idx, corpus, jnp.int32(n_act),
+                    new_ids)
+            state = shard_worlds(
+                eng.refill(state, mask, seeds_p[fill_ids],
+                           faults=children), mesh)
+            slot_sched = jnp.where(
+                jnp.asarray(mask)[:, None, None], children, slot_sched)
+        else:
+            state = shard_worlds(
+                eng.refill(state, mask, seeds_p[fill_ids],
+                           faults=batch_faults(fill_ids)), mesh)
         idx = jnp.where(jnp.asarray(np.arange(w_cur) >= n_act),
                         jnp.asarray(repl), idx)
-        return obs_t, idx_t, tail_len
+        return obs_t, idx_t, tail_len, sched_t, stats_t
 
     def do_shrink(new_w: int):
         """Shrink compaction, fully on device: permutation, split, and
         the live batch's mesh placement all happen inside one jitted
         program (out_shardings = the world sharding). Returns the frozen
-        tail's observation handles, un-fetched."""
-        nonlocal state, idx, reordered, w_cur
-        (state, idx), (frozen, fidx) = \
-            _compactor(eng, mesh, w_cur, new_w)(state, idx)
+        tail's observation handles, un-fetched. Guided sweeps split the
+        per-slot schedule array with the state so the frozen tail keeps
+        its schedule attribution."""
+        nonlocal state, idx, reordered, w_cur, slot_sched
+        if search_on:
+            (state, idx, slot_sched), (frozen, fidx, fsched) = \
+                _compactor(eng, mesh, w_cur, new_w, with_sched=True)(
+                    state, idx, slot_sched)
+        else:
+            fsched = None
+            (state, idx), (frozen, fidx) = \
+                _compactor(eng, mesh, w_cur, new_w)(state, idx)
         reordered = True
         tail_len = w_cur - new_w
         w_cur = new_w
         obs_t, idx_t = _observer(eng)(frozen, fidx)
-        return obs_t, idx_t, tail_len
+        return obs_t, idx_t, tail_len, fsched, None
 
     def ckpt_aux(cov_pair):
         """Sweep-level aux for a recycled checkpoint, captured at submit
@@ -1078,6 +1251,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             aux["ret_rows"] = list(retired_rows)
             for k, v in retired.items():
                 aux[f"ret_{k}"] = list(v)
+        if search_on:
+            # Search state rides the same aux channel: per-slot
+            # schedules + the whole corpus (device refs the writer
+            # thread pulls; consistent with the submitted state because
+            # submits are epoch-gated and search state only changes at
+            # epoch bumps), plus the retired-schedule attribution.
+            aux["srch_sched"] = slot_sched
+            aux["srch_c_sched"] = corpus.sched
+            aux["srch_c_sig"] = corpus.sig
+            aux["srch_c_score"] = corpus.score
+            aux["srch_c_filled"] = corpus.filled
+            aux["srch_c_gen"] = corpus.gen
+            aux["srch_c_inserted"] = corpus.inserted
+            if retired_sched:
+                aux["srch_ret"] = list(retired_sched)
         return aux
 
     try:
@@ -1346,7 +1534,15 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             state, cov_hits, cov_first, idx, n_real_dev, jnp.asarray(True))
 
     obs_live = eng.observe(state)
-    if cov_on:
+    sched_live_h = corpus_h = None
+    if cov_on and search_on:
+        # Search state rides the final ledger pull — still ONE _fetch.
+        idx_h, cov_hits_h, cov_first_h, sched_live_h, corpus_h = _fetch(
+            (idx, cov_hits, cov_first, slot_sched, corpus))
+        idx_h, cov_hits_h, cov_first_h = (
+            np.asarray(x) for x in (idx_h, cov_hits_h, cov_first_h))
+        sched_live_h = np.asarray(sched_live_h, np.int32)
+    elif cov_on:
         # The ledger rides the final slot-index pull — still ONE _fetch.
         idx_h, cov_hits_h, cov_first_h = (
             np.asarray(x) for x in _fetch((idx, cov_hits, cov_first)))
@@ -1358,6 +1554,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     # seed order — after any reorder/retirement, OR when a recycled sweep
     # exited (stop_on_first_bug / max_steps) before its first refill, so
     # only the first w0 < n_ids seeds were ever admitted.
+    sched_per_seed = None
     if reordered or retired_rows or w0 < n_ids:
         rows = np.concatenate(retired_rows + [idx_h[live_keep]])
         obs = {}
@@ -1370,9 +1567,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             out = np.zeros((n_ids,) + merged.shape[1:], merged.dtype)
             out[rows] = merged
             obs[k] = out
+        if search_on:
+            merged_s = np.concatenate(
+                retired_sched + [sched_live_h[live_keep]], axis=0)
+            sched_out = np.full((n_ids,) + merged_s.shape[1:], -1,
+                                np.int32)
+            sched_out[:, :, 1:] = 0  # canonical DISABLED_ROW padding
+            sched_out[rows] = merged_s
+            sched_per_seed = sched_out
     else:
         obs = obs_live
+        if search_on:
+            sched_per_seed = sched_live_h
     obs = {k: v[:n] for k, v in obs.items()}
+    if sched_per_seed is not None:
+        sched_per_seed = sched_per_seed[:n]
     util = (live_world_steps / issued_slot_steps if issued_slot_steps
             else 0.0)
     loop_stats = {
@@ -1396,6 +1605,27 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     }
     coverage = (coverage_from_device(cov_k, cov_hits_h, cov_first_h,
                                      novelty_hist) if cov_on else None)
+    search_report = None
+    triage_faults = faults
+    if search_on:
+        from ..search import SearchReport
+
+        c_filled = np.asarray(corpus_h.filled, bool)
+        search_report = SearchReport(
+            generations=int(np.asarray(corpus_h.gen)),
+            inserted=int(np.asarray(corpus_h.inserted)),
+            corpus_size=int(c_filled.sum()),
+            corpus_capacity=int(c_filled.shape[0]),
+            corpus_sched=np.asarray(corpus_h.sched, np.int32),
+            corpus_sig=np.asarray(corpus_h.sig, np.uint32),
+            corpus_score=np.asarray(corpus_h.score, np.int32),
+            corpus_filled=c_filled,
+            schedules=sched_per_seed,
+        )
+        # Triage sees the MATERIALIZED per-seed schedules: a guided
+        # find's minimize/triage path re-executes the child schedule
+        # the world actually ran, not the template.
+        triage_faults = sched_per_seed
     result = SweepResult(seeds=seeds, bug=obs["bug"], observations=obs,
                          steps_run=steps, n_devices=n_dev,
                          n_active_history=np.asarray(n_active_hist,
@@ -1407,8 +1637,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                          faults_sha256=(seeds_meta["faults_sha256"]
                                         if faults is not None else None),
                          coverage=coverage,
+                         search=search_report,
                          triage_ctx=TriageContext(engine=eng,
-                                                  faults=faults,
+                                                  faults=triage_faults,
                                                   mesh=mesh))
     if emit_telemetry is not None:
         final = {
@@ -1422,6 +1653,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         }
         if coverage is not None:
             final["coverage"] = coverage.to_json()
+        if search_report is not None:
+            final["search"] = search_report.to_json()
         emit_telemetry(final)
     if close_telemetry is not None:
         close_telemetry()
@@ -1455,7 +1688,8 @@ def _permute_worlds(state, perm):
     return jax.tree.map(lambda x: x[perm], state)
 
 
-def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
+def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int,
+               with_sched: bool = False):
     """Compile (and cache per engine) the on-device compaction program.
 
     The program computes the stable active-first permutation of a
@@ -1468,6 +1702,12 @@ def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
     ``n_active`` scalar the chunk runner already returned. Shrink widths
     are power-of-two buckets, so at most log2(W) programs compile.
 
+    ``with_sched`` (guided sweeps, search/): the program additionally
+    permutes/splits the per-slot ``(W, F, 4)`` schedule array in the
+    same dispatch, so schedule attribution travels with the worlds.
+    A distinct cache key — ``search=None`` sweeps compile the exact
+    pre-search program (tier-1, tests/test_search.py).
+
     Deliberately NOT donated: the permutation is a gather, whose output
     XLA can never alias onto its input (an in-place permute would read
     clobbered rows), so donating here frees nothing and trips the
@@ -1476,20 +1716,40 @@ def _compactor(eng: DeviceEngine, mesh: Mesh, w: int, new_w: int):
     lives 99% of the time — is the donated path.
     """
     cache = eng.__dict__.setdefault("_compactor_cache", {})
-    key = (mesh, w, new_w)
+    key = (mesh, w, new_w, with_sched)
     if key in cache:
         return cache[key]
 
-    def compacted(state, idx):
+    def compacted(state, idx, *sched):
         order = jnp.argsort((~state.active).astype(jnp.int32), stable=True)
-        state, idx = _permute_worlds((state, idx), order)
+        group = (state, idx) + sched
+        group = _permute_worlds(group, order)
         if new_w == w:
-            return state, idx
-        live = jax.tree.map(lambda x: x[:new_w], (state, idx))
-        frozen = jax.tree.map(lambda x: x[new_w:], (state, idx))
+            return group
+        live = jax.tree.map(lambda x: x[:new_w], group)
+        frozen = jax.tree.map(lambda x: x[new_w:], group)
         return live, frozen
 
     fn = jax.jit(compacted, out_shardings=world_sharding(mesh))
+    cache[key] = fn
+    return fn
+
+
+def _sched_tail(eng: DeviceEngine, mesh: Mesh, w: int, rows: int):
+    """Compile (and cache per engine) the frozen-tail schedule gather —
+    the :func:`_tail_observer` twin for the guided sweep's per-slot
+    ``(W, F, 4)`` schedule array, sharing its bucketed-``rows`` compile
+    bound and its clamp-and-slice contract."""
+    cache = eng.__dict__.setdefault("_sched_tail_cache", {})
+    key = (mesh, w, rows)
+    if key in cache:
+        return cache[key]
+
+    def tail(sched, start):
+        take = jnp.clip(start + jnp.arange(rows, dtype=jnp.int32), 0, w - 1)
+        return jnp.take(sched, take, axis=0)
+
+    fn = jax.jit(tail)
     cache[key] = fn
     return fn
 

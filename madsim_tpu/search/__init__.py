@@ -1,0 +1,89 @@
+"""Coverage-guided fault-schedule search: the closed fuzzer loop.
+
+The generator half of PAPER.md's always-on hunting service (ROADMAP
+item 2). PR 6 built the feedback signal (the device-resident behavior-
+coverage ledger) and PR 9 the triage back end (batched ddmin + the
+deduplicated corpus of minimized repro bundles); this package closes
+the loop by *generating new inputs*: retiring worlds' fault schedules
+are scored for novelty against a device-resident corpus, novel
+survivors become parents, and ``sweep(recycle=True,
+search=SearchConfig(...))`` refills retired slots with mutated/crossed-
+over children instead of fixed schedules — device-hours in, a
+1-minimal deduplicated failure corpus out (every find pipes unchanged
+through ``triage.triage`` → ddmin → minimized bundles, because the
+sweep materializes each world's actual schedule into its triage
+context).
+
+Module map (docs/search.md):
+
+- :mod:`~madsim_tpu.search.config` — ``SearchConfig``, the static knobs.
+- :mod:`~madsim_tpu.search.rng` — device splitmix64 lanes (counter-based
+  mutation randomness; bit-identical to the fleet's host splitmix64).
+- :mod:`~madsim_tpu.search.corpus` — the device-resident parent corpus
+  + novelty scoring (signature sketch distance).
+- :mod:`~madsim_tpu.search.mutate` — splice/disable/jitter/rotate/flip
+  operators, validity-preserving by construction.
+- :mod:`~madsim_tpu.search.generate` — the jitted harvest+generate
+  program (tracelint registry: ``search.generate``).
+- :mod:`~madsim_tpu.search.family` — ``GuidedPairActor``, the
+  conjunction-bug family with observable progress that ``bench.py
+  guided_hunt`` and ``make fuzz-demo`` gate on.
+"""
+import dataclasses as _dc
+from typing import Dict as _Dict
+
+import numpy as _np
+
+from .config import SearchConfig
+from .corpus import EMPTY_NOVELTY, CorpusState, corpus_init
+from .family import (
+    GuidedPairActor,
+    GuidedPairConfig,
+    engine_config,
+    family_schedule,
+)
+
+
+@_dc.dataclass
+class SearchReport:
+    """Host-side outcome of one guided sweep (``SweepResult.search``).
+
+    ``schedules`` is the materialized per-seed ``(n, F, 4)`` array of
+    the schedule each seed's world ACTUALLY ran (template rows for the
+    first batch, generated children after) — the attribution that makes
+    a guided find replayable and triageable; it is also installed as
+    ``SweepResult.triage_ctx.faults``. The corpus arrays are the final
+    device corpus, pulled once at sweep end.
+    """
+
+    generations: int             # guided-refill generations run
+    inserted: int                # total corpus inserts over the sweep
+    corpus_size: int             # filled corpus entries at exit
+    corpus_capacity: int
+    corpus_sched: _np.ndarray    # (K, F, 4) parent schedules
+    corpus_sig: _np.ndarray      # (K,) u32 signatures at insert
+    corpus_score: _np.ndarray    # (K,) novelty at insert (-0 unfilled)
+    corpus_filled: _np.ndarray   # (K,) bool
+    schedules: _np.ndarray       # (n, F, 4) per-seed materialized rows
+
+    def to_json(self) -> _Dict[str, object]:
+        """Compact JSON-safe record (bench_results.json ``search``)."""
+        return {
+            "generations": int(self.generations),
+            "inserted": int(self.inserted),
+            "corpus_size": int(self.corpus_size),
+            "corpus_capacity": int(self.corpus_capacity),
+        }
+
+
+__all__ = [
+    "SearchConfig",
+    "SearchReport",
+    "CorpusState",
+    "corpus_init",
+    "EMPTY_NOVELTY",
+    "GuidedPairActor",
+    "GuidedPairConfig",
+    "family_schedule",
+    "engine_config",
+]

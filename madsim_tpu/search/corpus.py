@@ -1,0 +1,143 @@
+# tracelint: hot-loop
+"""Device-resident schedule corpus: the parent pool of the guided hunt.
+
+A fixed-capacity ledger of ``K`` surviving high-novelty ``(F, 4)`` fault
+schedules, carried as mesh-replicated device arrays exactly like the
+PR 6 coverage ledger (obs/coverage.py) — the sweep loop syncs it to the
+host only on the cadence it already pays (the retire pulls and the final
+fetch), never mid-loop.
+
+Novelty is the sketch distance of a retiring world's u32 behavior
+signature (obs/coverage.behavior_signature over its MetricsBlock
+histograms) against every corpus entry's recorded signature: the minimum
+Hamming distance in signature bits, 33 against an empty corpus. A world
+clears the bar (``SearchConfig.min_novelty``) iff its behavior class is
+far enough from everything the corpus already holds — the AFL "keep
+inputs that light new coverage" rule with the comparison run entirely
+on device.
+
+Insertion is SEQUENTIAL over the retiring tail (a ``fori_loop``), so a
+batch retiring several novel worlds folds them one at a time against the
+corpus as it updates — two worlds with the same fresh signature insert
+once, and the fold order (slot order after compaction) is deterministic,
+which is half of the guided sweep's bitwise-reproducibility contract
+(the other half is the counter-based mutation lanes, search/rng.py).
+Replacement is worst-first: a candidate lands in the lowest-score slot
+(unfilled slots score -1, so they fill first; ``argmin`` ties resolve to
+the lowest index), and only if its novelty strictly beats that score.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Novelty of a signature against an EMPTY corpus: one more than the
+# maximum Hamming distance of two u32s, so the first insert always wins.
+EMPTY_NOVELTY = 33
+
+
+class CorpusState(NamedTuple):
+    """The device corpus (all leaves mesh-replicated).
+
+    ``gen`` counts refill generations (bumped once per guided refill —
+    the generation half of the (seed, generation) child key);
+    ``inserted`` counts total corpus inserts, for telemetry.
+    """
+
+    sched: jnp.ndarray     # (K, F, 4) i32 parent schedules
+    sig: jnp.ndarray       # (K,) u32 behavior signature at insert
+    score: jnp.ndarray     # (K,) i32 novelty at insert
+    filled: jnp.ndarray    # (K,) bool
+    gen: jnp.ndarray       # () i32 refill-generation counter
+    inserted: jnp.ndarray  # () i32 total inserts
+
+
+def corpus_init(k: int, template: np.ndarray) -> CorpusState:
+    """A fresh corpus seeded with the (normalized) template schedule in
+    slot 0 — parents always exist, so generation 1 children are
+    mutations of the original schedule. The template's signature is
+    unknown until a world runs; it is recorded as 0 with score 0, so the
+    first real survivor may replace it."""
+    template = np.asarray(template, np.int32)
+    f = template.shape[0]
+    sched = np.zeros((k, f, 4), np.int32)
+    sched[:, :, 0] = -1                      # DISABLED_ROW sentinels
+    sched[0] = template
+    filled = np.zeros((k,), bool)
+    filled[0] = True
+    return CorpusState(
+        sched=jnp.asarray(sched),
+        sig=jnp.zeros((k,), jnp.uint32),
+        score=jnp.zeros((k,), jnp.int32),
+        filled=jnp.asarray(filled),
+        gen=jnp.int32(0),
+        inserted=jnp.int32(0),
+    )
+
+
+def popcount32(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-element population count of a u32 array (SWAR; exact integer
+    math, bit-stable across backends like coverage's _bit_length_u32)."""
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> jnp.uint32(1)) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> jnp.uint32(2))
+                                        & jnp.uint32(0x33333333))
+    x = (x + (x >> jnp.uint32(4))) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> jnp.uint32(24)).astype(jnp.int32)
+
+
+def novelty(sig: jnp.ndarray, corpus: CorpusState) -> jnp.ndarray:
+    """Sketch distance of one signature against the corpus: the minimum
+    Hamming distance (bits) to any filled entry's signature,
+    :data:`EMPTY_NOVELTY` when nothing is filled."""
+    d = popcount32(sig ^ corpus.sig)
+    d = jnp.where(corpus.filled, d, jnp.int32(EMPTY_NOVELTY))
+    return jnp.min(d)
+
+
+def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
+                 sigs: jnp.ndarray, fold_mask: jnp.ndarray,
+                 min_novelty: int) -> Tuple[CorpusState, jnp.ndarray]:
+    """Fold the masked worlds' schedules into the corpus, sequentially.
+
+    ``sched`` is the (W, F, 4) per-slot schedule array, ``sigs`` the
+    (W,) u32 behavior signatures, ``fold_mask`` the (W,) bool of worlds
+    retiring in this harvest. Returns the updated corpus and the number
+    of inserts performed. Runs at the refill boundary — the same world-
+    retirement edge the PR 6 coverage fold observes — where a retired
+    slot's MetricsBlock is still frozen in place.
+    """
+    w = sigs.shape[0]
+
+    def body(j, carry):
+        c, n_ins = carry
+        nov = novelty(sigs[j], c)
+        key = jnp.where(c.filled, c.score, jnp.int32(-1))
+        tgt = jnp.argmin(key).astype(jnp.int32)
+        do = fold_mask[j] & (nov >= jnp.int32(min_novelty)) \
+            & (nov > key[tgt])
+        c = CorpusState(
+            sched=jnp.where(do, c.sched.at[tgt].set(sched[j]), c.sched),
+            sig=jnp.where(do, c.sig.at[tgt].set(sigs[j]), c.sig),
+            score=jnp.where(do, c.score.at[tgt].set(nov), c.score),
+            filled=jnp.where(do, c.filled.at[tgt].set(True), c.filled),
+            gen=c.gen,
+            inserted=c.inserted + do.astype(jnp.int32),
+        )
+        return c, n_ins + do.astype(jnp.int32)
+
+    return jax.lax.fori_loop(0, w, body, (corpus, jnp.int32(0)))
+
+
+def pick_filled(corpus: CorpusState, draws: jnp.ndarray) -> jnp.ndarray:
+    """Map u32 draws to filled corpus indices, uniformly over the filled
+    entries (corpus_init guarantees at least one). ``draws`` may carry
+    any batch shape; the result holds i32 corpus indices."""
+    cum = jnp.cumsum(corpus.filled.astype(jnp.int32), dtype=jnp.int32)
+    n_f = jnp.maximum(cum[-1], jnp.int32(1))
+    j = (draws % n_f.astype(jnp.uint32)).astype(jnp.int32)
+    # Index of the (j+1)-th filled slot: first k with cum[k] == j+1.
+    return jnp.searchsorted(cum, j + 1, side="left").astype(jnp.int32)

@@ -1,0 +1,84 @@
+# tracelint: hot-loop
+"""The search generator program: harvest + mutate, one jitted dispatch.
+
+This is the device half of the closed fuzzer loop (docs/search.md): at
+every refill boundary of a guided ``sweep(recycle=True, search=...)``
+the sweep dispatches ONE compiled program that
+
+1. **harvests** — computes the behavior signature
+   (obs/coverage.behavior_signature) of every slot retiring in this
+   refill, scores each against the device-resident corpus (sketch
+   distance, search/corpus.py), and folds the novel survivors' schedules
+   in, sequentially and deterministically; then
+2. **generates** — emits one child ``(F, 4)`` schedule per slot by
+   tournament-selecting parents from the updated corpus and applying the
+   splice/mutation operators (search/mutate.py) under per-slot
+   splitmix64 lanes keyed by ``(search seed, slot seed id, generation)``
+   (search/rng.py).
+
+The program reads the post-compaction world state (the retiring tail's
+MetricsBlock is frozen in place until the slots are refilled — the same
+world-retirement edge the PR 6 coverage fold observes) and returns the
+children, the updated corpus, and two telemetry scalars
+``(corpus_filled, corpus_inserted_total)`` that ride the retire pull the
+sweep already pays — zero new mid-loop host syncs (the counted-_fetch
+contract, tests/test_search.py).
+
+Cached per ``(mesh, batch width, schedule rows, SearchConfig)`` on the
+engine, like every other sweep program; it is registered in the
+tracelint program registry as ``search.generate`` with ledger budgets
+(analysis/budgets.json).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ..obs.coverage import behavior_signature
+from ..parallel.mesh import scalar_spec, world_sharding
+from .config import SearchConfig
+from .corpus import CorpusState, harvest_fold
+from .mutate import make_children
+
+
+def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
+    """Compile (and cache per engine) the harvest+generate program.
+
+    Signature: ``(state, sched, idx, corpus, n_act, new_ids) ->
+    (children, corpus', (n_filled, n_inserted))`` where ``state`` is the
+    post-compaction batch (active-first), ``sched`` the (W, F, 4)
+    per-slot schedule array permuted with it, ``idx`` the slot→seed
+    index, ``n_act`` the live count (rows past it are the retiring
+    tail), and ``new_ids`` the (W,) seed ids the refilled slots will
+    run. With ``scfg.guided=False`` the harvest is compiled out — the
+    corpus stays at the seeded template and the children are the
+    matched random-mutation baseline.
+    """
+    cache = eng.__dict__.setdefault("_searcher_cache", {})
+    key = (mesh, w, f_rows, scfg)
+    if key in cache:
+        return cache[key]
+
+    rep = NamedSharding(mesh, scalar_spec())
+
+    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids):
+        if scfg.guided:
+            sigs = behavior_signature(state.metrics)          # (W,) u32
+            rows_r = jnp.arange(w, dtype=jnp.int32)
+            hmask = (rows_r >= n_act) & (idx >= 0) & ~state.active
+            corpus, _ = harvest_fold(corpus, sched, sigs, hmask,
+                                     scfg.min_novelty)
+        gen1 = corpus.gen + jnp.int32(1)
+        children = make_children(scfg, eng.cfg, corpus, new_ids, gen1)
+        corpus = corpus._replace(gen=gen1)
+        n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
+        return children, corpus, (n_filled, corpus.inserted)
+
+    out_sh = (world_sharding(mesh),
+              CorpusState(sched=rep, sig=rep, score=rep, filled=rep,
+                          gen=rep, inserted=rep),
+              (rep, rep))
+    fn = jax.jit(run, out_shardings=out_sh)
+    cache[key] = fn
+    return fn

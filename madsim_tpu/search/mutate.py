@@ -1,0 +1,177 @@
+# tracelint: hot-loop
+"""Schedule mutation/crossover: the child generators of the guided hunt.
+
+Operators over ``(F, 4)`` fault-schedule rows ``[time_us, op, a, b]``,
+vectorized over a ``(W, F)`` batch inside the jitted generator program
+(search/generate.py). Validity is preserved **by construction**, which
+is what lets the sweep's refill path skip host-side value validation
+(``DeviceEngine.refill``'s device-schedule contract): given parents
+whose enabled rows are valid for the engine config — the seeded template
+is validated by ``init()`` at sweep start, children by induction — every
+operator below maps valid rows to valid rows:
+
+- **two-parent row splice** (``splice_pct`` per row): take the row from
+  the second parent instead of the first — also the only way a disabled
+  row revives, which keeps ragged schedules reachable in both
+  directions.
+- **row disable**: rewrite to the canonical ``DISABLED_ROW``
+  (triage/shrink.py's drop-as-disable representation — shapes stay
+  static, and triage's dedup sees canonical arrays).
+- **time jitter**: fire time moves by up to ±``time_jitter_us``,
+  clamped to ``[1, t_limit_us - 1]`` (never disables, never escapes the
+  simulated window).
+- **node/param perturbation**: node ops rotate their target(s) within
+  ``[0, n_nodes)``; ``SET_LOSS`` resamples its ppm in ``[0, 1e6]``;
+  ``SET_LATENCY`` resamples a legal window above its min.
+- **op flip**: replace the op within its argument-compatible class —
+  {KILL, RESTART, PAUSE, RESUME}, {CLOG_NODE, UNCLOG_NODE},
+  {CLOG_LINK, UNCLOG_LINK}. Net-config ops never flip (their params
+  ride the payload channel with its own width precondition), so a
+  template without SET rows can never grow one.
+
+Each row draws ONE structural mutation from the cumulative
+``SearchConfig`` distribution (disable | time | node | op | none), after
+the splice draw — matching the classic mutation-stacking of
+coverage-guided fuzzers while keeping the per-row draw budget static.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine.core import (
+    FAULT_CLOG_LINK,
+    FAULT_CLOG_NODE,
+    FAULT_KILL,
+    FAULT_PAUSE,
+    FAULT_RESTART,
+    FAULT_RESUME,
+    FAULT_SET_LATENCY,
+    FAULT_SET_LOSS,
+    FAULT_UNCLOG_LINK,
+    FAULT_UNCLOG_NODE,
+)
+from .config import SearchConfig
+from .corpus import CorpusState, pick_filled
+from .rng import lanes_u32, pct, stream_key
+
+# Draws consumed per row / per slot (search/rng.py lane layout).
+ROW_DRAWS = 5      # splice, select, time, node, op
+SLOT_DRAWS = 4     # parent 1 tournament pair, parent 2 tournament pair
+
+# Argument-compatible op-flip classes: liveness ops (a = node, b unused),
+# node clogs, link clogs. A flip rotates within the row's class.
+_LIVENESS = (FAULT_KILL, FAULT_RESTART, FAULT_PAUSE, FAULT_RESUME)
+
+
+def _i32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.int32)
+
+
+def _flip_op(op: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
+    """The op-flip operator: a different member of the row's class
+    (uniform over the other members), identity for SET_* rows."""
+    lv = jnp.stack([_i32(o) for o in _LIVENESS])
+    # Position of op within the liveness class (4 members -> +1..+3).
+    is_lv = ((op == FAULT_KILL) | (op == FAULT_RESTART)
+             | (op == FAULT_PAUSE) | (op == FAULT_RESUME))
+    lv_pos = (_i32(op == FAULT_RESTART) * 1 + _i32(op == FAULT_PAUSE) * 2
+              + _i32(op == FAULT_RESUME) * 3)
+    lv_new = lv[(lv_pos + 1 + (r % jnp.uint32(3)).astype(jnp.int32)) % 4]
+    node_clog = (op == FAULT_CLOG_NODE) | (op == FAULT_UNCLOG_NODE)
+    link_clog = (op == FAULT_CLOG_LINK) | (op == FAULT_UNCLOG_LINK)
+    out = jnp.where(is_lv, lv_new, op)
+    out = jnp.where(node_clog,
+                    _i32(FAULT_CLOG_NODE) + _i32(FAULT_UNCLOG_NODE)
+                    - op, out)
+    out = jnp.where(link_clog,
+                    _i32(FAULT_CLOG_LINK) + _i32(FAULT_UNCLOG_LINK)
+                    - op, out)
+    return out
+
+
+def make_children(scfg: SearchConfig, ecfg, corpus: CorpusState,
+                  seed_ids: jnp.ndarray, generation) -> jnp.ndarray:
+    """Generate one child schedule per slot: ``(W, F, 4)`` i32.
+
+    ``seed_ids`` is the (W,) i32 vector of the seed ids the refilled
+    slots will simulate (placeholders for unrefilled slots — their
+    children are discarded by the refill select). Every child is a pure
+    function of ``(SearchConfig.seed, seed_id, generation)`` plus the
+    corpus contents: bitwise reproducible, replayable, and identical
+    between the serial and pipelined sweep loops (which call this at
+    identical refill points).
+    """
+    f_rows = corpus.sched.shape[1]
+    n = int(ecfg.n_nodes)
+    jitter = (int(scfg.time_jitter_us) if scfg.time_jitter_us
+              else max(int(ecfg.t_limit_us) // 16, 1))
+    t_max = int(ecfg.t_limit_us) - 1
+
+    x0 = stream_key(scfg.seed, seed_ids, generation)
+    draws = lanes_u32(x0, SLOT_DRAWS + f_rows * ROW_DRAWS)  # (W, D)
+    rows_d = draws[:, SLOT_DRAWS:].reshape(
+        draws.shape[0], f_rows, ROW_DRAWS)
+    r_splice, r_sel, r_t, r_n, r_o = (rows_d[..., k] for k in range(5))
+
+    def tournament(da, db):
+        """Binary tournament over the filled entries: of two uniform
+        picks, keep the higher insertion-novelty score (first pick on
+        ties) — the standard selection-pressure knob of evolutionary
+        fuzzers, deterministic given the corpus."""
+        ca, cb = pick_filled(corpus, da), pick_filled(corpus, db)
+        return jnp.where(corpus.score[cb] > corpus.score[ca], cb, ca)
+
+    p1 = tournament(draws[:, 0], draws[:, 1])
+    p2 = tournament(draws[:, 2], draws[:, 3])
+    base = corpus.sched[p1]      # (W, F, 4)
+    other = corpus.sched[p2]
+
+    # Two-parent splice, per row.
+    row = jnp.where((pct(r_splice) < _i32(scfg.splice_pct))[..., None],
+                    other, base)
+    t, op, a, b = (row[..., k] for k in range(4))
+    enabled = t >= 0
+
+    # One structural mutation per row, drawn from the cumulative ranges.
+    m = pct(r_sel)
+    c_dis = _i32(scfg.disable_pct)
+    c_time = c_dis + _i32(scfg.time_pct)
+    c_node = c_time + _i32(scfg.node_pct)
+    c_op = c_node + _i32(scfg.op_pct)
+    do_dis = enabled & (m < c_dis)
+    do_time = enabled & (m >= c_dis) & (m < c_time)
+    do_node = enabled & (m >= c_time) & (m < c_node)
+    do_op = enabled & (m >= c_node) & (m < c_op)
+
+    # Time jitter: ±jitter, clamped inside the simulated window.
+    delta = (r_t % jnp.uint32(2 * jitter + 1)).astype(jnp.int32) - jitter
+    t = jnp.where(do_time, jnp.clip(t + delta, 1, t_max), t)
+
+    # Node/param perturbation.
+    is_set_lat = op == FAULT_SET_LATENCY
+    is_set_loss = op == FAULT_SET_LOSS
+    is_link = (op == FAULT_CLOG_LINK) | (op == FAULT_UNCLOG_LINK)
+    is_node_op = ~is_set_lat & ~is_set_loss
+    rot_a = (a + 1 + (r_n % jnp.uint32(max(n - 1, 1))).astype(jnp.int32)) \
+        % _i32(n)
+    rot_b = (b + 1 + ((r_n >> jnp.uint32(8))
+                      % jnp.uint32(max(n - 1, 1))).astype(jnp.int32)) \
+        % _i32(n)
+    new_loss = (r_n % jnp.uint32(1_000_001)).astype(jnp.int32)
+    new_lat_hi = a + 1 + (r_n % jnp.uint32(1_000_000)).astype(jnp.int32)
+    a = jnp.where(do_node & is_node_op, rot_a,
+                  jnp.where(do_node & is_set_loss, new_loss, a))
+    b = jnp.where(do_node & is_link, rot_b,
+                  jnp.where(do_node & is_set_lat, new_lat_hi, b))
+
+    # Op flip within the argument-compatible class.
+    op = jnp.where(do_op, _flip_op(op, r_o), op)
+
+    t = jnp.where(do_dis, _i32(-1), t)
+    child = jnp.stack([t, op, a, b], axis=-1)
+    # Canonical disabled rows (triage/shrink.py DISABLED_ROW), so
+    # schedule identity is bitwise no matter which operator disabled a
+    # row.
+    disabled = child[..., 0] < 0
+    return jnp.where(disabled[..., None],
+                     jnp.asarray([-1, 0, 0, 0], jnp.int32), child)

@@ -1,0 +1,129 @@
+# tracelint: hot-loop
+"""Device splitmix64 lanes: the mutation randomness of the guided hunt.
+
+The schedule generator (search/mutate.py) needs per-slot random draws
+INSIDE the jitted refill-boundary program, and they must be counter-based
+— a pure function of ``(search seed, slot seed id, generation, draw
+index)`` — so every generated child is replayable from the sweep's
+inputs alone (the counter-PRNG reproducibility argument of PAPERS.md;
+the same property the engine gets from Threefry in engine/rng.py and the
+fleet fabric gets from its host splitmix64 in fleet/rpc.py).
+
+This module is the device twin of :func:`madsim_tpu.fleet.rpc.splitmix64`
+— bit-identical by construction (tier-1, tests/test_search.py): a u64 is
+carried as two u32 limbs because the sweep runs with the x64 flag off,
+and the 64-bit adds/multiplies of the splitmix64 finalizer are spelled
+out in 32/16-bit partial products. Stream keys are derived through
+engine/rng.py's Threefry (the engine's one key-derivation function), so
+the search stream can never collide with the simulation streams that
+share the same world seed.
+
+Draw layout: slot ``w`` with generation ``g`` gets the 64-bit stream
+state ``x0 = threefry2x32(search_seed, seed_id(w), g, STREAM_SEARCH)``
+and lane ``i`` is ``splitmix64(x0 + i * GAMMA)`` — the host function
+applied at an offset counter, with the low 32 bits used as the draw.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..ops.threefry import threefry2x32_jax
+
+# The splitmix64 increment (golden-ratio gamma) and finalizer constants,
+# split into u32 limbs (hi, lo). Values match fleet/rpc.py exactly.
+_GAMMA = (0x9E3779B9, 0x7F4A7C15)
+_MUL1 = (0xBF58476D, 0x1CE4E5B9)
+_MUL2 = (0x94D049BB, 0x133111EB)
+
+# Threefry stream id of the search generator — far outside the engine's
+# actor/device stream ids so search draws never alias simulation draws.
+STREAM_SEARCH = 0x5EA7C4
+
+
+def _u32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _add64(a: Tuple, b: Tuple) -> Tuple:
+    """(hi, lo) + (hi, lo) mod 2^64 in u32 limbs."""
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return a[0] + b[0] + carry, lo
+
+
+def _mul32_64(a: jnp.ndarray, b: jnp.ndarray) -> Tuple:
+    """Full 32x32 -> 64 product of two u32s, as (hi, lo) u32 limbs
+    (16-bit partial products; jax has no u32 mulhi primitive)."""
+    a0, a1 = a & _u32(0xFFFF), a >> _u32(16)
+    b0, b1 = b & _u32(0xFFFF), b >> _u32(16)
+    lo = a0 * b0
+    mid = a1 * b0 + a0 * b1          # may wrap u32: the wrap IS the carry
+    mid_carry = (mid < a1 * b0).astype(jnp.uint32) << _u32(16)
+    hi = a1 * b1 + (mid >> _u32(16)) + mid_carry
+    lo2 = lo + ((mid & _u32(0xFFFF)) << _u32(16))
+    hi = hi + (lo2 < lo).astype(jnp.uint32)
+    return hi, lo2
+
+
+def _mul64(a: Tuple, b: Tuple) -> Tuple:
+    """(hi, lo) * (hi, lo) mod 2^64: full low product + wrapping cross
+    terms into the high limb."""
+    hi, lo = _mul32_64(a[1], b[1])
+    hi = hi + a[1] * b[0] + a[0] * b[1]
+    return hi, lo
+
+
+def _shr64_xor(x: Tuple, s: int) -> Tuple:
+    """x ^ (x >> s) for 0 < s < 32, in limbs."""
+    hi, lo = x
+    sh_lo = (lo >> _u32(s)) | (hi << _u32(32 - s))
+    sh_hi = hi >> _u32(s)
+    return hi ^ sh_hi, lo ^ sh_lo
+
+
+def splitmix64_dev(x: Tuple) -> Tuple:
+    """One splitmix64 step on a (hi, lo) u32-limb u64 — bit-identical to
+    :func:`madsim_tpu.fleet.rpc.splitmix64` (tier-1-tested parity)."""
+    x = _add64(x, (_u32(_GAMMA[0]), _u32(_GAMMA[1])))
+    x = _shr64_xor(x, 30)
+    x = _mul64(x, (_u32(_MUL1[0]), _u32(_MUL1[1])))
+    x = _shr64_xor(x, 27)
+    x = _mul64(x, (_u32(_MUL2[0]), _u32(_MUL2[1])))
+    return _shr64_xor(x, 31)
+
+
+def stream_key(search_seed: int, seed_ids: jnp.ndarray,
+               generation) -> Tuple:
+    """Per-slot 64-bit stream state ``x0`` from the search seed, the
+    slot's (refill) seed id vector, and the generation counter — derived
+    through engine/rng.py's Threefry so the search stream is disjoint
+    from every simulation stream of the same world seed."""
+    ids = jnp.asarray(seed_ids, jnp.int32).astype(jnp.uint32)
+    gen = jnp.asarray(generation, jnp.int32).astype(jnp.uint32)
+    k0, k1 = threefry2x32_jax(
+        _u32(search_seed & 0xFFFFFFFF) ^ ids,
+        _u32((search_seed >> 32) & 0xFFFFFFFF),
+        gen, _u32(STREAM_SEARCH))
+    return k1, k0  # (hi, lo)
+
+
+def lanes_u32(x0: Tuple, n_draws: int) -> jnp.ndarray:
+    """``n_draws`` u32 lanes per stream: lane ``i`` is the low limb of
+    ``splitmix64(x0 + i * GAMMA)`` (counter-based — no carried state).
+    ``x0`` limbs may carry leading batch axes; the draw axis is appended
+    last, so the result is ``x0.shape + (n_draws,)``."""
+    i = jnp.arange(n_draws, dtype=jnp.uint32)
+    # i * GAMMA in limbs, broadcast against the stream batch axes.
+    g_hi, g_lo = _mul32_64(i, _u32(_GAMMA[1]))
+    g_hi = g_hi + i * _u32(_GAMMA[0])
+    hi = x0[0][..., None] + jnp.zeros_like(g_hi)
+    lo = x0[1][..., None] + jnp.zeros_like(g_lo)
+    ctr = _add64((hi, lo), (g_hi, g_lo))
+    return splitmix64_dev(ctr)[1]
+
+
+def pct(draw: jnp.ndarray) -> jnp.ndarray:
+    """Map a u32 draw to an int32 percent bucket in [0, 100)."""
+    return (draw % _u32(100)).astype(jnp.int32)

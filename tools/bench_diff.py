@@ -94,6 +94,20 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.minimize_bug.candidates_evaluated", False),
     ("minimize wall s", "configs.minimize_bug.wall_s", False),
     ("minimize final rows", "configs.minimize_bug.final_rows", False),
+    # Guided-search hunting power (docs/search.md; bench_guided_hunt):
+    # seeds-to-bug on the pair family (lower = the staircase is
+    # working), the lower-bound speedup vs the matched random baseline,
+    # and bugs-at-budget on the seeded raft double-vote.
+    ("guided pair seeds-to-bug",
+     "configs.guided_hunt.pair.guided_seeds_to_bug", False),
+    ("guided pair speedup>=",
+     "configs.guided_hunt.pair.speedup_lower_bound", True),
+    ("guided raft bugs",
+     "configs.guided_hunt.raft.guided_bugs_found", True),
+    ("random raft bugs",
+     "configs.guided_hunt.raft.random_bugs_found", False),
+    ("guided raft novelty area",
+     "configs.guided_hunt.raft.guided_novelty_area", True),
 ]
 
 

@@ -110,6 +110,42 @@ def run_matrix(n_seeds: int = 64) -> int:
     return failures
 
 
+def run_guided_leg(n_seeds: int = 96) -> int:
+    """Guided-refill chaos leg (docs/search.md): a chaotic guided fleet
+    must equal a crash-free guided fleet BITWISE — per-seed
+    observations, bug flags, and the materialized schedules' effects.
+    (No single-host comparison here: each leased range evolves its own
+    corpus, so guided fleet results are deterministic per (seeds, range
+    partitioning, SearchConfig), not partition-invariant.)"""
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.fleet import ChaosConfig, fleet_sweep
+    from madsim_tpu.search.hunts import pair_hunt
+
+    hunt = pair_hunt()
+    eng = DeviceEngine(hunt.actor, hunt.cfg)
+    seeds = np.arange(n_seeds)
+    kw = dict(engine=eng, faults=hunt.template,
+              search=hunt.search(True), **hunt.sweep_kw)
+    clean = fleet_sweep(None, hunt.cfg, seeds, n_workers=2,
+                        range_size=n_seeds // 2, **kw)
+    chaotic = fleet_sweep(None, hunt.cfg, seeds, n_workers=2,
+                          range_size=n_seeds // 2,
+                          chaos=ChaosConfig(seed=7, kill_at=(("w1", 2),),
+                                            drop_rpc_rate=0.2,
+                                            restart_after=2), **kw)
+    bad = _contract_equal(clean, chaotic)
+    stats = chaotic.loop_stats["fleet"]
+    ok = not bad and stats["kills"] > 0
+    print(json.dumps({
+        "family": "guided_pair(guided refill)", "ok": ok,
+        "n_seeds": n_seeds,
+        "contract_mismatches": bad,
+        "injected": {k: stats[k] for k in ("kills", "leases_reissued",
+                                           "rpc_retries")},
+    }))
+    return 0 if ok else 1
+
+
 def run_process_leg(n_seeds: int = 32) -> int:
     from madsim_tpu.engine import (
         DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
@@ -146,6 +182,7 @@ def main() -> int:
                     help="also run the multiprocess (spawn) leg")
     args = ap.parse_args()
     failures = run_matrix(args.seeds)
+    failures += run_guided_leg()
     if args.process:
         failures += run_process_leg()
     if failures:
