@@ -88,6 +88,15 @@ smoke:
 	rneed={'guided_bugs_found','random_bugs_found', \
 	       'guided_novelty_area','random_novelty_area'}; \
 	assert rneed<=set(gh['raft']), f'guided_hunt raft leg: {gh[\"raft\"]}'; \
+	gf=d['configs'].get('guided_fleet'); \
+	fneed={'exchanged_seeds_to_bug','independent_seeds_to_bug', \
+	       'exchanged_bugs_found','independent_bugs_found', \
+	       'exchange_overhead_frac','epochs_merged','publishes'}; \
+	assert isinstance(gf,dict) and fneed<=set(gf), \
+	    f'guided_fleet record missing/incomplete: {gf}'; \
+	assert gf.get('exchanged_seeds_to_bug') and \
+	    gf['exchanged_bugs_found']>=gf['independent_bugs_found'], \
+	    f'exchanged fleet did not hold the cross-range gate: {gf}'; \
 	print('bench_results.json ok:', d['metric'])"
 	$(CPU_ENV) $(PY) tools/pallas_smoke.py
 

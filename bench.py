@@ -776,6 +776,93 @@ def bench_guided_hunt(budget: int) -> dict:
     return out
 
 
+def bench_guided_fleet(budget: int) -> dict:
+    """Cross-range corpus exchange vs independent-corpus fleet
+    (docs/fleet.md "Corpus exchange"), on the pair family at a range
+    size DELIBERATELY too small to climb the staircase alone: 64-seed
+    ranges under a ~73-seed bug mean an independent fleet can never
+    reach it — partition-dependence made visible — while the exchanged
+    fleet chains corpus progress across epochs and finds it. Records
+    seeds-to-bug both ways (the acceptance gate: exchanged reaches the
+    bug in no more seeds than the best independent range, asserted
+    inline), bugs at budget, merge/publish traffic, and the exchange
+    overhead fraction tools/bench_diff.py tracks round over round."""
+    import jax
+
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.fleet import ExchangeConfig, fleet_sweep
+    from madsim_tpu.fleet.lease import split_ranges
+    from madsim_tpu.search.hunts import pair_hunt
+
+    hunt = pair_hunt()
+    eng = DeviceEngine(hunt.actor, hunt.cfg)
+    seeds = np.arange(budget)
+    range_size = 64
+    kw = dict(engine=eng, faults=hunt.template, search=hunt.search(True),
+              stop_on_first_bug=True, **hunt.sweep_kw)
+
+    def best_seeds_to_bug(res):
+        """Fewest seeds INTO any one range before its first find (the
+        per-range analog of guided_hunt's seeds-to-bug; None = no range
+        found the bug)."""
+        fails = sorted(int(s) for s in res.failing_seeds)
+        per = [s - r.lo + 1 for r in split_ranges(budget, range_size)
+               for s in fails if r.lo <= s < r.hi]
+        return min(per) if per else None
+
+    # Warmup compiles the engine + search programs on the real shapes so
+    # the timed runs measure orchestration, not XLA.
+    fleet_sweep(None, hunt.cfg, seeds[:range_size], n_workers=1,
+                range_size=range_size, **kw)
+    t0 = walltime.perf_counter()
+    independent = fleet_sweep(None, hunt.cfg, seeds, n_workers=2,
+                              range_size=range_size, **kw)
+    dt_ind = walltime.perf_counter() - t0
+    t0 = walltime.perf_counter()
+    exchanged = fleet_sweep(None, hunt.cfg, seeds, n_workers=2,
+                            range_size=range_size,
+                            exchange=ExchangeConfig(every=1), **kw)
+    dt_exc = walltime.perf_counter() - t0
+
+    st = exchanged.loop_stats["fleet"]
+    ind_best = best_seeds_to_bug(independent)
+    exc_best = best_seeds_to_bug(exchanged)
+    out = {
+        "budget": budget, "range_size": range_size, "exchange_every": 1,
+        "independent_seeds_to_bug": ind_best,
+        "exchanged_seeds_to_bug": exc_best,
+        "independent_bugs_found": len(independent.failing_seeds),
+        "exchanged_bugs_found": len(exchanged.failing_seeds),
+        "exchanged_first_global_seed": (
+            int(exchanged.failing_seeds[0]) + 1
+            if exchanged.failing_seeds else None),
+        "epochs_merged": st["epochs_merged"],
+        "merge_inserts": st["merge_inserts"],
+        "publishes": st["publishes"],
+        "publish_bytes": st["publish_bytes"],
+        "broadcast_bytes": st["broadcast_bytes"],
+        "merged_corpus_size": int(exchanged.search.corpus_size),
+        "independent_wall_s": round(dt_ind, 3),
+        "exchanged_wall_s": round(dt_exc, 3),
+        # >0 = the exchange costs wall time vs the independent fleet
+        # (epoch barriers serialize rounds + merge/broadcast work).
+        "exchange_overhead_frac": round(1 - dt_ind / dt_exc, 4),
+    }
+    # The acceptance gate: the exchanged fleet reaches the bug in no
+    # more seeds-into-a-range than the best independent range (an
+    # un-found independent leg counts as range_size+1, a lower bound).
+    assert exc_best is not None, \
+        "exchanged fleet missed the pair bug — exchange is not chaining " \
+        "corpus progress across epochs (retune fleet/exchange.py)"
+    assert exc_best <= (ind_best if ind_best is not None
+                        else range_size + 1), \
+        f"exchanged fleet needed {exc_best} seeds vs best independent " \
+        f"range's {ind_best}"
+    assert len(exchanged.failing_seeds) >= len(independent.failing_seeds)
+    log(f"guided_fleet[{jax.default_backend()}]: {out}")
+    return out
+
+
 def bench_minimize_bug(n_rows: int) -> dict:
     """Batched ddmin schedule minimization on the known-minimal
     synthetic bug (docs/triage.md; triage/synthetic.py): an ``n_rows``
@@ -1189,6 +1276,12 @@ _CONFIGS = [
      lambda a: bench_minimize_bug(16 if a.smoke else 64)),
     ("guided", "guided_hunt",
      lambda a: bench_guided_hunt(256 if a.smoke else 512)),
+    # Budget pinned at 320/512 regardless of --smoke depth: the
+    # exchanged fleet's first find lands in epoch 4 (global seed ~294),
+    # and per-range evolution is budget-prefix-stable, so 320 covers
+    # the gate at smoke cost.
+    ("gfleet", "guided_fleet",
+     lambda a: bench_guided_fleet(320 if a.smoke else 512)),
     ("bridge", "bridge_sweep",
      lambda a: bench_bridge_sweep(n_host=16 if a.smoke else 64,
                                   n_bridge=64 if a.smoke else 512)),

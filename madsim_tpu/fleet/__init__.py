@@ -25,6 +25,12 @@ default; ``spawn="process"`` for real OS workers with pipes+signals).
 """
 from .chaos import ChaosConfig, ChaosPolicy
 from .coordinator import Coordinator, FLEET_SCHEMA
+from .exchange import (
+    EXCHANGE_SCHEMA,
+    CorpusExchange,
+    ExchangeConfig,
+    TornPayloadError,
+)
 from .fabric import FleetStalledError, LocalFabric, fleet_sweep
 from .lease import Lease, LeaseTable, SeedRange, split_ranges
 from .merge import (
@@ -44,8 +50,10 @@ from .rpc import (
 from .worker import LeaseLost, LeasePreempted, Worker, WorkerKilled
 
 __all__ = [
-    "ChaosConfig", "ChaosPolicy", "Coordinator", "FLEET_SCHEMA",
+    "ChaosConfig", "ChaosPolicy", "Coordinator", "CorpusExchange",
+    "EXCHANGE_SCHEMA", "ExchangeConfig", "FLEET_SCHEMA",
     "FleetIntegrityError", "FleetStalledError", "InlineTransport",
+    "TornPayloadError",
     "Lease", "LeaseLost", "LeasePreempted", "LeaseTable", "LocalFabric",
     "RealClock", "RetryExhausted", "RetryPolicy", "RpcError",
     "SeedRange", "VirtualClock", "Worker", "WorkerKilled",

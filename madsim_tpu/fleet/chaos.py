@@ -59,6 +59,14 @@ class ChaosConfig:
     duplicate_completion_rate: float = 0.0
     duplicate_all_completions: bool = False
     tear_checkpoint_on_kill: bool = False
+    # Corpus-exchange publish tearing (fleet/exchange.py): flip a byte
+    # of the snapshot in flight so the coordinator's checksum rejects it
+    # and the worker must re-send. ``tear_publish_at`` entries are
+    # (worker_id, nth-publish-attempt) pairs (1-based, per worker);
+    # ``tear_publish_rate`` rolls per attempt — re-sends re-roll, so
+    # convergence is guaranteed for rates < 1.
+    tear_publish_at: Tuple[Tuple[str, int], ...] = ()
+    tear_publish_rate: float = 0.0
     restart_after: int = 2
     max_kills_per_worker: int = 2
 
@@ -119,6 +127,21 @@ class ChaosPolicy:
         self._rpc_seq[key] = seq + 1
         return unit_hash(c.seed, worker_id, method, seq, "rpc") \
             < c.drop_rpc_rate
+
+    def tear_publish(self, worker_id: str) -> bool:
+        """Corrupt this corpus publish in flight? Counted per worker
+        publish ATTEMPT, so an explicit ``tear_publish_at`` entry tears
+        exactly once and the re-send goes through clean."""
+        c = self.config
+        if not c.tear_publish_at and c.tear_publish_rate <= 0:
+            return False
+        key = f"{worker_id}:pub"
+        n = self._rpc_seq.get(key, 0) + 1
+        self._rpc_seq[key] = n
+        if (worker_id, n) in set(c.tear_publish_at):
+            return True
+        return c.tear_publish_rate > 0 and \
+            unit_hash(c.seed, worker_id, n, "tearpub") < c.tear_publish_rate
 
     def duplicate_completion(self, worker_id: str) -> bool:
         c = self.config

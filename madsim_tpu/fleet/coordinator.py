@@ -39,7 +39,7 @@ class Coordinator:
     """
 
     def __init__(self, seeds, range_size: int, lease_ttl: float, clock,
-                 emit=None, n_devices: int = 1):
+                 emit=None, n_devices: int = 1, exchange=None):
         self.seeds = np.asarray(seeds, np.uint64)
         self.ranges: List[SeedRange] = split_ranges(
             self.seeds.shape[0], range_size)
@@ -47,6 +47,11 @@ class Coordinator:
         self.clock = clock
         self.n_devices = n_devices
         self._emit = emit
+        # Cross-range corpus exchange (fleet/exchange.py CorpusExchange,
+        # or None): gates lease issue on the epoch barrier, delivers
+        # seed corpora with leases, and accepts/dedupes snapshot
+        # publishes.
+        self.exchange = exchange
         self.results: Dict[int, SweepResult] = {}
         self.stats: Dict[str, int] = {
             "ranges": len(self.ranges),
@@ -73,9 +78,19 @@ class Coordinator:
     # -- the RPC surface -------------------------------------------------
     def rpc_acquire(self, worker_id: str) -> Optional[Dict[str, Any]]:
         """Hand the next pending range to ``worker_id`` (None: nothing
-        pending — all ranges leased out or done; idle and retry)."""
+        pending — all ranges leased out or done, or every pending range
+        is held back by the exchange's epoch barrier; idle and retry).
+
+        Under an exchange the lease additionally carries the range's
+        deterministic seed corpus (the merged previous-epoch corpus;
+        None for epoch 0) — a re-issued lease for a killed worker's
+        range gets the SAME corpus its first holder did, which is the
+        bounded-loss contract."""
         self._reap()
-        lease = self.table.issue(worker_id, self.clock.now())
+        eligible = (self.exchange.eligible
+                    if self.exchange is not None else None)
+        lease = self.table.issue(worker_id, self.clock.now(),
+                                 eligible=eligible)
         if lease is None:
             return None
         self.stats["leases_issued"] += 1
@@ -87,7 +102,7 @@ class Coordinator:
                   generation=lease.generation,
                   reissued=lease.generation > 0,
                   resume_checkpoint=lease.checkpoint)
-        return {
+        out = {
             "lease_id": lease.lease_id,
             "range_id": lease.range.range_id,
             "lo": lease.range.lo,
@@ -96,6 +111,13 @@ class Coordinator:
             "expires_at": lease.expires_at,
             "checkpoint": lease.checkpoint,
         }
+        if self.exchange is not None:
+            rid = lease.range.range_id
+            out["exchange_epoch"] = self.exchange.epoch_of(rid)
+            out["exchange_gen0"] = self.exchange.gen0_of(rid)
+            out["corpus"] = self.exchange.seed_payload(rid,
+                                                      worker=worker_id)
+        return out
 
     def rpc_heartbeat(self, worker_id: str, lease_id: int,
                       progress: Optional[Dict[str, Any]] = None
@@ -136,6 +158,15 @@ class Coordinator:
         if first:
             self.results[range_id] = result
             self.stats["completions"] += 1
+            if self.exchange is not None and \
+                    not self.exchange.has(range_id):
+                # Backstop publish: a worker that completed but whose
+                # explicit publish was lost (crash between the two
+                # RPCs, retry exhaustion) must not stall the epoch
+                # barrier — the completion payload carries the same
+                # final corpus, so the coordinator publishes it through
+                # the identical dedupe/crosscheck path.
+                self._publish_from_result(worker_id, range_id, result)
         else:
             self.stats["duplicate_completions"] += 1
             crosscheck_duplicate(range_id, self.results[range_id], result)
@@ -146,6 +177,29 @@ class Coordinator:
                   n_seeds=int(np.asarray(result.seeds).shape[0]),
                   failing=len(result.failing_seeds))
         return {"accepted": True, "duplicate": not first}
+
+    def rpc_publish(self, worker_id: str, range_id: int,
+                    snapshot: Any) -> Dict[str, Any]:
+        """Accept a range's corpus snapshot (cross-range exchange,
+        fleet/exchange.py). Torn payloads are discarded and re-requested
+        (``torn=True`` tells the sender to re-send); duplicates resolve
+        by bitwise crosscheck — mismatch raises FleetIntegrityError."""
+        if self.exchange is None:
+            return {"accepted": False, "torn": False, "disabled": True}
+        return self.exchange.publish(range_id, snapshot, worker=worker_id)
+
+    def _publish_from_result(self, worker_id: str, range_id: int,
+                             result: SweepResult) -> None:
+        from ..search.corpus import HostCorpus
+        from .exchange import corpus_payload
+
+        rep = getattr(result, "search", None)
+        if rep is None:
+            return
+        payload = corpus_payload(HostCorpus(
+            sched=rep.corpus_sched, sig=rep.corpus_sig,
+            score=rep.corpus_score, filled=rep.corpus_filled))
+        self.exchange.publish(range_id, payload, worker=worker_id)
 
     def rpc_poll_done(self, worker_id: str) -> Dict[str, Any]:
         """Is the hunt over? Idle workers (acquire returned None because
@@ -173,14 +227,47 @@ class Coordinator:
     def done(self) -> bool:
         return len(self.results) == len(self.ranges)
 
+    def stall_report(self) -> str:
+        """One line per outstanding range, naming the holder, its lease
+        generation, last accepted heartbeat, and deadline — or why a
+        pending range cannot issue (exchange barrier). This is what
+        FleetStalledError carries instead of a bare range count, so the
+        post-mortem starts at the sick range, not at a grep."""
+        now = self.clock.now()
+        lines: List[str] = []
+        for rid in sorted(self.table.outstanding()):
+            lease = self.table.lease_for_range(rid)
+            if lease is not None:
+                beat = ("never" if lease.last_heartbeat < 0
+                        else f"t={lease.last_heartbeat:g}")
+                lines.append(
+                    f"range {rid}: held by {lease.worker_id} (lease "
+                    f"{lease.lease_id}, generation {lease.generation}, "
+                    f"heartbeats {lease.heartbeats}, last heartbeat "
+                    f"{beat}, expires t={lease.expires_at:g})")
+                continue
+            blocked = (self.exchange.blocked_reason(rid)
+                       if self.exchange is not None else None)
+            lines.append(f"range {rid}: pending"
+                         + (f", {blocked}" if blocked else " re-issue"))
+        return (f"outstanding ranges at t={now:g}:\n  "
+                + "\n  ".join(lines)) if lines else "no outstanding ranges"
+
     def finalize(self, fleet_stats: Optional[Dict[str, Any]] = None
                  ) -> SweepResult:
         """Merge all range results into the fleet SweepResult and emit
-        the summary telemetry record."""
+        the summary telemetry record. Under an exchange the result also
+        carries the fleet-level ``search`` report: the final merged
+        corpus plus the per-seed materialized schedules."""
         stats = dict(self.stats)
+        if self.exchange is not None:
+            stats.update(self.exchange.stats)
         stats.update(fleet_stats or {})
         result = merge_range_results(self.seeds, self.ranges, self.results,
                                      self.n_devices, fleet_stats=stats)
+        if self.exchange is not None:
+            result.search = self.exchange.fleet_report(
+                int(self.seeds.shape[0]), self.ranges, self.results)
         self.emit("fleet_summary", seeds_total=int(self.seeds.shape[0]),
                   failing=len(result.failing_seeds), **stats)
         return result

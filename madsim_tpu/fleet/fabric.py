@@ -37,8 +37,11 @@ from .worker import Worker
 class FleetStalledError(RuntimeError):
     """The fabric cannot make progress: every worker is permanently dead
     (restarts disabled) or the scheduling round budget ran out with
-    ranges still outstanding. Carries the coordinator's stats so the
-    post-mortem starts with data."""
+    ranges still outstanding. The message carries the coordinator's
+    per-range stall report — each stuck range with its holding worker,
+    lease generation, last accepted heartbeat, and deadline (or the
+    exchange-barrier reason a pending range cannot issue) — plus the
+    fleet stats, so the post-mortem starts at the sick range."""
 
 
 class LocalFabric:
@@ -62,8 +65,7 @@ class LocalFabric:
             if rounds > self.max_rounds:
                 raise FleetStalledError(
                     f"no convergence after {self.max_rounds} scheduling "
-                    f"rounds; outstanding ranges: "
-                    f"{self.coordinator.table.outstanding()}; "
+                    f"rounds; {self.coordinator.stall_report()}\n"
                     f"stats: {self.coordinator.stats}")
             alive = 0
             for w in self.workers:
@@ -81,8 +83,7 @@ class LocalFabric:
                                    and self.chaos.restarts_enabled):
                 raise FleetStalledError(
                     "all workers dead with restarts disabled; "
-                    f"outstanding ranges: "
-                    f"{self.coordinator.table.outstanding()}")
+                    f"{self.coordinator.stall_report()}")
             # The scheduler's own tick: even an all-idle round moves
             # fabric time, so a dead worker's lease always expires and a
             # downed worker's restart timer always fires.
@@ -120,6 +121,7 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
                 retry: Optional[RetryPolicy] = None,
                 max_rounds: int = 100_000,
                 spawn: str = "inline",
+                exchange: Any = None,
                 **sweep_kwargs) -> SweepResult:
     """Distribute a seed sweep over a resilient coordinator/worker fleet.
 
@@ -157,6 +159,22 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
     (fleet/process.py) — the deployment shape, minus the determinism of
     the scheduler (results are still bitwise identical; schedules are
     not).
+
+    ``exchange``: an :class:`~madsim_tpu.fleet.exchange.ExchangeConfig`
+    — cross-range corpus exchange for guided fleets (requires
+    ``search=SearchConfig(...)`` in the sweep kwargs; docs/fleet.md
+    "Corpus exchange"). Ranges partition into exchange epochs by range
+    id (``exchange.every`` per epoch; default one epoch per worker
+    round); each epoch's ranges seed their sweeps from the merged
+    corpus of the previous epoch, published snapshots dedupe by range
+    with bitwise crosscheck, torn publishes are discarded and re-sent,
+    and the merged corpus persists at ``exchange.state_path`` (default
+    ``<checkpoint_dir>/exchange_state.npz`` when checkpointing) for
+    coordinator crash→resume. Results are bitwise deterministic per
+    (seeds, partitioning, exchange cadence, SearchConfig) — chaos
+    cannot move them — and the merged result's ``search`` carries the
+    final fleet corpus plus the per-seed materialized schedules.
+    Inline fabric only.
     """
     from ..engine.core import DeviceEngine
 
@@ -168,6 +186,21 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
         raise ValueError("n_workers must be >= 1")
     if range_size is None:
         range_size = max(1, -(-n // (2 * n_workers)))
+    if exchange is not None:
+        scfg = sweep_kwargs.get("search")
+        if scfg is None:
+            raise ValueError(
+                "exchange= needs search=SearchConfig(...): the corpus "
+                "exchange shares guided-search progress across ranges — "
+                "a plain fleet sweep has no corpus to exchange")
+        if faults is None:
+            raise ValueError(
+                "exchange= needs the fault-schedule template (faults=): "
+                "the merged corpora evolve within its fault vocabulary")
+        if spawn != "inline":
+            raise ValueError(
+                "exchange= currently requires spawn='inline': the "
+                "process fabric does not pipe corpus snapshots yet")
     if spawn == "process":
         from .process import process_fleet_sweep
 
@@ -186,9 +219,35 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
     clock = VirtualClock()
     emit, close = _obsy.make_observer(observe)
     policy = ChaosPolicy(chaos) if chaos is not None else None
+    exch = None
+    if exchange is not None:
+        from ..triage.shrink import normalize as _normalize_sched
+        from .exchange import CorpusExchange
+
+        scfg = sweep_kwargs["search"]
+        faults_a = np.asarray(faults, np.int32)
+        template = _normalize_sched(
+            faults_a[0] if faults_a.ndim == 3 else faults_a)
+        state_path = exchange.state_path
+        if state_path is None and checkpoint_dir is not None:
+            state_path = os.path.join(checkpoint_dir,
+                                      "exchange_state.npz")
+            os.makedirs(checkpoint_dir, exist_ok=True)
+        exch = CorpusExchange(
+            ranges=split_ranges(n, range_size),
+            every=exchange.every if exchange.every is not None
+            else n_workers,
+            template=template, corpus_k=int(scfg.corpus),
+            min_novelty=int(scfg.min_novelty), emit=emit, clock=clock,
+            state_path=state_path)
+        if state_path is not None and os.path.exists(state_path):
+            # Coordinator crash→resume: reload the accepted snapshots
+            # and re-derive the merged-epoch chain bit-exactly (the
+            # merge is a deterministic fold of the persisted inputs).
+            exch.resume(state_path)
     coordinator = Coordinator(seeds, range_size=range_size,
                               lease_ttl=lease_ttl, clock=clock, emit=emit,
-                              n_devices=mesh.devices.size)
+                              n_devices=mesh.devices.size, exchange=exch)
     transport = InlineTransport(coordinator, chaos=policy)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)

@@ -68,6 +68,11 @@ class Lease:
     expires_at: float
     checkpoint: Optional[str] = None
     progress: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Liveness bookkeeping for stall diagnostics (FleetStalledError
+    # names the holder and its last beat): count + clock time of the
+    # most recent accepted heartbeat (-1.0 = never beat).
+    heartbeats: int = 0
+    last_heartbeat: float = -1.0
 
 
 def split_ranges(n_seeds: int, range_size: int) -> List[SeedRange]:
@@ -144,12 +149,23 @@ class LeaseTable:
                 self._pending.append(lease.range.range_id)
         return reaped
 
-    def issue(self, worker_id: str, now: float) -> Optional[Lease]:
+    def issue(self, worker_id: str, now: float,
+              eligible=None) -> Optional[Lease]:
         """Issue the next pending range to ``worker_id`` (None if all
-        ranges are leased or done)."""
+        ranges are leased or done). ``eligible`` (optional predicate on
+        range ids) gates which pending ranges may issue — the corpus
+        exchange's epoch barrier (fleet/exchange.py) holds back ranges
+        whose seed corpus has not merged yet; the FIRST eligible pending
+        range issues, preserving range-id-major order within an epoch."""
         if not self._pending:
             return None
-        rid = self._pending.pop(0)
+        pos = 0
+        if eligible is not None:
+            pos = next((i for i, rid in enumerate(self._pending)
+                        if eligible(rid)), None)
+            if pos is None:
+                return None
+        rid = self._pending.pop(pos)
         self._generation[rid] += 1
         lease = Lease(
             lease_id=self._next_lease_id,
@@ -174,6 +190,8 @@ class LeaseTable:
         if lease is None or lease.worker_id != worker_id:
             return False
         lease.expires_at = now + self.ttl
+        lease.heartbeats += 1
+        lease.last_heartbeat = now
         if progress:
             lease.progress.update(progress)
         return True
@@ -227,3 +245,9 @@ class LeaseTable:
 
     def checkpoint_for(self, range_id: int) -> Optional[str]:
         return self._checkpoint.get(range_id)
+
+    def lease_for_range(self, range_id: int) -> Optional[Lease]:
+        """The live lease currently holding ``range_id`` (None when the
+        range is pending or done) — stall diagnostics."""
+        lease_id = self._by_range.get(range_id)
+        return None if lease_id is None else self._live.get(lease_id)

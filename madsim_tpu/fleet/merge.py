@@ -64,6 +64,21 @@ def contract_mismatches(a: SweepResult, b: SweepResult) -> List[str]:
             bad.append("coverage.first_seen_seed")
     if a.faults_sha256 != b.faults_sha256:
         bad.append("faults_sha256")
+    sa = getattr(a, "search", None)
+    sb = getattr(b, "search", None)
+    if (sa is None) != (sb is None):
+        bad.append("search")
+    elif sa is not None:
+        # Guided sweeps: the materialized per-seed schedules and the
+        # final corpus are contract surface too — two executions of one
+        # range must evolve identical corpora and run identical
+        # children (docs/search.md determinism contract).
+        if not np.array_equal(sa.schedules, sb.schedules):
+            bad.append("search.schedules")
+        for f in ("corpus_sched", "corpus_sig", "corpus_score",
+                  "corpus_filled"):
+            if not np.array_equal(getattr(sa, f), getattr(sb, f)):
+                bad.append(f"search.{f}")
     return bad
 
 

@@ -179,6 +179,7 @@ class InlineTransport:
         self.calls: Dict[str, int] = {}
         self.injected_failures = 0
         self.injected_duplicates = 0
+        self.injected_torn_publishes = 0
 
     def call(self, method: str, worker_id: str, **kw):
         self.calls[method] = self.calls.get(method, 0) + 1
@@ -186,6 +187,22 @@ class InlineTransport:
             self.injected_failures += 1
             raise RpcError(
                 f"injected transport failure: {method} from {worker_id}")
+        if (method == "publish" and self.chaos is not None
+                and self.chaos.tear_publish(worker_id)):
+            # Tear the snapshot IN FLIGHT (flip one byte of the payload)
+            # so the coordinator's checksum rejects it — the torn-
+            # publish failure mode of a real network, exercised
+            # deterministically. The worker re-sends a fresh (clean)
+            # serialization.
+            import numpy as _np
+
+            self.injected_torn_publishes += 1
+            kw = dict(kw)
+            snap = dict(kw["snapshot"])
+            sched = _np.array(snap["sched"], copy=True)
+            sched.flat[0] ^= 1
+            snap["sched"] = sched
+            kw["snapshot"] = snap
         fn = getattr(self.coordinator, f"rpc_{method}")
         out = fn(worker_id=worker_id, **kw)
         if (method == "complete" and self.chaos is not None

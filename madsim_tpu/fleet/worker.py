@@ -101,7 +101,9 @@ class Worker:
                       "heartbeats_sent": 0, "heartbeats_dropped": 0,
                       "heartbeats_delayed": 0, "rpc_retries": 0,
                       "checkpoints_recovered": 0,
-                      "checkpoints_discarded": 0}
+                      "checkpoints_discarded": 0,
+                      "corpus_published": 0, "corpus_resent": 0,
+                      "corpus_seeded": 0}
 
     # -- preemption ------------------------------------------------------
     def request_preemption(self) -> None:
@@ -201,6 +203,14 @@ class Worker:
             return True
         finally:
             self._lease = None
+        if lease.get("exchange_epoch") is not None and \
+                getattr(result, "search", None) is not None:
+            # Publish the range's final corpus BEFORE the completion so
+            # the exchange barrier can lift as soon as the epoch's last
+            # quantum finishes; a lost publish is backstopped by the
+            # coordinator at complete (same dedupe path), so neither RPC
+            # alone is load-bearing.
+            self._publish_corpus(lease, result)
         try:
             self._call("complete", lease_id=lease["lease_id"],
                        range_id=lease["range_id"], result=result)
@@ -236,6 +246,42 @@ class Worker:
             self.emit("checkpoint_torn", range_id=lease["range_id"],
                       path=ck)
 
+    def _publish_corpus(self, lease, result) -> None:
+        """Send the finished range's corpus snapshot to the coordinator.
+
+        Retries ride the normal RPC backoff; a TORN response (payload
+        failed the coordinator's checksum — chaos, or a real transport
+        tearing bytes) re-sends a fresh serialization: the snapshot is
+        deterministic host data, so a re-send is bitwise identical and
+        the dedupe layer absorbs any accidental double delivery."""
+        from ..search.corpus import HostCorpus
+        from .exchange import corpus_payload
+
+        rep = result.search
+        corpus = HostCorpus(sched=rep.corpus_sched, sig=rep.corpus_sig,
+                            score=rep.corpus_score,
+                            filled=rep.corpus_filled)
+        for attempt in range(4):
+            try:
+                resp = self._call("publish", range_id=lease["range_id"],
+                                  snapshot=corpus_payload(corpus))
+            except RetryExhausted as exc:
+                # Abandon: the coordinator backstops from the completion
+                # payload (or the range re-runs after expiry).
+                self.emit("publish_abandoned",
+                          range_id=lease["range_id"], error=str(exc))
+                return
+            if not resp.get("torn"):
+                self.stats["corpus_published"] += 1
+                self.emit("corpus_published", range_id=lease["range_id"],
+                          epoch=lease.get("exchange_epoch"),
+                          duplicate=bool(resp.get("duplicate")),
+                          resent=attempt)
+                return
+            self.stats["corpus_resent"] += 1
+        self.emit("publish_abandoned", range_id=lease["range_id"],
+                  error="torn on every attempt")
+
     def _run_lease(self, lease) -> Any:
         from ..parallel.sweep import sweep
 
@@ -245,6 +291,22 @@ class Worker:
         if faults is not None and np.asarray(faults).ndim == 3:
             faults = np.asarray(faults)[lo:hi]
         kwargs = dict(self.sweep_kwargs)
+        if lease.get("exchange_gen0"):
+            # Epoch stream offset: this range's sweep mutates on a
+            # fresh generation-key family (exchange.GEN_STRIDE) so a
+            # seeded epoch explores NEW children instead of redrawing
+            # the mutations its seed corpus's epoch already tried.
+            kwargs["search_gen0"] = lease["exchange_gen0"]
+        if lease.get("corpus") is not None:
+            # Exchange seeding: the lease carries the merged previous-
+            # epoch corpus; verify the checksum (a torn broadcast must
+            # not silently skew the hunt) and install it as the sweep's
+            # seed corpus. Deterministic per range — a re-issued lease
+            # carries the identical payload.
+            from .exchange import payload_corpus
+
+            kwargs["search_corpus"] = payload_corpus(lease["corpus"])
+            self.stats["corpus_seeded"] += 1
         ck = self._lease_checkpoint(lease)
         if ck is not None:
             # resume=True: if a previous holder (crashed or preempted)

@@ -50,6 +50,13 @@ _SCHEMA = "madsim.sweep.telemetry/1"
 # summarize either.
 _FLEET_SCHEMA = "madsim.fleet.telemetry/1"
 
+# The cross-range corpus exchange (fleet/exchange.py, docs/fleet.md
+# "Corpus exchange") rides the same sink with its own schema: publish
+# (range/epoch/bytes, duplicate + torn flags), merge (epoch, ranges
+# merged, corpus inserted/size), broadcast (seed corpus delivered with
+# a lease), resume (coordinator crash→resume snapshot count).
+_EXCHANGE_SCHEMA = "madsim.fleet.exchange/1"
+
 
 class JsonlEmitter:
     """Append one JSON line per telemetry record; flush per line so a
@@ -266,6 +273,47 @@ def render_fleet_event(rec: dict) -> str:
     return "  ".join(str(b) for b in bits)
 
 
+def render_exchange_event(rec: dict) -> str:
+    """One terminal line per corpus-exchange record — epochs, ranges
+    merged, corpus growth, bytes on the wire — so an operator can watch
+    the fleet's shared search progress next to its lease churn."""
+    bits = [f"t={rec.get('t', 0):>6}", "[exchange]", rec.get("event", "?")]
+    for k in ("epoch", "from_epoch", "range_id", "worker",
+              "ranges_merged", "corpus_inserted", "corpus_size",
+              "corpus_gen", "epochs_merged", "bytes", "snapshots"):
+        if k in rec and rec[k] is not None:
+            bits.append(f"{k}={rec[k]}")
+    for k in ("duplicate", "torn"):
+        if rec.get(k):
+            bits.append(k.upper())
+    if rec.get("error"):
+        bits.append(f"error={rec['error']}")
+    return "  ".join(str(b) for b in bits)
+
+
+def render_exchange_summary(exchange: List[dict]) -> List[str]:
+    """Aggregate line for the exchange records in a stream: epochs
+    merged, corpus inserts, publish/broadcast traffic."""
+    if not exchange:
+        return []
+    merges = [r for r in exchange if r.get("event") == "merge"]
+    pubs = [r for r in exchange if r.get("event") == "publish"]
+    line = (f"exchange: {len(merges)} epoch(s) merged, "
+            f"{sum(r.get('corpus_inserted', 0) for r in merges)} corpus "
+            f"insert(s), {len(pubs)} publish(es) "
+            f"({sum(r.get('bytes', 0) for r in pubs)} B published)")
+    dup = sum(1 for r in pubs if r.get("duplicate"))
+    torn = sum(1 for r in exchange if r.get("event") == "publish_torn")
+    if dup or torn:
+        line += (f" [{dup} duplicate(s) crosschecked, {torn} torn "
+                 "publish(es) discarded]")
+    if merges:
+        last = merges[-1]
+        line += (f"; merged corpus: {last.get('corpus_size', '?')} "
+                 f"entries after epoch {last.get('epoch', '?')}")
+    return [line]
+
+
 def render_fleet_summary(fleet: List[dict]) -> List[str]:
     """Aggregate lines for the fleet records in a stream: event counts
     plus the resilience headline (expiries, re-leases, crosschecked
@@ -294,11 +342,14 @@ def render_summary(records: List[dict]) -> str:
     if not records:
         return "watch: empty telemetry stream"
     fleet = [r for r in records if r.get("schema") == _FLEET_SCHEMA]
-    records = [r for r in records if r.get("schema") != _FLEET_SCHEMA]
+    exchange = [r for r in records if r.get("schema") == _EXCHANGE_SCHEMA]
+    records = [r for r in records
+               if r.get("schema") not in (_FLEET_SCHEMA, _EXCHANGE_SCHEMA)]
     progress = [r for r in records if r.get("event") != "summary"]
     summary = next((r for r in records if r.get("event") == "summary"),
                    None)
     lines: List[str] = render_fleet_summary(fleet)
+    lines.extend(render_exchange_summary(exchange))
     if progress:
         lines.append(f"{len(progress)} progress records; last:")
         lines.append("  " + render_progress(progress[-1]))
@@ -327,7 +378,7 @@ def render_summary(records: List[dict]) -> str:
                 f"behaviors in {cov.get('n_buckets')} buckets "
                 f"({cov.get('worlds_folded')} worlds folded, novelty "
                 f"{cov.get('novelty_first')}->{cov.get('novelty_last')})")
-    elif not fleet:
+    elif not fleet and not exchange:
         lines.append("no summary record yet (sweep still running?)")
     return "\n".join(lines)
 
@@ -360,6 +411,8 @@ def watch(path: str, follow: bool = False, prom: Optional[str] = None,
             if rec.get("event") == "summary":
                 print(render_summary(records), file=out)
                 done = True
+            elif rec.get("schema") == _EXCHANGE_SCHEMA:
+                print(render_exchange_event(rec), file=out)
             elif rec.get("schema") == _FLEET_SCHEMA:
                 print(render_fleet_event(rec), file=out)
             else:

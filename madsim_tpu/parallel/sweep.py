@@ -609,7 +609,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           profile_dir: Optional[str] = None,
           profile_window: Tuple[int, int] = (0, 4),
           coverage_buckets: Optional[int] = None,
-          search: Optional[Any] = None) -> SweepResult:
+          search: Optional[Any] = None,
+          search_corpus: Optional[Any] = None,
+          search_gen0: int = 0) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     The loop is a slot-occupancy model: the device batch is a fixed set of
@@ -759,6 +761,29 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     materialized per-seed schedules), and ``triage_ctx.faults`` becomes
     that per-seed array, so ``triage.triage``/``minimize`` work on
     guided finds unchanged.
+
+    ``search_corpus``: a host corpus snapshot
+    (:class:`~madsim_tpu.search.corpus.HostCorpus`-shaped: ``sched``
+    ``(K, F, 4)``, ``sig``/``score``/``filled`` ``(K,)``) that SEEDS the
+    device corpus instead of the template-only ``corpus_init`` — the
+    fleet's cross-range corpus exchange (fleet/exchange.py) passes the
+    merged previous-epoch corpus here so a leased range continues the
+    fleet's search instead of restarting from the template. One
+    host→device transfer at sweep start; zero mid-loop syncs added.
+    Seeding with the template-initialized corpus is bitwise identical
+    to ``search_corpus=None`` (tested). A checkpoint resume overrides
+    it (the snapshot's corpus wins — it already embeds the seed).
+
+    ``search_gen0``: starting value of the corpus generation counter
+    (default 0). The mutation lanes key children by ``(SearchConfig.
+    seed, slot seed id, generation)``, so two sweeps over the same
+    corpus at the same generations draw the SAME mutations; the
+    exchange offsets each epoch's ranges by a fixed stride
+    (fleet/exchange.py ``GEN_STRIDE``) so a seeded epoch explores fresh
+    mutation streams instead of redrawing its parents' — deterministic
+    per range, chaos-invariant. ``SweepResult.search.generations``
+    still reports the generations THIS sweep ran (the offset is
+    subtracted).
     """
     from ..engine import checkpoint as ckpt
 
@@ -807,6 +832,16 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                 "search= needs a fault-schedule template (faults=): the "
                 "mutation operators perturb within the template's fault "
                 "vocabulary — an empty schedule has nothing to evolve")
+    if search_corpus is not None and not search_on:
+        raise ValueError(
+            "search_corpus= seeds the guided-search parent corpus and "
+            "needs search=SearchConfig(...) — a plain sweep has no "
+            "corpus to seed")
+    if search_gen0 and not search_on:
+        raise ValueError("search_gen0= offsets the guided mutation "
+                         "streams and needs search=SearchConfig(...)")
+    if search_gen0 < 0:
+        raise ValueError("search_gen0 must be >= 0")
 
     # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
     # once; recycled sweeps hold batch_worlds slots and stream the rest.
@@ -960,7 +995,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     retired_sched: List[np.ndarray] = []
     search_host = {"corpus_size": 1, "inserted": 0}
     if search_on:
-        from ..search.corpus import corpus_init
+        from ..search.corpus import CorpusState, corpus_init
         from ..search.generate import searcher as _searcher
         from ..triage.shrink import normalize as _normalize_sched
 
@@ -969,12 +1004,47 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                  else np.broadcast_to(faults_p, (w0,) + faults_p.shape))
         slot_sched = shard_worlds(
             jnp.asarray(np.ascontiguousarray(base0), jnp.int32), mesh)
-        # Corpus seeded with the (normalized) template: parents always
-        # exist, so generation-1 children mutate the original schedule.
-        template = _normalize_sched(
-            faults_p[0] if per_world_faults else faults_p)
-        corpus = jax.device_put(corpus_init(int(search.corpus), template),
-                                NamedSharding(mesh, scalar_spec()))
+        if search_corpus is not None:
+            # Exchange seeding (fleet/exchange.py): start from a merged
+            # host corpus instead of the template-only init. The per-
+            # sweep gen/inserted counters still start at zero — they
+            # count THIS sweep's refills/inserts.
+            sc_sched = np.asarray(search_corpus.sched, np.int32)
+            k = int(search.corpus)
+            if sc_sched.shape != (k, f_rows, 4):
+                raise ValueError(
+                    f"search_corpus.sched must be (K, F, 4) = "
+                    f"({k}, {f_rows}, 4) for SearchConfig.corpus={k} and "
+                    f"the {f_rows}-row template; got {sc_sched.shape}")
+            for name in ("sig", "score", "filled"):
+                shp = np.asarray(getattr(search_corpus, name)).shape
+                if shp != (k,):
+                    raise ValueError(
+                        f"search_corpus.{name} must be ({k},) for "
+                        f"SearchConfig.corpus={k}; got {shp}")
+            # gen starts at the epoch stream offset (fleet/exchange.py):
+            # generation is the third key of the mutation lanes, so the
+            # shift moves this sweep onto a fresh splitmix64 stream
+            # family instead of redrawing the seed corpus's parents'.
+            corpus = jax.device_put(CorpusState(
+                sched=jnp.asarray(sc_sched),
+                sig=jnp.asarray(np.asarray(search_corpus.sig, np.uint32)),
+                score=jnp.asarray(np.asarray(search_corpus.score,
+                                             np.int32)),
+                filled=jnp.asarray(np.asarray(search_corpus.filled, bool)),
+                gen=jnp.int32(search_gen0), inserted=jnp.int32(0),
+            ), NamedSharding(mesh, scalar_spec()))
+        else:
+            # Corpus seeded with the (normalized) template: parents
+            # always exist, so generation-1 children mutate the original
+            # schedule.
+            template = _normalize_sched(
+                faults_p[0] if per_world_faults else faults_p)
+            c0 = corpus_init(int(search.corpus), template)
+            if search_gen0:
+                c0 = c0._replace(gen=jnp.int32(search_gen0))
+            corpus = jax.device_put(
+                c0, NamedSharding(mesh, scalar_spec()))
     if resumed and recycle:
         # Rehydrate the sweep-level bookkeeping the checkpoint carried:
         # the slot→seed index (device-resident again), the refill
@@ -1612,7 +1682,9 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
 
         c_filled = np.asarray(corpus_h.filled, bool)
         search_report = SearchReport(
-            generations=int(np.asarray(corpus_h.gen)),
+            # Generations THIS sweep ran: the epoch stream offset
+            # (search_gen0) is a key-space shift, not work done here.
+            generations=int(np.asarray(corpus_h.gen)) - int(search_gen0),
             inserted=int(np.asarray(corpus_h.inserted)),
             corpus_size=int(c_filled.sum()),
             corpus_capacity=int(c_filled.shape[0]),

@@ -132,6 +132,107 @@ def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
     return jax.lax.fori_loop(0, w, body, (corpus, jnp.int32(0)))
 
 
+# ---------------------------------------------------------------------------
+# The corpus-merge half: a HOST twin of the device insertion fold
+# ---------------------------------------------------------------------------
+#
+# The fleet's cross-range corpus exchange (fleet/exchange.py) merges
+# published per-range corpora on the coordinator — a machine with no
+# device state. The merge MUST be the same fold the device runs, bit for
+# bit, because a re-issued lease seeds its sweep from the merged corpus
+# and the chaos contract (chaotic fleet == clean fleet bitwise) rides on
+# every worker deriving identical children from identical parents. So
+# the insertion rule lives twice, like PR 9's FNV signature twin: once
+# as the jitted ``harvest_fold`` above, once as plain numpy below, with
+# a tier-1 parity test (tests/test_exchange.py) holding them together.
+
+class HostCorpus(NamedTuple):
+    """Host-side corpus snapshot: the four exchanged arrays of a
+    :class:`CorpusState` (the ``gen``/``inserted`` counters are per-sweep
+    telemetry and stay behind)."""
+
+    sched: np.ndarray   # (K, F, 4) i32 parent schedules
+    sig: np.ndarray     # (K,) u32 behavior signature at insert
+    score: np.ndarray   # (K,) i32 novelty at insert
+    filled: np.ndarray  # (K,) bool
+
+
+def host_corpus_init(k: int, template: np.ndarray) -> HostCorpus:
+    """Host twin of :func:`corpus_init`: the template-seeded corpus every
+    epoch-0 range (and every non-exchanged sweep) starts from."""
+    template = np.asarray(template, np.int32)
+    sched = np.zeros((k, template.shape[0], 4), np.int32)
+    sched[:, :, 0] = -1                      # DISABLED_ROW sentinels
+    sched[0] = template
+    filled = np.zeros((k,), bool)
+    filled[0] = True
+    return HostCorpus(sched=sched, sig=np.zeros((k,), np.uint32),
+                      score=np.zeros((k,), np.int32), filled=filled)
+
+
+def host_popcount32(x: int) -> int:
+    """Population count of one u32 — the scalar twin of
+    :func:`popcount32`."""
+    return bin(int(x) & 0xFFFFFFFF).count("1")
+
+
+def host_harvest_fold(corpus: HostCorpus, sched: np.ndarray,
+                      sigs: np.ndarray, fold_mask: np.ndarray,
+                      min_novelty: int) -> Tuple[HostCorpus, int]:
+    """Bit-identical host twin of :func:`harvest_fold`.
+
+    Folds the masked candidates sequentially (index order) into the
+    corpus under the same rule: novelty = min Hamming distance to any
+    filled entry (:data:`EMPTY_NOVELTY` on an empty corpus); the target
+    slot is the argmin of ``where(filled, score, -1)`` with ties to the
+    lowest index; insert iff masked, ``novelty >= min_novelty`` and
+    strictly above the target's key. Returns the updated corpus and the
+    insert count. Parity with the device fold is tier-1-gated.
+    """
+    c_sched = np.array(corpus.sched, np.int32, copy=True)
+    c_sig = np.array(corpus.sig, np.uint32, copy=True)
+    c_score = np.array(corpus.score, np.int32, copy=True)
+    c_filled = np.array(corpus.filled, bool, copy=True)
+    sched = np.asarray(sched, np.int32)
+    sigs = np.asarray(sigs, np.uint32)
+    fold_mask = np.asarray(fold_mask, bool)
+    n_ins = 0
+    for j in range(sigs.shape[0]):
+        if c_filled.any():
+            d = np.array([host_popcount32(int(sigs[j]) ^ int(s))
+                          for s in c_sig], np.int32)
+            nov = int(np.where(c_filled, d, np.int32(EMPTY_NOVELTY)).min())
+        else:
+            nov = EMPTY_NOVELTY
+        key = np.where(c_filled, c_score, np.int32(-1))
+        tgt = int(np.argmin(key))            # first-min ties, like argmin
+        if bool(fold_mask[j]) and nov >= int(min_novelty) \
+                and nov > int(key[tgt]):
+            c_sched[tgt] = sched[j]
+            c_sig[tgt] = sigs[j]
+            c_score[tgt] = nov
+            c_filled[tgt] = True
+            n_ins += 1
+    return HostCorpus(sched=c_sched, sig=c_sig, score=c_score,
+                      filled=c_filled), n_ins
+
+
+def merge_corpus(acc: HostCorpus, src: HostCorpus,
+                 min_novelty: int) -> Tuple[HostCorpus, int]:
+    """Fold one published corpus into the accumulating merged corpus.
+
+    The source's filled entries are candidates in slot-index order —
+    the same sequential worst-first insertion the device applies to a
+    retiring tail, so the merged corpus of an epoch is a pure fold over
+    (previous merged corpus, per-range snapshots in range-id order).
+    Scores are RE-computed against the accumulator (an entry novel
+    within its own range may be redundant fleet-wide).
+    """
+    return host_harvest_fold(acc, np.asarray(src.sched, np.int32),
+                             np.asarray(src.sig, np.uint32),
+                             np.asarray(src.filled, bool), min_novelty)
+
+
 def pick_filled(corpus: CorpusState, draws: jnp.ndarray) -> jnp.ndarray:
     """Map u32 draws to filled corpus indices, uniformly over the filled
     entries (corpus_init guarantees at least one). ``draws`` may carry

@@ -374,15 +374,22 @@ def test_fleet_telemetry_jsonl_and_watch(raft_eng, tmp_path):
 
 def test_fleet_stalls_loudly_when_unrecoverable(raft_eng):
     """All workers dead + restarts disabled must raise FleetStalledError
-    (with diagnostics), never hang."""
+    — and its message must name each stuck range with its holding
+    worker, lease generation, and last-heartbeat bookkeeping (the PR 12
+    satellite: diagnostics, not a bare range count)."""
     from madsim_tpu.fleet import FleetStalledError
 
-    with pytest.raises(FleetStalledError, match="dead"):
+    with pytest.raises(FleetStalledError, match="dead") as exc:
         fleet_sweep(None, raft_eng.cfg, np.arange(16), engine=raft_eng,
                     n_workers=1, range_size=8,
                     chaos=ChaosConfig(seed=1, kill_at=(("w0", 1),),
                                       restart_after=-1),
                     **SWEEP_KW)
+    msg = str(exc.value)
+    assert "range 0: held by w0" in msg
+    assert "last heartbeat" in msg and "heartbeats" in msg
+    assert "expires t=" in msg
+    assert "range 1: pending" in msg
 
 
 # ---------------------------------------------------------------------------

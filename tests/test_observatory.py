@@ -173,6 +173,106 @@ def test_jsonl_stream_watch_cli_and_prometheus(eng_on, tmp_path, capsys):
     assert observatory.watch(str(tmp_path / "nope.jsonl")) == 2
 
 
+def test_watch_renders_exchange_records_interleaved(tmp_path):
+    """The `madsim.fleet.exchange/1` schema (PR 12): `watch --follow`
+    renders exchange events interleaved with the sweep and fleet
+    schemas, and the summary mode rolls them up — round-tripped through
+    a real JSONL stream."""
+    stream = str(tmp_path / "mixed.jsonl")
+    records = [
+        {"schema": "madsim.sweep.telemetry/1", "elapsed_s": 0.5,
+         "chunks": 3, "n_active": 8, "batch_worlds": 16,
+         "seeds_total": 32, "seeds_done": 4, "seeds_per_s": 8.0},
+        {"schema": "madsim.fleet.telemetry/1", "event": "lease_issued",
+         "t": 1, "worker": "w0", "range_id": 0, "lease_id": 0,
+         "generation": 0},
+        {"schema": "madsim.fleet.exchange/1", "event": "publish", "t": 2,
+         "worker": "w0", "range_id": 0, "epoch": 0, "bytes": 3360,
+         "duplicate": False, "corpus_size": 2},
+        {"schema": "madsim.fleet.exchange/1", "event": "merge", "t": 3,
+         "epoch": 0, "ranges_merged": 2, "corpus_inserted": 5,
+         "corpus_size": 6, "corpus_gen": 1, "epochs_merged": 1},
+        {"schema": "madsim.fleet.exchange/1", "event": "broadcast",
+         "t": 4, "worker": "w1", "range_id": 2, "epoch": 1,
+         "from_epoch": 0, "bytes": 3360},
+        {"schema": "madsim.fleet.exchange/1", "event": "publish_torn",
+         "t": 5, "worker": "w1", "range_id": 2, "epoch": 1,
+         "error": "checksum mismatch"},
+        {"schema": "madsim.sweep.telemetry/1", "event": "summary",
+         "elapsed_s": 1.0, "seeds_total": 32, "failing_seeds": 0,
+         "world_utilization": 0.9, "loop_stats": {"chunks": 6,
+                                                  "dispatches": 3}},
+    ]
+    with open(stream, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+    # Follow mode: one rendered line per record, all three schemas
+    # interleaved in stream order.
+    buf = io.StringIO()
+    assert observatory.watch(stream, follow=True, interval=0.01,
+                             out=buf) == 0
+    tail = buf.getvalue()
+    assert "[exchange]" in tail
+    assert "publish" in tail and "merge" in tail and "broadcast" in tail
+    assert "epoch=0" in tail and "ranges_merged=2" in tail
+    assert "corpus_inserted=5" in tail and "corpus_gen=1" in tail
+    assert "bytes=3360" in tail
+    assert "publish_torn" in tail and "error=checksum mismatch" in tail
+    assert "[w0]" in tail and "lease_issued" in tail  # fleet schema
+    assert "chunks=3" in tail                         # sweep schema
+
+    # Summary mode: the exchange rollup line sits beside the sweep
+    # summary.
+    buf = io.StringIO()
+    assert observatory.watch(stream, out=buf) == 0
+    text = buf.getvalue()
+    assert "exchange: 1 epoch(s) merged, 5 corpus insert(s)" in text
+    assert "1 torn publish(es) discarded" in text
+    assert "merged corpus: 6 entries after epoch 0" in text
+    assert "final: 0 failing of 32 seeds" in text
+
+
+def test_exchange_stream_from_real_fleet_run(tmp_path):
+    """End-to-end: an exchanged guided fleet writes its telemetry to a
+    JSONL sink; the stream carries all three schemas and `watch`
+    summarizes it without error."""
+    from madsim_tpu.fleet import ExchangeConfig, fleet_sweep
+    from madsim_tpu.search import (
+        GuidedPairActor,
+        GuidedPairConfig,
+        engine_config,
+        family_schedule,
+    )
+    from madsim_tpu.search.family import HUNT_NODES, HUNT_ROWS, \
+        hunt_search_config
+
+    acfg = GuidedPairConfig(n=HUNT_NODES)
+    eng = DeviceEngine(GuidedPairActor(acfg), engine_config(acfg))
+    stream = str(tmp_path / "fleet.jsonl")
+    fleet_sweep(None, eng.cfg, np.arange(96), engine=eng,
+                faults=family_schedule(HUNT_ROWS, acfg), n_workers=2,
+                range_size=48, recycle=True, batch_worlds=32,
+                chunk_steps=32, max_steps=10_000_000,
+                search=hunt_search_config(True),
+                exchange=ExchangeConfig(every=1), observe=stream)
+    recs = [json.loads(ln) for ln in open(stream) if ln.strip()]
+    schemas = {r.get("schema") for r in recs}
+    assert "madsim.fleet.exchange/1" in schemas
+    assert "madsim.fleet.telemetry/1" in schemas
+    ex = [r for r in recs if r.get("schema") == "madsim.fleet.exchange/1"]
+    events = {r["event"] for r in ex}
+    assert {"publish", "merge", "broadcast"} <= events
+    merge = next(r for r in ex if r["event"] == "merge")
+    assert {"epoch", "ranges_merged", "corpus_inserted",
+            "corpus_size"} <= set(merge)
+    pub = next(r for r in ex if r["event"] == "publish")
+    assert pub["bytes"] > 0
+    buf = io.StringIO()
+    assert observatory.watch(stream, out=buf) == 0
+    assert "exchange:" in buf.getvalue()
+
+
 def test_make_observer_contract(tmp_path):
     assert observatory.make_observer(None) == (None, None)
     sink = []

@@ -108,6 +108,19 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.guided_hunt.raft.random_bugs_found", False),
     ("guided raft novelty area",
      "configs.guided_hunt.raft.guided_novelty_area", True),
+    # Cross-range corpus exchange (docs/fleet.md "Corpus exchange";
+    # bench_guided_fleet): the fleet-level staircase — an exchanged
+    # fleet must keep reaching the pair bug on ranges too small to
+    # climb alone — plus the exchange's wall-time overhead and merge
+    # traffic.
+    ("exchanged fleet seeds-to-bug",
+     "configs.guided_fleet.exchanged_seeds_to_bug", False),
+    ("exchanged fleet bugs",
+     "configs.guided_fleet.exchanged_bugs_found", True),
+    ("exchange overhead frac",
+     "configs.guided_fleet.exchange_overhead_frac", False),
+    ("exchange merge inserts",
+     "configs.guided_fleet.merge_inserts", True),
 ]
 
 

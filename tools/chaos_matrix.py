@@ -146,6 +146,52 @@ def run_guided_leg(n_seeds: int = 96) -> int:
     return 0 if ok else 1
 
 
+def run_exchange_leg(n_seeds: int = 320) -> int:
+    """Guided-EXCHANGE chaos leg (docs/fleet.md "Corpus exchange"):
+    a chaotic exchanged fleet — worker kills mid-epoch (kill→re-lease
+    re-seeds from the last merged epoch), duplicated completions, torn
+    corpus publishes, dropped RPCs — must equal a crash-free exchanged
+    fleet BITWISE on the contract fields INCLUDING the materialized
+    per-seed schedules and the final merged corpus; and the exchange
+    must actually bite: the exchanged fleet reaches the pair bug on
+    64-seed ranges an independent fleet can never climb alone."""
+    from madsim_tpu.engine import DeviceEngine
+    from madsim_tpu.fleet import ChaosConfig, ExchangeConfig, fleet_sweep
+    from madsim_tpu.search.hunts import pair_hunt
+
+    hunt = pair_hunt()
+    eng = DeviceEngine(hunt.actor, hunt.cfg)
+    seeds = np.arange(n_seeds)
+    kw = dict(engine=eng, faults=hunt.template, search=hunt.search(True),
+              stop_on_first_bug=True, range_size=64, n_workers=2,
+              exchange=ExchangeConfig(every=1), **hunt.sweep_kw)
+    clean = fleet_sweep(None, hunt.cfg, seeds, **kw)
+    chaotic = fleet_sweep(
+        None, hunt.cfg, seeds,
+        chaos=ChaosConfig(seed=13, kill_at=(("w1", 2),),
+                          duplicate_all_completions=True,
+                          tear_publish_at=(("w0", 1),),
+                          drop_rpc_rate=0.2, restart_after=2), **kw)
+    bad = _contract_equal(clean, chaotic)
+    stats = chaotic.loop_stats["fleet"]
+    injected = {k: stats[k] for k in
+                ("kills", "leases_reissued", "publishes_torn",
+                 "duplicates_crosschecked", "rpc_retries")}
+    missing = [k for k in injected if not injected[k]]
+    found = bool(clean.failing_seeds)
+    ok = not bad and not missing and found
+    print(json.dumps({
+        "family": "guided_pair(corpus exchange)", "ok": ok,
+        "n_seeds": n_seeds,
+        "contract_mismatches": bad,
+        "chaos_not_exercised": missing,
+        "exchange_found_bug": found,
+        "epochs_merged": stats["epochs_merged"],
+        "injected": injected,
+    }))
+    return 0 if ok else 1
+
+
 def run_process_leg(n_seeds: int = 32) -> int:
     from madsim_tpu.engine import (
         DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
@@ -183,6 +229,7 @@ def main() -> int:
     args = ap.parse_args()
     failures = run_matrix(args.seeds)
     failures += run_guided_leg()
+    failures += run_exchange_leg()
     if args.process:
         failures += run_process_leg()
     if failures:

@@ -30,6 +30,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BUDGET = 512
 
+# The pinned search numbers (ROADMAP item 2; docs/search.md): the
+# guided hunts are bitwise-deterministic, so these exact values hold
+# until MUTATION/GENERATION code changes — at which point the PR 11
+# retune-and-re-pin rule applies: retune search/family.py + hunts.py,
+# re-measure with `bench.py --only guided`, and re-pin here AND in the
+# ROADMAP recap. A drift WITHOUT a mutation-code change means search
+# semantics regressed silently — that is what this gate exists to catch
+# (PR 12 satellite: exchange/fleet work must not move these).
+PIN_PAIR_GUIDED = 73    # guided seeds-to-bug, pair family
+PIN_PAIR_RANDOM = 409   # random seeds-to-bug, pair family
+PIN_RAFT_GUIDED = 6     # guided failing seeds at budget, seeded raft
+PIN_RAFT_RANDOM = 3     # random failing seeds at budget, seeded raft
+
 
 def main() -> int:
     import numpy as np
@@ -66,6 +79,14 @@ def main() -> int:
     if r_seeds is not None and g_seeds >= r_seeds:
         print(f"fuzz-demo: guided ({g_seeds}) did not beat random "
               f"({r_seeds}) on the pair family", file=sys.stderr)
+        return 1
+    if (g_seeds, r_seeds) != (PIN_PAIR_GUIDED, PIN_PAIR_RANDOM):
+        print(f"fuzz-demo: pair seeds-to-bug drifted off the pinned "
+              f"numbers: got guided={g_seeds} random={r_seeds}, pinned "
+              f"{PIN_PAIR_GUIDED}/{PIN_PAIR_RANDOM}. If mutation/"
+              f"generation code changed deliberately, retune and re-pin "
+              f"(see the constants above); otherwise search semantics "
+              f"regressed.", file=sys.stderr)
         return 1
 
     # -- 3: triage the guided find to a 1-minimal replayable bundle ----
@@ -119,6 +140,13 @@ def main() -> int:
     if g_bugs <= r_bugs:
         print("fuzz-demo: guided search did not out-hunt random on the "
               "seeded raft bug", file=sys.stderr)
+        return 1
+    if (g_bugs, r_bugs) != (PIN_RAFT_GUIDED, PIN_RAFT_RANDOM):
+        print(f"fuzz-demo: raft bugs-at-budget drifted off the pinned "
+              f"numbers: got guided={g_bugs} random={r_bugs}, pinned "
+              f"{PIN_RAFT_GUIDED}/{PIN_RAFT_RANDOM} — retune and re-pin "
+              f"if mutation code changed, else investigate the "
+              f"regression.", file=sys.stderr)
         return 1
 
     print(f"fuzz-demo ok: pair bug at seed {g_seeds} guided vs "
