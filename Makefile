@@ -88,10 +88,18 @@ smoke:
 	rneed={'guided_bugs_found','random_bugs_found', \
 	       'guided_novelty_area','random_novelty_area'}; \
 	assert rneed<=set(gh['raft']), f'guided_hunt raft leg: {gh[\"raft\"]}'; \
+	ls=p.get('guided_operator_stats'); \
+	assert isinstance(ls,dict) and {'splice','node_rotate'}<=set(ls) \
+	    and all({'produced','novel','survived','bug'}<=set(v) \
+	            for v in ls.values()), \
+	    f'guided_hunt operator_stats missing/incomplete: {ls}'; \
+	assert p.get('guided_lineage_depth',0)>=1, \
+	    f'guided find has no ancestry depth: {p.get(\"guided_lineage_depth\")}'; \
 	gf=d['configs'].get('guided_fleet'); \
 	fneed={'exchanged_seeds_to_bug','independent_seeds_to_bug', \
 	       'exchanged_bugs_found','independent_bugs_found', \
-	       'exchange_overhead_frac','epochs_merged','publishes'}; \
+	       'exchange_overhead_frac','epochs_merged','publishes', \
+	       'lineage_depth','operator_stats'}; \
 	assert isinstance(gf,dict) and fneed<=set(gf), \
 	    f'guided_fleet record missing/incomplete: {gf}'; \
 	assert gf.get('exchanged_seeds_to_bug') and \

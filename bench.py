@@ -754,6 +754,12 @@ def bench_guided_hunt(budget: int) -> dict:
                 res.coverage.novelty_curve.sum())
             out[f"{mode}_generations"] = int(res.search.generations)
             out[f"{mode}_corpus_size"] = int(res.search.corpus_size)
+            # Evolution-observatory accounting (obs/lineage.py): the
+            # deepest ancestry chain materialized and the per-operator
+            # outcome table — tracked round over round by
+            # tools/bench_diff.py as the operator-credit signal.
+            out[f"{mode}_lineage_depth"] = int(res.search.lineage_depth())
+            out[f"{mode}_operator_stats"] = res.search.operator_stats
             out[f"{mode}_wall_s"] = round(dt, 3)
         g, r = out["guided_seeds_to_bug"], out["random_seeds_to_bug"]
         # seeds-to-bug ratio; an un-found random leg counts as budget+1
@@ -842,6 +848,11 @@ def bench_guided_fleet(budget: int) -> dict:
         "publish_bytes": st["publish_bytes"],
         "broadcast_bytes": st["broadcast_bytes"],
         "merged_corpus_size": int(exchanged.search.corpus_size),
+        # Fleet-level evolution observatory (obs/lineage.py): ancestry
+        # depth across the exchanged epochs and the merged per-operator
+        # outcome table (each range's table summed).
+        "lineage_depth": int(exchanged.search.lineage_depth()),
+        "operator_stats": exchanged.search.operator_stats,
         "independent_wall_s": round(dt_ind, 3),
         "exchanged_wall_s": round(dt_exc, 3),
         # >0 = the exchange costs wall time vs the independent fleet

@@ -354,7 +354,9 @@ SEARCH_WORLDS = 32
 SEARCH_ROWS = 6
 
 
-def _build_search_generate() -> Built:
+def _search_fixture():
+    """Shared state of the guided-search builders: engine, mesh, the
+    canonical template, and the per-slot arrays at the hunt shape."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -362,7 +364,6 @@ def _build_search_generate() -> Built:
 
     from ..parallel.mesh import scalar_spec, shard_worlds
     from ..search.corpus import corpus_init
-    from ..search.generate import searcher
 
     if "search_eng" not in _ENGINE_CACHE:
         from ..engine import DeviceEngine
@@ -379,16 +380,63 @@ def _build_search_generate() -> Built:
     scfg = hunt_search_config(True)
     tmpl = family_schedule(SEARCH_ROWS, _GPC(n=12))
     w = SEARCH_WORLDS
-    runner = searcher(eng, mesh, scfg, w, SEARCH_ROWS)
     state = shard_worlds(eng.init(np.arange(w), faults=tmpl), mesh)
     sched = shard_worlds(jnp.asarray(
         np.broadcast_to(tmpl, (w,) + tmpl.shape).copy()), mesh)
     idx = shard_worlds(jnp.arange(w, dtype=jnp.int32), mesh)
     corpus = jax.device_put(corpus_init(int(scfg.corpus), tmpl),
                             NamedSharding(mesh, scalar_spec()))
+    return eng, mesh, scfg, w, state, sched, idx, corpus
+
+
+def _search_lineage_args(mesh, w):
+    """The lineage-side searcher inputs (obs/lineage.py lanes + outcome
+    table) at the hunt shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from ..obs.lineage import lanes_origin, table_zeros
+    from ..parallel.mesh import scalar_spec, shard_worlds
+
+    lin = shard_worlds(lanes_origin(w), mesh)
+    op_tab = jax.device_put(table_zeros(),
+                            NamedSharding(mesh, scalar_spec()))
+    fill = shard_worlds(jnp.asarray(
+        jnp.arange(w, dtype=jnp.int32) >= w // 2), mesh)
+    return lin, op_tab, fill
+
+
+def _build_search_generate() -> Built:
+    import jax.numpy as jnp
+
+    from ..search.generate import searcher
+
+    eng, mesh, scfg, w, state, sched, idx, corpus = _search_fixture()
+    runner = searcher(eng, mesh, scfg, w, SEARCH_ROWS)
+    from ..parallel.mesh import shard_worlds
+
     ids = shard_worlds(jnp.arange(w, dtype=jnp.int32), mesh)
+    lin, op_tab, fill = _search_lineage_args(mesh, w)
     return Built(fn=runner, args=(state, sched, idx, corpus,
-                                  jnp.int32(w // 2), ids))
+                                  jnp.int32(w // 2), ids, fill, lin,
+                                  op_tab, jnp.int32(0)))
+
+
+def _build_compactor_sched() -> Built:
+    """The guided with_sched compactor: state + slot index + per-slot
+    schedules + lineage lanes permuted in ONE dispatch (the widened
+    PR 13 shape the guided sweep dispatches at every refill)."""
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import shard_worlds
+    from ..parallel.sweep import _compactor
+
+    eng, mesh, _scfg, w, state, sched, idx, _corpus = _search_fixture()
+    lin, _op_tab, _fill = _search_lineage_args(mesh, w)
+    del _op_tab, _fill
+    return Built(fn=_compactor(eng, mesh, w, w, with_sched=True),
+                 args=(state, idx, sched) + tuple(lin))
 
 
 # Triage candidate-eval shape (triage/minimize.py): one batch of
@@ -541,9 +589,16 @@ def registry() -> Dict[str, TraceProgram]:
         TraceProgram(
             "search.generate", "guided-search harvest + mutate program "
             f"(W={SEARCH_WORLDS} slots x F={SEARCH_ROWS} rows over the "
-            "guided_pair family engine, docs/search.md; deliberately "
-            "undonated: it only reads the state the refill then "
-            "donates)", _build_search_generate, budget=True,
+            "guided_pair family engine, docs/search.md; lineage lanes + "
+            "operator outcome table aboard (obs/lineage.py); "
+            "deliberately undonated: it only reads the state the refill "
+            "then donates)", _build_search_generate, budget=True,
+            donates=False, packed=True),
+        TraceProgram(
+            "sweep.compactor_sched", "guided compaction: state + "
+            "per-slot schedules + lineage lanes permuted in one "
+            "dispatch (undonated like sweep.compactor — gathers cannot "
+            "alias)", _build_compactor_sched, budget=True,
             donates=False),
         TraceProgram(
             "bridge.step", "bridge decision-kernel lockstep round "

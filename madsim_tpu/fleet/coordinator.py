@@ -198,7 +198,8 @@ class Coordinator:
             return
         payload = corpus_payload(HostCorpus(
             sched=rep.corpus_sched, sig=rep.corpus_sig,
-            score=rep.corpus_score, filled=rep.corpus_filled))
+            score=rep.corpus_score, filled=rep.corpus_filled,
+            entry=rep.corpus_entry, depth=rep.corpus_depth))
         self.exchange.publish(range_id, payload, worker=worker_id)
 
     def rpc_poll_done(self, worker_id: str) -> Dict[str, Any]:

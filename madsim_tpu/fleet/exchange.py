@@ -73,9 +73,12 @@ EXCHANGE_SCHEMA = "madsim.fleet.exchange/1"
 GEN_STRIDE = 1 << 16
 
 # The exchanged arrays, in canonical wire order (dtype-pinned so the
-# checksum is computed over identical bytes on both ends).
+# checksum is computed over identical bytes on both ends). The
+# ``entry``/``depth`` lineage lanes (obs/lineage.py) ride the wire
+# verbatim — merged entries keep their origin-range identity, which is
+# what lets the fleet-merged report attribute finds across ranges.
 _WIRE = (("sched", np.int32), ("sig", np.uint32), ("score", np.int32),
-         ("filled", np.bool_))
+         ("filled", np.bool_), ("entry", np.int32), ("depth", np.int32))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,7 +157,7 @@ def payload_corpus(payload: Any, corpus_k: Optional[int] = None,
         raise TornPayloadError(
             f"corpus schedules carry {sched.shape[1]} rows but the fleet "
             f"template has {f_rows}")
-    for name in ("sig", "score", "filled"):
+    for name in ("sig", "score", "filled", "entry", "depth"):
         if arrs[name].shape != (k,):
             raise TornPayloadError(
                 f"corpus {name} must be ({k},), got {arrs[name].shape}")
@@ -164,7 +167,8 @@ def payload_corpus(payload: Any, corpus_k: Optional[int] = None,
             f"recorded {str(payload.get('sha256'))[:16]}..., recomputed "
             f"{h.hexdigest()[:16]}...")
     return HostCorpus(sched=sched, sig=sig, score=arrs["score"],
-                      filled=arrs["filled"])
+                      filled=arrs["filled"], entry=arrs["entry"],
+                      depth=arrs["depth"])
 
 
 def _snapshots_equal(a: HostCorpus, b: HostCorpus) -> List[str]:
@@ -388,13 +392,27 @@ class CorpusExchange:
                      parts: Dict[int, Any]):
         """Assemble the merged ``SweepResult.search``: the final merged
         corpus (the last epoch's fold) plus the per-seed materialized
-        schedules scattered from the per-range reports."""
+        schedules — and the per-seed lineage lanes + summed operator
+        outcome table (obs/lineage.py) — scattered from the per-range
+        reports. Each range wrote its lineage entry ids at base
+        ``range.lo``, so the concatenated per-seed arrays resolve
+        cross-range ancestry at ``entry_base=0`` with plain arithmetic.
+        """
+        from ..obs.lineage import SearchLineage, merge_operator_stats
         from ..search import SearchReport
 
         final = self.merged_epoch(self.n_epochs - 1)
         f = self.template.shape[0]
         sched = np.full((n_seeds, f, 4), -1, np.int32)
         sched[:, :, 1:] = 0                  # canonical DISABLED_ROW pad
+        lin_arrays = {
+            "parent1": np.full((n_seeds,), -1, np.int32),
+            "parent2": np.full((n_seeds,), -1, np.int32),
+            "ops": np.zeros((n_seeds,), np.int32),
+            "depth": np.zeros((n_seeds,), np.int32),
+        }
+        op_parts = []
+        lineage_all = True
         generations = inserted = 0
         for r in sorted(ranges, key=lambda r: r.range_id):
             rep = getattr(parts[r.range_id], "search", None)
@@ -407,14 +425,29 @@ class CorpusExchange:
                                           np.int32)[:r.n_seeds]
             generations += int(rep.generations)
             inserted += int(rep.inserted)
+            lin = getattr(rep, "lineage", None)
+            if lin is None:
+                lineage_all = False
+            else:
+                for name in lin_arrays:
+                    lin_arrays[name][r.lo:r.hi] = np.asarray(
+                        getattr(lin, name), np.int32)[:r.n_seeds]
+                op_parts.append(rep.operator_stats or {})
         filled = np.asarray(final.filled, bool)
+        lineage = (SearchLineage(entry_base=0, **lin_arrays)
+                   if lineage_all else None)
         return SearchReport(
             generations=generations, inserted=inserted,
             corpus_size=int(filled.sum()), corpus_capacity=int(self.corpus_k),
             corpus_sched=np.asarray(final.sched, np.int32),
             corpus_sig=np.asarray(final.sig, np.uint32),
             corpus_score=np.asarray(final.score, np.int32),
-            corpus_filled=filled, schedules=sched)
+            corpus_filled=filled, schedules=sched,
+            corpus_entry=np.asarray(final.entry, np.int32),
+            corpus_depth=np.asarray(final.depth, np.int32),
+            lineage=lineage,
+            operator_stats=(merge_operator_stats(op_parts)
+                            if lineage_all and op_parts else None))
 
     # -- persistence (the coordinator's crash→resume aux channel) --------
     def _save(self, path: str) -> None:

@@ -76,9 +76,20 @@ def contract_mismatches(a: SweepResult, b: SweepResult) -> List[str]:
         if not np.array_equal(sa.schedules, sb.schedules):
             bad.append("search.schedules")
         for f in ("corpus_sched", "corpus_sig", "corpus_score",
-                  "corpus_filled"):
+                  "corpus_filled", "corpus_entry", "corpus_depth"):
             if not np.array_equal(getattr(sa, f), getattr(sb, f)):
                 bad.append(f"search.{f}")
+        la = getattr(sa, "lineage", None)
+        lb = getattr(sb, "lineage", None)
+        if (la is None) != (lb is None):
+            bad.append("search.lineage")
+        elif la is not None:
+            # The provenance lanes (obs/lineage.py) are contract
+            # surface: ancestry attribution must not depend on which
+            # worker ran the range.
+            for f in ("parent1", "parent2", "ops", "depth"):
+                if not np.array_equal(getattr(la, f), getattr(lb, f)):
+                    bad.append(f"search.lineage.{f}")
     return bad
 
 

@@ -260,7 +260,9 @@ class Worker:
         rep = result.search
         corpus = HostCorpus(sched=rep.corpus_sched, sig=rep.corpus_sig,
                             score=rep.corpus_score,
-                            filled=rep.corpus_filled)
+                            filled=rep.corpus_filled,
+                            entry=rep.corpus_entry,
+                            depth=rep.corpus_depth)
         for attempt in range(4):
             try:
                 resp = self._call("publish", range_id=lease["range_id"],
@@ -291,6 +293,13 @@ class Worker:
         if faults is not None and np.asarray(faults).ndim == 3:
             faults = np.asarray(faults)[lo:hi]
         kwargs = dict(self.sweep_kwargs)
+        if kwargs.get("search") is not None:
+            # Lineage entry-id base (obs/lineage.py): this range's
+            # corpus inserts are recorded under globally-unique entry
+            # ids lo + position + 1, so the fleet-merged report
+            # resolves cross-range ancestry — a pure id shift,
+            # chaos-invariant like every other per-range input.
+            kwargs["search_lin_base"] = lo
         if lease.get("exchange_gen0"):
             # Epoch stream offset: this range's sweep mutates on a
             # fresh generation-key family (exchange.GEN_STRIDE) so a
@@ -346,6 +355,12 @@ class Worker:
         flushes the checkpoint writer before unwinding."""
         if record.get("event") == "summary":
             return  # final sweep record, not a liveness beat
+        if record.get("schema") not in (None, "madsim.sweep.telemetry/1"):
+            # Search-telemetry records (obs/lineage.py) ride the same
+            # observe sink but are refill-grain accounting, not scalar-
+            # read beats: counting them would shift the heartbeat
+            # numbering chaos kill/preempt schedules key on.
+            return
         self._hb_count += 1
         self.clock.advance(1)
         action = (self.chaos.heartbeat_action(self.worker_id)

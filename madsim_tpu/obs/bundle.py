@@ -62,6 +62,7 @@ def write_sweep_bundle(path: str, *, seed: int, actor: str,
                        error: Optional[str] = None,
                        trace_path: Optional[str] = None,
                        minimization: Optional[Dict[str, Any]] = None,
+                       lineage: Optional[Dict[str, Any]] = None,
                        extra: Optional[Dict[str, Any]] = None) -> str:
     """Write a device-sweep repro bundle; returns the file path.
 
@@ -78,6 +79,13 @@ def write_sweep_bundle(path: str, *, seed: int, actor: str,
     ``madsim.triage.minimization/1``, docs/triage.md). When present,
     ``faults`` should be the MINIMIZED rows: replay then reproduces the
     failure from the minimal schedule, which is the point.
+
+    ``lineage`` (obs/lineage.py ``lineage_block``, schema
+    ``madsim.search.lineage/1``) records a GUIDED find's derivation:
+    the ancestry chain from the failing world back to the generation-0
+    template — which corpus parents it was spliced from, which mutation
+    operators touched it — plus the hunt's per-operator outcome table.
+    Rendered by ``python -m madsim_tpu.obs lineage <bundle>``.
     """
     import numpy as np
 
@@ -101,6 +109,7 @@ def write_sweep_bundle(path: str, *, seed: int, actor: str,
         "error": error,
         "trace_path": trace_path,
         "minimization": minimization,
+        "lineage": lineage,
         "extra": dict(extra or {}),
     }
     return _write(bundle, path, f"repro-seed{int(seed)}")

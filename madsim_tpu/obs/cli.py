@@ -164,6 +164,68 @@ def _replay_host_test(bundle: Dict[str, Any]) -> int:
     return 1
 
 
+def _lineage_cmd(path: str, out=None) -> int:
+    """``obs lineage <bundle|telemetry.jsonl>``: render a guided find's
+    ancestry tree + the hunt's per-operator outcome table
+    (obs/lineage.py; schema ``madsim.search.lineage/1``). Accepts a
+    repro bundle carrying a ``lineage`` block (triage/corpus.py) or a
+    sweep telemetry JSONL whose summary record carries ``search.finds``.
+    Exit 0 = rendered, 2 = the file holds no lineage."""
+    from .lineage import render_operator_table, render_tree
+
+    out = out or sys.stdout
+    if not os.path.exists(path):
+        print(f"obs lineage: no such file: {path}", file=sys.stderr)
+        return 2
+    blocks: List[Dict[str, Any]] = []
+    stats = None
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        # A repro bundle (obs/bundle.py) with a lineage block.
+        block = doc.get("lineage")
+        if block:
+            blocks = [block]
+            stats = block.get("operator_stats")
+    else:
+        # A telemetry JSONL stream: the sweep summary record carries
+        # search.finds (+ operator_stats inside each block).
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            srch = rec.get("search") if isinstance(rec, dict) else None
+            if isinstance(srch, dict) and srch.get("finds"):
+                blocks = list(srch["finds"])
+                stats = (blocks[0].get("operator_stats")
+                         or srch.get("operator_stats"))
+    if not blocks:
+        print(f"obs lineage: no lineage block in {path} — is it a "
+              "guided-hunt bundle (triage over a search= sweep with "
+              "SearchConfig(lineage=True)) or its telemetry stream?",
+              file=sys.stderr)
+        return 2
+    for block in blocks:
+        print(f"find: seed {block.get('seed')} (depth "
+              f"{block.get('depth')}, operators: "
+              f"{', '.join(block.get('operators_applied') or []) or 'none'})",
+              file=out)
+        print(render_tree(block.get("chain") or []), file=out)
+        if stats is None:
+            stats = block.get("operator_stats")
+    if stats:
+        print(render_operator_table(stats), file=out)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="madsim_tpu.obs",
@@ -197,8 +259,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     wp.add_argument("--prom", default=None,
                     help="also write a Prometheus text snapshot of the "
                          "latest record to this path (atomic rewrite)")
+    lp = sub.add_parser("lineage", help="render a guided find's ancestry "
+                                        "tree + operator outcome table "
+                                        "(docs/search.md)")
+    lp.add_argument("file", help="repro bundle with a lineage block, or "
+                                 "a sweep telemetry JSONL")
     args = ap.parse_args(argv)
 
+    if args.cmd == "lineage":
+        return _lineage_cmd(args.file)
     if args.cmd == "watch":
         from .observatory import watch
 

@@ -1,0 +1,405 @@
+# tracelint: hot-loop
+"""The evolution observatory: device-resident search lineage + operator
+outcome accounting (docs/search.md "Reading the lineage").
+
+The guided search (search/, docs/search.md) evolves fault schedules on
+device, but a ``SearchReport`` alone only says *what* was found — not
+which parents and operators produced a find, or which mutation operators
+are earning their keep. This module adds that accounting with the PR 5/6
+house pattern: **write-only device lanes** carried beside the state,
+folded inside the programs the sweep already dispatches, synced to the
+host only on the cadence it already pays (retire pulls + the final
+fetch), and bitwise invisible to the simulation itself
+(``SearchConfig(lineage=False)`` compiles every lane out; lineage-on ≡
+lineage-off is tier-1-gated).
+
+Three pieces:
+
+- **Provenance lanes** (:class:`LineageLanes`): every installed child
+  carries its two splice-parent corpus **entry ids**, an
+  applied-operator bitmask (one bit per operator class — the masks
+  already computed inside ``mutate.make_children``, exposed rather than
+  recomputed), and its ancestry depth. Entry ids are *globally unique by
+  construction*: a corpus entry inserted from the world at seed position
+  ``i`` gets entry id ``lin_base + i + 1`` (``0`` is the seeded
+  template, ``-1`` means "no parent"), where ``lin_base`` is the
+  sweep's seed-position base (a fleet range passes its ``lo``), so a
+  fleet-merged report resolves parents across ranges with plain
+  arithmetic.
+- **The operator outcome table** (:class:`OperatorTable`): per operator
+  bit, how many installed children carried it (``produced``), how many
+  retiring carriers cleared the novelty bar (``novel``), survived into
+  the corpus (``survived``), and found a bug (``bug``) — accumulated
+  inside the jitted ``search.generate`` program, pulled with the retire
+  ``_fetch`` the loop already pays. This is the measurement ROADMAP
+  item 2 names as the prerequisite for AFL-style operator credit
+  assignment.
+- **Host-side reconstruction**: :func:`ancestry` chases parent entry
+  ids through the per-seed lanes back to the generation-0 template;
+  :func:`render_tree` prints the chain; :func:`lineage_block` packages
+  a find's derivation as the ``madsim.search.lineage/1`` bundle block
+  the triage bundles carry and ``python -m madsim_tpu.obs lineage``
+  renders.
+
+Dtype discipline (docs/perf.md "Roofline round 2"): the operator
+bitmask lane is packed ``int8`` (5 bits used) and every read widens
+through ``engine/lanes.widen`` — the one sanctioned narrow→wide site
+(tracelint TRC005); entry ids and depths are unbounded counters and
+stay wide ``int32`` per the :class:`~madsim_tpu.engine.lanes.Lanes`
+category rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.lanes import narrow, widen
+
+# Operator classes, in bit order. Bit i of a child's ops mask is set iff
+# operator i touched at least one of its rows (the masks are the
+# intermediates of mutate.make_children, exposed — never recomputed).
+OP_NAMES = ("splice", "disable", "time_jitter", "node_rotate", "op_flip")
+N_OPS = len(OP_NAMES)
+OP_SPLICE, OP_DISABLE, OP_TIME, OP_NODE, OP_FLIP = range(N_OPS)
+
+# Outcome rows of the operator table, in array order.
+OUTCOME_NAMES = ("produced", "novel", "survived", "bug")
+
+# Entry-id vocabulary: TEMPLATE_ENTRY is the seeded template's corpus
+# entry; NO_PARENT marks a generation-0 world (it IS the template run,
+# not a mutation of it).
+TEMPLATE_ENTRY = 0
+NO_PARENT = -1
+
+LINEAGE_SCHEMA = "madsim.search.lineage/1"
+SEARCH_TELEMETRY_SCHEMA = "madsim.search.telemetry/1"
+
+
+# ---------------------------------------------------------------------------
+# Device lanes
+# ---------------------------------------------------------------------------
+
+class LineageLanes(NamedTuple):
+    """Per-slot provenance lanes, carried beside ``slot_sched`` through
+    the guided sweep (permuted/split by the same compactor dispatch,
+    harvested at retire, refilled with each installed child's lanes).
+
+    ``p1``/``p2`` are corpus ENTRY ids (the tournament winners the
+    child was spliced from; ``p2`` is recorded even when no row spliced
+    — the selection happened), ``ops`` the packed applied-operator
+    bitmask, ``depth`` the ancestry depth (template = 0, child = 1 +
+    max(parent depths)).
+    """
+
+    p1: jnp.ndarray     # (W,) i32 parent-1 corpus entry id (-1 = none)
+    p2: jnp.ndarray     # (W,) i32 parent-2 (splice) corpus entry id
+    ops: jnp.ndarray    # (W,) i8 packed operator bitmask (widen on read)
+    depth: jnp.ndarray  # (W,) i32 ancestry depth (template = 0)
+
+
+def lanes_origin(w: int) -> LineageLanes:
+    """Generation-0 lanes: the initial batch runs the template itself —
+    no parents, no operators, depth 0 (host arrays; the sweep shards
+    them)."""
+    return LineageLanes(
+        p1=jnp.full((w,), NO_PARENT, jnp.int32),
+        p2=jnp.full((w,), NO_PARENT, jnp.int32),
+        ops=jnp.zeros((w,), jnp.int8),
+        depth=jnp.zeros((w,), jnp.int32),
+    )
+
+
+def pack_ops(bits) -> jnp.ndarray:
+    """Fold per-operator bool masks ``bits[i]`` (each ``(W,)``) into the
+    packed i8 bitmask lane, through the sanctioned saturating
+    ``lanes.narrow`` write boundary (values fit 5 bits)."""
+    m = jnp.zeros(jnp.shape(bits[0]), jnp.int32)
+    for i, b in enumerate(bits):
+        m = m | (b.astype(jnp.int32) << i)
+    return narrow(m, jnp.int8)
+
+
+def ops_bits(ops: jnp.ndarray) -> jnp.ndarray:
+    """Unpack the i8 ops lane to a ``(..., N_OPS)`` bool matrix — the
+    ONE widen site of the lane (tracelint TRC005)."""
+    wide = widen(ops)
+    return (wide[..., None] >> jnp.arange(N_OPS, dtype=jnp.int32)) & 1 > 0
+
+
+class OperatorTable(NamedTuple):
+    """Per-operator outcome counters, device-resident (mesh-replicated
+    like the coverage ledger). All rows i32 — counters stay wide.
+
+    The fourth outcome (``bug``) is deliberately NOT a device row: a
+    find can halt the sweep (``stop_on_first_bug``) or sit live at exit,
+    in which case it never crosses a harvest edge — so bug credit is
+    folded HOST-side from the per-seed lanes the final fetch already
+    carries (:func:`host_credit` over ``obs['bug']``), which counts
+    every find exactly once."""
+
+    produced: jnp.ndarray   # (N_OPS,) children installed carrying the op
+    novel: jnp.ndarray      # (N_OPS,) retiring carriers >= min_novelty
+    survived: jnp.ndarray   # (N_OPS,) retiring carriers inserted
+
+
+def table_zeros() -> OperatorTable:
+    z = jnp.zeros((N_OPS,), jnp.int32)
+    return OperatorTable(produced=z, novel=z, survived=z)
+
+
+def credit(counter: jnp.ndarray, obits: jnp.ndarray,
+           mask: jnp.ndarray) -> jnp.ndarray:
+    """Add each masked world's operator bits into a per-op counter row:
+    ``counter[o] += sum_w mask[w] & obits[w, o]`` (dtype-pinned — a bare
+    sum would widen under the x64 flag, tracelint TRC003)."""
+    add = jnp.sum(obits & mask[..., None], axis=0, dtype=jnp.int32)
+    return counter + add
+
+
+# ---------------------------------------------------------------------------
+# Host twin of the outcome crediting (parity-gated, PR 9 FNV-twin style)
+# ---------------------------------------------------------------------------
+
+def host_ops_bits(ops: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`ops_bits` over an i8/i32 mask vector."""
+    wide = np.asarray(ops, np.int32)
+    return (wide[..., None] >> np.arange(N_OPS, dtype=np.int32)) & 1 > 0
+
+
+def host_credit(counter: np.ndarray, ops: np.ndarray,
+                mask: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`credit` — the fold the tier-1 parity test
+    holds against the device accumulation."""
+    obits = host_ops_bits(ops)
+    add = np.sum(obits & np.asarray(mask, bool)[..., None], axis=0,
+                 dtype=np.int32)
+    return np.asarray(counter, np.int32) + add
+
+
+# ---------------------------------------------------------------------------
+# Host-side lineage reconstruction
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SearchLineage:
+    """Per-seed provenance of one guided sweep
+    (``SweepResult.search.lineage``).
+
+    Arrays are indexed by seed POSITION (row ``i`` of the sweep's seed
+    vector). ``entry_base`` is the sweep's entry-id base: the world at
+    position ``i`` — if its schedule survived into the corpus — holds
+    entry id ``entry_base + i + 1``, so ``resolve(e) = e - 1 -
+    entry_base`` maps a parent entry id back to a seed position. A
+    fleet-merged lineage concatenates ranges into global positions with
+    ``entry_base = 0`` (each range wrote ids at base ``range.lo``), so
+    cross-range ancestry resolves with the same arithmetic.
+    """
+
+    parent1: np.ndarray   # (n,) i32 corpus entry id (-1 = generation 0)
+    parent2: np.ndarray   # (n,) i32 splice-parent entry id
+    ops: np.ndarray       # (n,) i32 applied-operator bitmask
+    depth: np.ndarray     # (n,) i32 ancestry depth (template = 0)
+    entry_base: int = 0
+
+    def resolve(self, entry: int) -> Optional[int]:
+        """Seed position holding ``entry``, or None for the template /
+        an entry outside this report (an exchange-seeded parent from
+        another range, visible only in the fleet-merged report)."""
+        if entry <= TEMPLATE_ENTRY:
+            return None
+        pos = int(entry) - 1 - self.entry_base
+        return pos if 0 <= pos < self.parent1.shape[0] else None
+
+    @property
+    def max_depth(self) -> int:
+        return int(self.depth.max()) if self.depth.size else 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"n_seeds": int(self.parent1.shape[0]),
+                "entry_base": int(self.entry_base),
+                "max_depth": self.max_depth}
+
+
+def op_names(mask: int) -> List[str]:
+    """Operator names set in a packed bitmask, in bit order."""
+    return [name for i, name in enumerate(OP_NAMES) if (int(mask) >> i) & 1]
+
+
+def ancestry(lin: SearchLineage, pos: int,
+             seeds: Optional[np.ndarray] = None,
+             max_depth: int = 10_000) -> List[Dict[str, Any]]:
+    """The ancestry chain of the world at seed position ``pos``: a list
+    of nodes from the find itself back to the generation-0 template,
+    following the primary (``parent1``) line and recording the splice
+    parent of every hop.
+
+    Each node: ``{"pos", "seed", "entry", "depth", "ops", "parent1",
+    "parent2", "kind"}`` with ``kind`` one of ``"world"`` /
+    ``"template"`` / ``"external"`` (an exchange-seeded parent whose
+    origin range is outside this report). Chains are finite by
+    construction — parents always retired strictly earlier — but
+    ``max_depth`` bounds a corrupted report.
+    """
+    chain: List[Dict[str, Any]] = []
+    cur: Optional[int] = int(pos)
+    hops = 0
+    while cur is not None and hops < max_depth:
+        hops += 1
+        e1, e2 = int(lin.parent1[cur]), int(lin.parent2[cur])
+        chain.append({
+            "pos": cur,
+            "seed": int(np.asarray(seeds)[cur]) if seeds is not None
+            else cur,
+            "entry": int(lin.entry_base) + cur + 1,
+            "depth": int(lin.depth[cur]),
+            "ops": op_names(int(lin.ops[cur])),
+            "parent1": e1,
+            "parent2": e2,
+            "kind": "world",
+        })
+        if e1 == NO_PARENT:
+            # Generation 0: this world ran the template itself.
+            chain.append({"entry": NO_PARENT, "kind": "template",
+                          "depth": 0, "ops": [], "parent1": NO_PARENT,
+                          "parent2": NO_PARENT})
+            return chain
+        if e1 == TEMPLATE_ENTRY:
+            chain.append({"entry": TEMPLATE_ENTRY, "kind": "template",
+                          "depth": 0, "ops": [], "parent1": NO_PARENT,
+                          "parent2": NO_PARENT})
+            return chain
+        nxt = lin.resolve(e1)
+        if nxt is None:
+            chain.append({"entry": e1, "kind": "external", "depth": -1,
+                          "ops": [], "parent1": NO_PARENT,
+                          "parent2": NO_PARENT})
+            return chain
+        cur = nxt
+    return chain
+
+
+def render_tree(chain: List[Dict[str, Any]]) -> str:
+    """Terminal rendering of an ancestry chain (find first, template
+    last) — the ``obs lineage`` CLI body."""
+    lines: List[str] = []
+    for i, node in enumerate(chain):
+        pad = "" if i == 0 else "  " * (i - 1) + "└─ "
+        if node["kind"] == "template":
+            lines.append(f"{pad}template (entry {TEMPLATE_ENTRY}, "
+                         "generation 0)")
+            continue
+        if node["kind"] == "external":
+            lines.append(f"{pad}external entry {node['entry']} "
+                         "(exchange-seeded; resolve in the fleet-merged "
+                         "report)")
+            continue
+        if node["parent1"] == NO_PARENT:
+            # Generation-0 world: it RAN the template (no mutation).
+            lines.append(f"{pad}seed {node['seed']} (entry "
+                         f"{node['entry']}, depth 0) ran the template")
+            continue
+        ops = "+".join(node["ops"]) if node["ops"] else "no-op-copy"
+        splice = (f"  [x entry {node['parent2']}]"
+                  if "splice" in node["ops"] else "")
+        lines.append(f"{pad}seed {node['seed']} (entry {node['entry']}, "
+                     f"depth {node['depth']}) via {ops}{splice}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Operator stats (host dicts of the device OperatorTable)
+# ---------------------------------------------------------------------------
+
+def operator_stats(produced, novel, survived, bug) -> Dict[str, Dict[str, int]]:
+    """Host dict of the pulled outcome table: one row per operator,
+    ``{produced, novel, survived, bug, survival_pct}``."""
+    out: Dict[str, Dict[str, int]] = {}
+    produced = np.asarray(produced, np.int64)
+    novel = np.asarray(novel, np.int64)
+    survived = np.asarray(survived, np.int64)
+    bug = np.asarray(bug, np.int64)
+    for i, name in enumerate(OP_NAMES):
+        p = int(produced[i])
+        out[name] = {
+            "produced": p,
+            "novel": int(novel[i]),
+            "survived": int(survived[i]),
+            "bug": int(bug[i]),
+            # Corpus-survival rate per installed carrier — the credit
+            # signal a future operator scheduler would feed on.
+            "survival_pct": round(100.0 * int(survived[i]) / p, 2)
+            if p else 0.0,
+        }
+    return out
+
+
+def merge_operator_stats(parts: List[Dict[str, Dict[str, int]]]
+                         ) -> Dict[str, Dict[str, int]]:
+    """Sum per-range operator tables into the fleet table (counts add;
+    the rate recomputes)."""
+    acc = {name: {k: 0 for k in OUTCOME_NAMES} for name in OP_NAMES}
+    for part in parts:
+        for name in OP_NAMES:
+            row = part.get(name, {})
+            for k in OUTCOME_NAMES:
+                acc[name][k] += int(row.get(k, 0))
+    for name in OP_NAMES:
+        p = acc[name]["produced"]
+        acc[name]["survival_pct"] = (round(
+            100.0 * acc[name]["survived"] / p, 2) if p else 0.0)
+    return acc
+
+
+def top_operator(stats: Optional[Dict[str, Dict[str, int]]],
+                 by: str = "survived") -> Optional[str]:
+    """The operator with the highest ``by`` count (ties to bit order);
+    None when the table is empty/absent or all-zero."""
+    if not stats:
+        return None
+    best, best_v = None, 0
+    for name in OP_NAMES:
+        v = int(stats.get(name, {}).get(by, 0))
+        if v > best_v:
+            best, best_v = name, v
+    return best
+
+
+def render_operator_table(stats: Dict[str, Dict[str, int]]) -> str:
+    """Fixed-width terminal table of the per-operator outcome counts."""
+    head = (f"{'operator':<12} {'produced':>9} {'novel':>7} "
+            f"{'survived':>9} {'bug':>5} {'surv%':>7}")
+    lines = [head, "-" * len(head)]
+    for name in OP_NAMES:
+        row = stats.get(name, {})
+        lines.append(
+            f"{name:<12} {row.get('produced', 0):>9} "
+            f"{row.get('novel', 0):>7} {row.get('survived', 0):>9} "
+            f"{row.get('bug', 0):>5} {row.get('survival_pct', 0.0):>7}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The madsim.search.lineage/1 bundle block
+# ---------------------------------------------------------------------------
+
+def lineage_block(lin: SearchLineage, pos: int,
+                  seeds: Optional[np.ndarray] = None,
+                  stats: Optional[Dict[str, Dict[str, int]]] = None
+                  ) -> Dict[str, Any]:
+    """The provenance block a triage bundle carries for a guided find:
+    the find's full ancestry chain plus the sweep's operator outcome
+    table — a minimized repro that documents its own derivation
+    (schema ``madsim.search.lineage/1``)."""
+    chain = ancestry(lin, pos, seeds=seeds)
+    applied = sorted({op for node in chain for op in node.get("ops", [])})
+    return {
+        "schema": LINEAGE_SCHEMA,
+        "seed": chain[0].get("seed") if chain else None,
+        "depth": chain[0].get("depth", 0) if chain else 0,
+        "operators_applied": applied,
+        "chain": chain,
+        "operator_stats": stats,
+    }

@@ -57,6 +57,22 @@ _FLEET_SCHEMA = "madsim.fleet.telemetry/1"
 # a lease), resume (coordinator crash→resume snapshot count).
 _EXCHANGE_SCHEMA = "madsim.fleet.exchange/1"
 
+# The evolution observatory (obs/lineage.py, docs/search.md "Reading
+# the lineage"): guided sweeps emit one record per refill — corpus
+# size/insert pressure, per-refill novelty, and the per-operator
+# produced/novel/survived scalars — built from values the retire pull
+# already fetched (zero extra device syncs, counted tier-1).
+_SEARCH_SCHEMA = "madsim.search.telemetry/1"
+
+# Schema → short key, for the per-schema Prometheus counters and the
+# snapshot's namespacing.
+_SCHEMA_KEYS = {
+    _SCHEMA: "sweep",
+    _FLEET_SCHEMA: "fleet",
+    _EXCHANGE_SCHEMA: "exchange",
+    _SEARCH_SCHEMA: "search",
+}
+
 
 class JsonlEmitter:
     """Append one JSON line per telemetry record; flush per line so a
@@ -121,17 +137,70 @@ def prometheus_text(record: dict, prefix: str = "madsim_sweep") -> str:
     return "\n".join(lines) + "\n"
 
 
-def write_prometheus(record: dict, path: str,
-                     prefix: str = "madsim_sweep") -> None:
-    """Atomically (tmp+rename) write a Prometheus snapshot of one record
-    — the node-exporter-textfile-collector handoff shape, so a scraper
-    never reads a half-written file."""
-    text = prometheus_text(record, prefix=prefix)
+def _atomic_write(text: str, path: str) -> None:
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     with os.fdopen(fd, "w", encoding="utf-8") as f:
         f.write(text)
     os.replace(tmp, path)
+
+
+def write_prometheus(record: dict, path: str,
+                     prefix: str = "madsim_sweep") -> None:
+    """Atomically (tmp+rename) write a Prometheus snapshot of one record
+    — the node-exporter-textfile-collector handoff shape, so a scraper
+    never reads a half-written file."""
+    _atomic_write(prometheus_text(record, prefix=prefix), path)
+
+
+def _prom_name(s: str) -> str:
+    """Sanitize an event/schema key into a metric-name fragment."""
+    return "".join(c if c.isalnum() else "_" for c in str(s))
+
+
+def prometheus_snapshot(records: List[dict]) -> str:
+    """Whole-stream Prometheus snapshot: per-schema record counters,
+    per-event fleet/exchange counters, and the latest sweep + search
+    records' gauges.
+
+    A stream from a fleet interleaves four schemas; rendering only the
+    newest record used to let a fleet/exchange record carry no sweep
+    gauges at all (and fleet activity never surfaced as metrics). The
+    snapshot keeps the newest record of EACH numeric schema as gauges
+    (``madsim_sweep_*`` / ``madsim_search_*``) and counts every record
+    and fleet/exchange event (``madsim_records_<schema>``,
+    ``madsim_fleet_events_<event>``, ``madsim_exchange_events_<event>``)
+    so node-exporter dashboards see fleet + search activity, not just
+    sweep progress.
+    """
+    parts: List[str] = []
+    counts: dict = {}
+    events: dict = {}
+    latest: dict = {}
+    for r in records:
+        key = _SCHEMA_KEYS.get(r.get("schema"), "other")
+        counts[key] = counts.get(key, 0) + 1
+        if key in ("sweep", "search"):
+            latest[key] = r
+        if key in ("fleet", "exchange") and r.get("event"):
+            name = f"madsim_{key}_events_{_prom_name(r['event'])}"
+            events[name] = events.get(name, 0) + 1
+    for key in sorted(counts):
+        name = f"madsim_records_{_prom_name(key)}"
+        parts.append(f"# TYPE {name} counter\n{name} {counts[key]}")
+    for name in sorted(events):
+        parts.append(f"# TYPE {name} counter\n{name} {events[name]}")
+    out = "\n".join(parts) + ("\n" if parts else "")
+    if "sweep" in latest:
+        out += prometheus_text(latest["sweep"], prefix="madsim_sweep")
+    if "search" in latest:
+        out += prometheus_text(latest["search"], prefix="madsim_search")
+    return out
+
+
+def write_prometheus_snapshot(records: List[dict], path: str) -> None:
+    """Atomic write of :func:`prometheus_snapshot` (tmp+rename)."""
+    _atomic_write(prometheus_snapshot(records), path)
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +383,47 @@ def render_exchange_summary(exchange: List[dict]) -> List[str]:
     return [line]
 
 
+def render_search_event(rec: dict) -> str:
+    """One terminal line per search-telemetry record (obs/lineage.py):
+    refill-grain corpus growth and the per-operator survival scalars, so
+    an operator can watch which mutation operators are earning their
+    keep while the hunt runs."""
+    bits = [f"t={rec.get('elapsed_s', 0):8.2f}s", "[search]",
+            rec.get("event", "?"),
+            f"gen={rec.get('generation', '?')}",
+            f"corpus={rec.get('corpus_size', '?')}",
+            f"inserted={rec.get('corpus_inserted', '?')}"]
+    if rec.get("refill_novel") is not None:
+        bits.append(f"novel+={rec['refill_novel']}")
+    if rec.get("refill_inserted") is not None:
+        bits.append(f"ins+={rec['refill_inserted']}")
+    surv = [(k[len("op_survived_"):], v) for k, v in rec.items()
+            if k.startswith("op_survived_") and v]
+    if surv:
+        bits.append("survived[" + " ".join(f"{k}={v}"
+                                           for k, v in sorted(surv)) + "]")
+    return "  ".join(str(b) for b in bits)
+
+
+def render_search_summary(search: List[dict]) -> List[str]:
+    """Aggregate line for the search records in a stream: generations,
+    corpus growth, and the top surviving operator."""
+    if not search:
+        return []
+    last = search[-1]
+    line = (f"search: {len(search)} refill(s), generation "
+            f"{last.get('generation', '?')}, corpus "
+            f"{last.get('corpus_size', '?')} "
+            f"({last.get('corpus_inserted', '?')} inserted)")
+    surv = [(k[len("op_survived_"):], v) for k, v in last.items()
+            if k.startswith("op_survived_")]
+    if surv:
+        top = max(surv, key=lambda kv: kv[1])
+        if top[1]:
+            line += f"; top operator {top[0]} ({top[1]} survived)"
+    return [line]
+
+
 def render_fleet_summary(fleet: List[dict]) -> List[str]:
     """Aggregate lines for the fleet records in a stream: event counts
     plus the resilience headline (expiries, re-leases, crosschecked
@@ -343,13 +453,16 @@ def render_summary(records: List[dict]) -> str:
         return "watch: empty telemetry stream"
     fleet = [r for r in records if r.get("schema") == _FLEET_SCHEMA]
     exchange = [r for r in records if r.get("schema") == _EXCHANGE_SCHEMA]
+    search = [r for r in records if r.get("schema") == _SEARCH_SCHEMA]
     records = [r for r in records
-               if r.get("schema") not in (_FLEET_SCHEMA, _EXCHANGE_SCHEMA)]
+               if r.get("schema") not in (_FLEET_SCHEMA, _EXCHANGE_SCHEMA,
+                                          _SEARCH_SCHEMA)]
     progress = [r for r in records if r.get("event") != "summary"]
     summary = next((r for r in records if r.get("event") == "summary"),
                    None)
     lines: List[str] = render_fleet_summary(fleet)
     lines.extend(render_exchange_summary(exchange))
+    lines.extend(render_search_summary(search))
     if progress:
         lines.append(f"{len(progress)} progress records; last:")
         lines.append("  " + render_progress(progress[-1]))
@@ -378,6 +491,22 @@ def render_summary(records: List[dict]) -> str:
                 f"behaviors in {cov.get('n_buckets')} buckets "
                 f"({cov.get('worlds_folded')} worlds folded, novelty "
                 f"{cov.get('novelty_first')}->{cov.get('novelty_last')})")
+        srch = summary.get("search")
+        if srch:
+            line = (f"search: corpus {srch.get('corpus_size')}/"
+                    f"{srch.get('corpus_capacity')} after "
+                    f"{srch.get('generations')} generation(s), "
+                    f"{srch.get('inserted')} inserted")
+            ops = srch.get("operator_stats") or {}
+            best = max(ops.items(),
+                       key=lambda kv: kv[1].get("survived", 0),
+                       default=None)
+            if best and best[1].get("survived", 0):
+                line += (f"; top operator {best[0]} "
+                         f"({best[1]['survived']} survived, "
+                         f"{best[1].get('survival_pct', 0)}% of "
+                         f"{best[1].get('produced', 0)} produced)")
+            lines.append(line)
     elif not fleet and not exchange:
         lines.append("no summary record yet (sweep still running?)")
     return "\n".join(lines)
@@ -397,7 +526,7 @@ def watch(path: str, follow: bool = False, prom: Optional[str] = None,
         records = _load_records(path)
         print(render_summary(records), file=out)
         if prom and records:
-            write_prometheus(records[-1], prom)
+            write_prometheus_snapshot(records, prom)
         return 0
     # Follow mode: host-side tail of a host-side stream — the one place
     # a real sleep belongs (this process never runs simulation code).
@@ -407,10 +536,13 @@ def watch(path: str, follow: bool = False, prom: Optional[str] = None,
     done = False
     while not done:
         records = _load_records(path)
-        for rec in records[seen:]:
-            if rec.get("event") == "summary":
+        for i, rec in enumerate(records[seen:], start=seen):
+            if rec.get("event") == "summary" \
+                    and rec.get("schema") != _SEARCH_SCHEMA:
                 print(render_summary(records), file=out)
                 done = True
+            elif rec.get("schema") == _SEARCH_SCHEMA:
+                print(render_search_event(rec), file=out)
             elif rec.get("schema") == _EXCHANGE_SCHEMA:
                 print(render_exchange_event(rec), file=out)
             elif rec.get("schema") == _FLEET_SCHEMA:
@@ -418,7 +550,10 @@ def watch(path: str, follow: bool = False, prom: Optional[str] = None,
             else:
                 print(render_progress(rec), file=out)
             if prom:
-                write_prometheus(rec, prom)
+                # Snapshot over everything seen so far: a fleet or
+                # search record must ADD counters, never clobber the
+                # sweep gauges (the per-schema counter satellite).
+                write_prometheus_snapshot(records[:i + 1], prom)
         seen = len(records)
         if not done:
             _walltime.sleep(interval)  # detlint: allow[DET001]

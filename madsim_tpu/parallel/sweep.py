@@ -565,6 +565,20 @@ class SweepResult:
                     f"across the run)" if curve.size else "")
             parts.append(f"{cov.distinct_behaviors} distinct behaviors "
                          f"in {cov.n_buckets} buckets{tail}")
+        if self.search is not None:
+            # Guided hunts summarize their evolution too (obs/lineage.py):
+            # corpus fill, insert pressure, generations, top operator.
+            s = self.search
+            line = (f"guided search: corpus {s.corpus_size}/"
+                    f"{s.corpus_capacity}, {s.inserted} inserted over "
+                    f"{s.generations} generations")
+            if getattr(s, "operator_stats", None):
+                from ..obs.lineage import top_operator
+
+                top = top_operator(s.operator_stats)
+                if top:
+                    line += f", top operator {top}"
+            parts.append(line)
         m = self.metrics
         if m is not None:
             agg = m["aggregate"]
@@ -611,7 +625,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           coverage_buckets: Optional[int] = None,
           search: Optional[Any] = None,
           search_corpus: Optional[Any] = None,
-          search_gen0: int = 0) -> SweepResult:
+          search_gen0: int = 0,
+          search_lin_base: int = 0) -> SweepResult:
     """Run one simulation per seed, sharded over the mesh, to completion.
 
     The loop is a slot-occupancy model: the device batch is a fixed set of
@@ -784,6 +799,15 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     per range, chaos-invariant. ``SweepResult.search.generations``
     still reports the generations THIS sweep ran (the offset is
     subtracted).
+
+    ``search_lin_base``: base of the lineage entry-id space
+    (obs/lineage.py; default 0). A world at seed position ``i`` whose
+    schedule survives into the corpus is recorded under entry id
+    ``search_lin_base + i + 1`` — a fleet range passes its ``lo`` so
+    entry ids are globally unique across ranges and the merged report
+    resolves cross-range ancestry with plain arithmetic. Pure
+    accounting: it shifts ids only, never a corpus decision or a child
+    byte.
     """
     from ..engine import checkpoint as ckpt
 
@@ -842,6 +866,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                          "streams and needs search=SearchConfig(...)")
     if search_gen0 < 0:
         raise ValueError("search_gen0 must be >= 0")
+    if search_lin_base and not search_on:
+        raise ValueError("search_lin_base= offsets the lineage entry-id "
+                         "space and needs search=SearchConfig(...)")
+    if search_lin_base < 0:
+        raise ValueError("search_lin_base must be >= 0")
+    lineage_on = bool(search_on and getattr(search, "lineage", False))
 
     # Batch width: a multiple of the mesh. Plain sweeps hold every seed at
     # once; recycled sweeps hold batch_worlds slots and stream the rest.
@@ -993,7 +1023,15 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     # the mesh-replicated parent pool (search/corpus.py).
     slot_sched = corpus = None
     retired_sched: List[np.ndarray] = []
-    search_host = {"corpus_size": 1, "inserted": 0}
+    # -- lineage lanes + operator outcome table (obs/lineage.py) ----------
+    # slot_lin: per-slot provenance (parent entry ids, applied-operator
+    # bitmask, ancestry depth), permuted/split/refilled in lockstep with
+    # slot_sched; op_tab: the per-operator produced/novel/survived/bug
+    # counters, accumulated inside the searcher program.
+    slot_lin = op_tab = None
+    retired_lin: List[tuple] = []
+    search_host = {"corpus_size": 1, "inserted": 0, "gen": 0,
+                   "refill_novel": 0, "refill_inserted": 0}
     if search_on:
         from ..search.corpus import CorpusState, corpus_init
         from ..search.generate import searcher as _searcher
@@ -1004,6 +1042,14 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                  else np.broadcast_to(faults_p, (w0,) + faults_p.shape))
         slot_sched = shard_worlds(
             jnp.asarray(np.ascontiguousarray(base0), jnp.int32), mesh)
+        if lineage_on:
+            from ..obs.lineage import lanes_origin, table_zeros
+
+            # The initial batch runs the template itself: generation-0
+            # lanes (no parents, no operators, depth 0).
+            slot_lin = shard_worlds(lanes_origin(w0), mesh)
+            op_tab = jax.device_put(table_zeros(),
+                                    NamedSharding(mesh, scalar_spec()))
         if search_corpus is not None:
             # Exchange seeding (fleet/exchange.py): start from a merged
             # host corpus instead of the template-only init. The per-
@@ -1016,7 +1062,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                     f"search_corpus.sched must be (K, F, 4) = "
                     f"({k}, {f_rows}, 4) for SearchConfig.corpus={k} and "
                     f"the {f_rows}-row template; got {sc_sched.shape}")
-            for name in ("sig", "score", "filled"):
+            for name in ("sig", "score", "filled", "entry", "depth"):
                 shp = np.asarray(getattr(search_corpus, name)).shape
                 if shp != (k,):
                     raise ValueError(
@@ -1033,6 +1079,10 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                                              np.int32)),
                 filled=jnp.asarray(np.asarray(search_corpus.filled, bool)),
                 gen=jnp.int32(search_gen0), inserted=jnp.int32(0),
+                entry=jnp.asarray(np.asarray(search_corpus.entry,
+                                             np.int32)),
+                depth=jnp.asarray(np.asarray(search_corpus.depth,
+                                             np.int32)),
             ), NamedSharding(mesh, scalar_spec()))
         else:
             # Corpus seeded with the (normalized) template: parents
@@ -1074,6 +1124,15 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             # insert counters), and the retired-schedule attribution.
             from ..search.corpus import CorpusState
 
+            if lineage_on != ("srch_lin_p1" in resume_aux):
+                raise ckpt.CheckpointError(
+                    f"checkpoint {checkpoint_path!r} was written with "
+                    f"lineage "
+                    f"{'on' if 'srch_lin_p1' in resume_aux else 'off'} "
+                    f"but this resume runs SearchConfig(lineage="
+                    f"{lineage_on}): the provenance lanes cannot be "
+                    "reconciled — resume with the original lineage "
+                    "setting")
             slot_sched = shard_worlds(jnp.asarray(
                 np.asarray(resume_aux["srch_sched"], np.int32)), mesh)
             corpus = jax.device_put(CorpusState(
@@ -1089,10 +1148,42 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                                            np.int32).reshape(())),
                 inserted=jnp.asarray(np.asarray(
                     resume_aux["srch_c_inserted"], np.int32).reshape(())),
+                entry=jnp.asarray(np.asarray(resume_aux["srch_c_entry"],
+                                             np.int32)),
+                depth=jnp.asarray(np.asarray(resume_aux["srch_c_depth"],
+                                             np.int32)),
             ), NamedSharding(mesh, scalar_spec()))
             if "srch_ret" in resume_aux:
                 retired_sched.append(
                     np.asarray(resume_aux["srch_ret"], np.int32))
+            if lineage_on:
+                # Lineage lanes + operator table ride the same aux
+                # channel — a resumed hunt's ancestry and outcome
+                # accounting equal an unbroken run's bit for bit.
+                from ..obs.lineage import LineageLanes, OperatorTable
+
+                slot_lin = shard_worlds(LineageLanes(
+                    p1=jnp.asarray(np.asarray(resume_aux["srch_lin_p1"],
+                                              np.int32)),
+                    p2=jnp.asarray(np.asarray(resume_aux["srch_lin_p2"],
+                                              np.int32)),
+                    ops=jnp.asarray(np.asarray(resume_aux["srch_lin_ops"],
+                                               np.int8)),
+                    depth=jnp.asarray(np.asarray(
+                        resume_aux["srch_lin_depth"], np.int32)),
+                ), mesh)
+                op_tab = jax.device_put(OperatorTable(
+                    produced=jnp.asarray(np.asarray(
+                        resume_aux["srch_op_produced"], np.int32)),
+                    novel=jnp.asarray(np.asarray(
+                        resume_aux["srch_op_novel"], np.int32)),
+                    survived=jnp.asarray(np.asarray(
+                        resume_aux["srch_op_survived"], np.int32)),
+                ), NamedSharding(mesh, scalar_spec()))
+                if "srch_ret_lin_p1" in resume_aux:
+                    retired_lin.append(tuple(
+                        np.asarray(resume_aux[f"srch_ret_lin_{k}"])
+                        for k in ("p1", "p2", "ops", "depth")))
     n_active_hist: List[int] = []
     n_active_chunk: List[int] = []     # chunk index each entry measured at
     issued_slot_steps = 0              # sum over chunks of width*chunk_steps
@@ -1181,11 +1272,13 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         emit_telemetry(rec)
 
     def retire(obs_slice: Dict[str, np.ndarray], rows: np.ndarray,
-               sched_slice: Optional[np.ndarray] = None) -> None:
+               sched_slice: Optional[np.ndarray] = None,
+               lin_slice: Optional[tuple] = None) -> None:
         """Record final observations for rows leaving the batch (dead
         slots — already retired earlier — are filtered out by idx).
         ``sched_slice`` (guided sweeps) carries the retiring rows'
-        materialized fault schedules, filtered identically."""
+        materialized fault schedules; ``lin_slice`` (lineage on) their
+        provenance lanes — both filtered identically."""
         nonlocal live_world_steps
         keep = rows >= 0
         if not keep.all():
@@ -1193,6 +1286,8 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             obs_slice = {k: np.asarray(v)[keep] for k, v in obs_slice.items()}
             if sched_slice is not None:
                 sched_slice = np.asarray(sched_slice)[keep]
+            if lin_slice is not None:
+                lin_slice = tuple(np.asarray(a)[keep] for a in lin_slice)
         if rows.size == 0:
             return
         live_world_steps += int(np.asarray(obs_slice["steps"]).sum())
@@ -1201,28 +1296,67 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             retired.setdefault(k, []).append(np.asarray(v))
         if sched_slice is not None:
             retired_sched.append(np.asarray(sched_slice, np.int32))
+        if lin_slice is not None:
+            retired_lin.append(tuple(np.asarray(a) for a in lin_slice))
+
+    def emit_search_point(op_h) -> None:
+        """One ``madsim.search.telemetry/1`` record per guided refill —
+        built ONLY from the values the retire pull already fetched
+        (zero extra device syncs, like every other telemetry record).
+        ``op_h`` is the pulled OperatorTable (or None, lineage off)."""
+        if emit_telemetry is None or not search_on:
+            return
+        from ..obs.lineage import OP_NAMES
+        from ..obs.lineage import (
+            SEARCH_TELEMETRY_SCHEMA as _SEARCH_SCHEMA,
+        )
+
+        rec = {
+            "schema": _SEARCH_SCHEMA,
+            "event": "refill",
+            "elapsed_s": round(_clk() - t_loop0, 6),
+            "generation": search_host["gen"],
+            "corpus_size": search_host["corpus_size"],
+            "corpus_inserted": search_host["inserted"],
+            "refill_novel": search_host["refill_novel"],
+            "refill_inserted": search_host["refill_inserted"],
+        }
+        if op_h is not None:
+            for row, vals in zip(("produced", "novel", "survived"), op_h):
+                arr = np.asarray(vals)
+                for i, name in enumerate(OP_NAMES):
+                    rec[f"op_{row}_{name}"] = int(arr[i])
+        emit_telemetry(rec)
 
     def fetch_retire(handles) -> None:
         """Materialize a deferred on-device retirement slice and record
         it. The pull covers ONLY the (bucketed) frozen-tail rows — the
         full per-world observation arrays never cross to the host. On a
         guided sweep the same single ``_fetch`` additionally carries the
-        tail's schedule rows and the corpus telemetry scalars — the
-        "corpus syncs ride the existing cadence" half of the zero-new-
-        syncs contract (tests/test_search.py counts this)."""
-        obs_t, idx_t, tail_len, sched_t, stats_t = handles
+        tail's schedule rows, its lineage lanes, the corpus telemetry
+        scalars, and the operator outcome table — the "corpus syncs
+        ride the existing cadence" half of the zero-new-syncs contract
+        (tests/test_search.py counts this)."""
+        obs_t, idx_t, tail_len, sched_t, stats_t, lin_t, op_t = handles
         t0 = _clk()
-        obs_h, idx_h, sched_h, stats_h = _fetch(
-            (obs_t, idx_t, sched_t, stats_t))
+        obs_h, idx_h, sched_h, stats_h, lin_h, op_h = _fetch(
+            (obs_t, idx_t, sched_t, stats_t, lin_t, op_t))
         perf["retire_wait_s"] += _clk() - t0
         perf["retire_fetches"] += 1
         if stats_h is not None:
             search_host["corpus_size"] = int(stats_h[0])
             search_host["inserted"] = int(stats_h[1])
+            if len(stats_h) > 2:           # lineage-on stats vector
+                search_host["gen"] = int(stats_h[2])
+                search_host["refill_novel"] = int(stats_h[3])
+                search_host["refill_inserted"] = int(stats_h[4])
+            emit_search_point(op_h)
         retire({k: np.asarray(v)[:tail_len] for k, v in obs_h.items()},
                np.asarray(idx_h)[:tail_len],
                (np.asarray(sched_h)[:tail_len]
-                if sched_h is not None else None))
+                if sched_h is not None else None),
+               (tuple(np.asarray(a)[:tail_len] for a in lin_h)
+                if lin_h is not None else None))
 
     def do_refill(n_act: int):
         """World recycling: stable active-first partition on device,
@@ -1239,8 +1373,17 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         tail into the corpus and generates the children the refill
         installs through ``DeviceEngine.refill``'s device-schedule
         path."""
-        nonlocal state, idx, cursor, reordered, slot_sched, corpus
-        if search_on:
+        nonlocal state, idx, cursor, reordered, slot_sched, corpus, \
+            slot_lin, op_tab
+        if search_on and lineage_on:
+            # The lineage lanes permute/split with the state in the SAME
+            # compaction dispatch (the varargs sched group), so
+            # provenance attribution travels with the worlds for free.
+            (state, idx, slot_sched, l_p1, l_p2, l_ops, l_dep) = \
+                _compactor(eng, mesh, w_cur, w_cur, with_sched=True)(
+                    state, idx, slot_sched, *slot_lin)
+            slot_lin = type(slot_lin)(l_p1, l_p2, l_ops, l_dep)
+        elif search_on:
             state, idx, slot_sched = _compactor(
                 eng, mesh, w_cur, w_cur, with_sched=True)(
                     state, idx, slot_sched)
@@ -1259,16 +1402,36 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         mask = np.zeros(w_cur, bool)
         mask[n_act:n_act + take] = True
         fill_ids = np.maximum(repl, 0)
-        sched_t = stats_t = None
+        sched_t = stats_t = lin_t = op_t = None
         if search_on:
-            sched_t = _sched_tail(eng, mesh, w_cur, rows)(
-                slot_sched, jnp.int32(n_act))
             new_ids = shard_worlds(
                 jnp.asarray(fill_ids.astype(np.int32)), mesh)
-            children, corpus, stats_t = _searcher(
-                eng, mesh, search, w_cur, f_rows)(
-                    state, slot_sched, idx, corpus, jnp.int32(n_act),
-                    new_ids)
+            if lineage_on:
+                # One tail gather covers the schedules AND the lanes
+                # (same bucketed program, a wider pytree); it reads the
+                # PRE-refill lanes — the retiring worlds' provenance —
+                # before the children overwrite them below.
+                sched_t, lt1, lt2, lto, ltd = _sched_tail(
+                    eng, mesh, w_cur, rows)(
+                        (slot_sched,) + tuple(slot_lin), jnp.int32(n_act))
+                lin_t = (lt1, lt2, lto, ltd)
+                fill_dev = shard_worlds(jnp.asarray(mask), mesh)
+                children, child_lin, corpus, op_tab, stats_t = _searcher(
+                    eng, mesh, search, w_cur, f_rows)(
+                        state, slot_sched, idx, corpus, jnp.int32(n_act),
+                        new_ids, fill_dev, slot_lin, op_tab,
+                        jnp.int32(search_lin_base))
+                op_t = op_tab
+                slot_lin = type(slot_lin)(*(
+                    jnp.where(jnp.asarray(mask), c, s)
+                    for c, s in zip(child_lin, slot_lin)))
+            else:
+                sched_t = _sched_tail(eng, mesh, w_cur, rows)(
+                    slot_sched, jnp.int32(n_act))
+                children, corpus, stats_t = _searcher(
+                    eng, mesh, search, w_cur, f_rows)(
+                        state, slot_sched, idx, corpus, jnp.int32(n_act),
+                        new_ids)
             state = shard_worlds(
                 eng.refill(state, mask, seeds_p[fill_ids],
                            faults=children), mesh)
@@ -1280,7 +1443,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                            faults=batch_faults(fill_ids)), mesh)
         idx = jnp.where(jnp.asarray(np.arange(w_cur) >= n_act),
                         jnp.asarray(repl), idx)
-        return obs_t, idx_t, tail_len, sched_t, stats_t
+        return obs_t, idx_t, tail_len, sched_t, stats_t, lin_t, op_t
 
     def do_shrink(new_w: int):
         """Shrink compaction, fully on device: permutation, split, and
@@ -1289,8 +1452,16 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         tail's observation handles, un-fetched. Guided sweeps split the
         per-slot schedule array with the state so the frozen tail keeps
         its schedule attribution."""
-        nonlocal state, idx, reordered, w_cur, slot_sched
-        if search_on:
+        nonlocal state, idx, reordered, w_cur, slot_sched, slot_lin
+        flin = None
+        if search_on and lineage_on:
+            ((state, idx, slot_sched, l1, l2, lo_, ld),
+             (frozen, fidx, fsched, f1, f2, fo, fd)) = \
+                _compactor(eng, mesh, w_cur, new_w, with_sched=True)(
+                    state, idx, slot_sched, *slot_lin)
+            slot_lin = type(slot_lin)(l1, l2, lo_, ld)
+            flin = (f1, f2, fo, fd)
+        elif search_on:
             (state, idx, slot_sched), (frozen, fidx, fsched) = \
                 _compactor(eng, mesh, w_cur, new_w, with_sched=True)(
                     state, idx, slot_sched)
@@ -1302,7 +1473,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         tail_len = w_cur - new_w
         w_cur = new_w
         obs_t, idx_t = _observer(eng)(frozen, fidx)
-        return obs_t, idx_t, tail_len, fsched, None
+        return obs_t, idx_t, tail_len, fsched, None, flin, None
 
     def ckpt_aux(cov_pair):
         """Sweep-level aux for a recycled checkpoint, captured at submit
@@ -1334,8 +1505,22 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             aux["srch_c_filled"] = corpus.filled
             aux["srch_c_gen"] = corpus.gen
             aux["srch_c_inserted"] = corpus.inserted
+            aux["srch_c_entry"] = corpus.entry
+            aux["srch_c_depth"] = corpus.depth
             if retired_sched:
                 aux["srch_ret"] = list(retired_sched)
+            if lineage_on:
+                # Provenance lanes + outcome table (obs/lineage.py):
+                # same epoch-gated consistency argument as slot_sched.
+                for k, v in zip(("p1", "p2", "ops", "depth"), slot_lin):
+                    aux[f"srch_lin_{k}"] = v
+                for k, v in zip(("produced", "novel", "survived"),
+                                op_tab):
+                    aux[f"srch_op_{k}"] = v
+                if retired_lin:
+                    for i, k in enumerate(("p1", "p2", "ops", "depth")):
+                        aux[f"srch_ret_lin_{k}"] = [t[i]
+                                                    for t in retired_lin]
         return aux
 
     try:
@@ -1604,14 +1789,18 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             state, cov_hits, cov_first, idx, n_real_dev, jnp.asarray(True))
 
     obs_live = eng.observe(state)
-    sched_live_h = corpus_h = None
+    sched_live_h = corpus_h = lin_live_h = op_tab_h = None
     if cov_on and search_on:
         # Search state rides the final ledger pull — still ONE _fetch.
-        idx_h, cov_hits_h, cov_first_h, sched_live_h, corpus_h = _fetch(
-            (idx, cov_hits, cov_first, slot_sched, corpus))
+        (idx_h, cov_hits_h, cov_first_h, sched_live_h, corpus_h,
+         lin_live_h, op_tab_h) = _fetch(
+            (idx, cov_hits, cov_first, slot_sched, corpus, slot_lin,
+             op_tab))
         idx_h, cov_hits_h, cov_first_h = (
             np.asarray(x) for x in (idx_h, cov_hits_h, cov_first_h))
         sched_live_h = np.asarray(sched_live_h, np.int32)
+        if lin_live_h is not None:
+            lin_live_h = tuple(np.asarray(a) for a in lin_live_h)
     elif cov_on:
         # The ledger rides the final slot-index pull — still ONE _fetch.
         idx_h, cov_hits_h, cov_first_h = (
@@ -1624,7 +1813,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     # seed order — after any reorder/retirement, OR when a recycled sweep
     # exited (stop_on_first_bug / max_steps) before its first refill, so
     # only the first w0 < n_ids seeds were ever admitted.
-    sched_per_seed = None
+    sched_per_seed = lin_per_seed = None
     if reordered or retired_rows or w0 < n_ids:
         rows = np.concatenate(retired_rows + [idx_h[live_keep]])
         obs = {}
@@ -1645,13 +1834,31 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             sched_out[:, :, 1:] = 0  # canonical DISABLED_ROW padding
             sched_out[rows] = merged_s
             sched_per_seed = sched_out
+        if lin_live_h is not None:
+            # Per-seed lineage lanes scatter exactly like the
+            # schedules; never-admitted seeds read as generation 0
+            # (-1 parents, no operators, depth 0).
+            lanes_out = []
+            for i, dflt in enumerate((-1, -1, 0, 0)):
+                merged_l = np.concatenate(
+                    [t[i] for t in retired_lin]
+                    + [lin_live_h[i][live_keep]], axis=0)
+                out = np.full((n_ids,), dflt, np.int32)
+                out[rows] = np.asarray(merged_l, np.int32)
+                lanes_out.append(out)
+            lin_per_seed = tuple(lanes_out)
     else:
         obs = obs_live
         if search_on:
             sched_per_seed = sched_live_h
+        if lin_live_h is not None:
+            lin_per_seed = tuple(np.asarray(a, np.int32)
+                                 for a in lin_live_h)
     obs = {k: v[:n] for k, v in obs.items()}
     if sched_per_seed is not None:
         sched_per_seed = sched_per_seed[:n]
+    if lin_per_seed is not None:
+        lin_per_seed = tuple(a[:n] for a in lin_per_seed)
     util = (live_world_steps / issued_slot_steps if issued_slot_steps
             else 0.0)
     loop_stats = {
@@ -1680,6 +1887,27 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     if search_on:
         from ..search import SearchReport
 
+        lineage_rep = op_stats = None
+        if lin_per_seed is not None:
+            from ..obs.lineage import (
+                N_OPS,
+                SearchLineage,
+                host_credit,
+                operator_stats,
+            )
+
+            lineage_rep = SearchLineage(
+                parent1=lin_per_seed[0], parent2=lin_per_seed[1],
+                ops=lin_per_seed[2], depth=lin_per_seed[3],
+                entry_base=int(search_lin_base))
+            # Bug credit folds HOST-side over the per-seed lanes: a find
+            # that halted the sweep (or sat live at exit) never crossed
+            # a harvest edge, so only this fold counts every find
+            # exactly once (obs/lineage.py OperatorTable).
+            op_bug = host_credit(np.zeros(N_OPS, np.int32),
+                                 lineage_rep.ops,
+                                 np.asarray(obs["bug"], bool))
+            op_stats = operator_stats(*(tuple(op_tab_h) + (op_bug,)))
         c_filled = np.asarray(corpus_h.filled, bool)
         search_report = SearchReport(
             # Generations THIS sweep ran: the epoch stream offset
@@ -1693,6 +1921,10 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             corpus_score=np.asarray(corpus_h.score, np.int32),
             corpus_filled=c_filled,
             schedules=sched_per_seed,
+            corpus_entry=np.asarray(corpus_h.entry, np.int32),
+            corpus_depth=np.asarray(corpus_h.depth, np.int32),
+            lineage=lineage_rep,
+            operator_stats=op_stats,
         )
         # Triage sees the MATERIALIZED per-seed schedules: a guided
         # find's minimize/triage path re-executes the child schedule
@@ -1727,6 +1959,18 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             final["coverage"] = coverage.to_json()
         if search_report is not None:
             final["search"] = search_report.to_json()
+            if search_report.lineage is not None and result.failing_seeds:
+                # The finds' full derivations ride the summary record
+                # (capped — a hunt's first few finds, not the seed
+                # space), so `python -m madsim_tpu.obs lineage
+                # <stream>` can render ancestry without the SweepResult.
+                from ..obs.lineage import lineage_block
+
+                rows = np.flatnonzero(np.asarray(result.bug))[:8]
+                final["search"]["finds"] = [
+                    lineage_block(search_report.lineage, int(r),
+                                  seeds=np.asarray(result.seeds))
+                    for r in rows]
         emit_telemetry(final)
     if close_telemetry is not None:
         close_telemetry()
@@ -1811,15 +2055,18 @@ def _sched_tail(eng: DeviceEngine, mesh: Mesh, w: int, rows: int):
     """Compile (and cache per engine) the frozen-tail schedule gather —
     the :func:`_tail_observer` twin for the guided sweep's per-slot
     ``(W, F, 4)`` schedule array, sharing its bucketed-``rows`` compile
-    bound and its clamp-and-slice contract."""
+    bound and its clamp-and-slice contract. Accepts any pytree of
+    ``(W, ...)`` arrays: with lineage on the sweep passes ``(sched,
+    *LineageLanes)`` so the provenance lanes ride the SAME gather
+    dispatch as the schedules."""
     cache = eng.__dict__.setdefault("_sched_tail_cache", {})
     key = (mesh, w, rows)
     if key in cache:
         return cache[key]
 
-    def tail(sched, start):
+    def tail(group, start):
         take = jnp.clip(start + jnp.arange(rows, dtype=jnp.int32), 0, w - 1)
-        return jnp.take(sched, take, axis=0)
+        return jax.tree.map(lambda x: jnp.take(x, take, axis=0), group)
 
     fn = jax.jit(tail)
     cache[key] = fn

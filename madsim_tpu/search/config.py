@@ -51,6 +51,15 @@ class SearchConfig:
     # no coverage feedback) that `bench.py guided_hunt` and
     # `make fuzz-demo` compare guided search against.
     guided: bool = True
+    # Provenance lanes + per-operator outcome accounting (obs/lineage.py,
+    # docs/search.md "Reading the lineage"): every installed child
+    # carries its parent corpus-entry ids, applied-operator bitmask and
+    # ancestry depth, and the generator accumulates the per-operator
+    # produced/novel/survived/bug table — all device-resident,
+    # write-only, synced on the cadence the sweep already pays. False
+    # compiles every lane out; lineage-on is bitwise identical to
+    # lineage-off on trajectories/schedules/corpus (tier-1-gated).
+    lineage: bool = True
 
     def __post_init__(self):
         if self.corpus < 1:

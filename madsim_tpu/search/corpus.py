@@ -45,6 +45,13 @@ class CorpusState(NamedTuple):
     ``gen`` counts refill generations (bumped once per guided refill —
     the generation half of the (seed, generation) child key);
     ``inserted`` counts total corpus inserts, for telemetry.
+
+    ``entry``/``depth`` are the provenance lanes of the evolution
+    observatory (obs/lineage.py): the globally-unique entry id each
+    slot's schedule was inserted under (``lin_base + seed position +
+    1``; the template is entry 0) and its ancestry depth at insert —
+    write-only accounting, never read by the insertion rule, so lineage
+    on/off cannot move a single corpus decision.
     """
 
     sched: jnp.ndarray     # (K, F, 4) i32 parent schedules
@@ -53,6 +60,8 @@ class CorpusState(NamedTuple):
     filled: jnp.ndarray    # (K,) bool
     gen: jnp.ndarray       # () i32 refill-generation counter
     inserted: jnp.ndarray  # () i32 total inserts
+    entry: jnp.ndarray     # (K,) i32 lineage entry id (-1 unfilled)
+    depth: jnp.ndarray     # (K,) i32 ancestry depth at insert
 
 
 def corpus_init(k: int, template: np.ndarray) -> CorpusState:
@@ -68,6 +77,8 @@ def corpus_init(k: int, template: np.ndarray) -> CorpusState:
     sched[0] = template
     filled = np.zeros((k,), bool)
     filled[0] = True
+    entry = np.full((k,), -1, np.int32)
+    entry[0] = 0                             # the template is entry 0
     return CorpusState(
         sched=jnp.asarray(sched),
         sig=jnp.zeros((k,), jnp.uint32),
@@ -75,6 +86,8 @@ def corpus_init(k: int, template: np.ndarray) -> CorpusState:
         filled=jnp.asarray(filled),
         gen=jnp.int32(0),
         inserted=jnp.int32(0),
+        entry=jnp.asarray(entry),
+        depth=jnp.zeros((k,), jnp.int32),
     )
 
 
@@ -100,7 +113,9 @@ def novelty(sig: jnp.ndarray, corpus: CorpusState) -> jnp.ndarray:
 
 def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
                  sigs: jnp.ndarray, fold_mask: jnp.ndarray,
-                 min_novelty: int) -> Tuple[CorpusState, jnp.ndarray]:
+                 min_novelty: int, entries: jnp.ndarray = None,
+                 depths: jnp.ndarray = None,
+                 with_masks: bool = False):
     """Fold the masked worlds' schedules into the corpus, sequentially.
 
     ``sched`` is the (W, F, 4) per-slot schedule array, ``sigs`` the
@@ -109,16 +124,30 @@ def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
     of inserts performed. Runs at the refill boundary — the same world-
     retirement edge the PR 6 coverage fold observes — where a retired
     slot's MetricsBlock is still frozen in place.
+
+    ``entries``/``depths`` (obs/lineage.py): the candidates' lineage
+    entry ids and ancestry depths, recorded on the corpus lanes at
+    insert. Defaults (-1 / 0) keep lineage-off sweeps and the host
+    parity tests total. Pure accounting — the insertion DECISION never
+    reads them, so the sched/sig/score/filled outcome is bit-identical
+    with or without lanes.
+
+    ``with_masks=True`` additionally returns the per-world ``(novel,
+    inserted)`` bool masks the operator outcome table credits from.
     """
     w = sigs.shape[0]
+    if entries is None:
+        entries = jnp.full((w,), -1, jnp.int32)
+    if depths is None:
+        depths = jnp.zeros((w,), jnp.int32)
 
     def body(j, carry):
-        c, n_ins = carry
+        c, n_ins, nov_m, ins_m = carry
         nov = novelty(sigs[j], c)
         key = jnp.where(c.filled, c.score, jnp.int32(-1))
         tgt = jnp.argmin(key).astype(jnp.int32)
-        do = fold_mask[j] & (nov >= jnp.int32(min_novelty)) \
-            & (nov > key[tgt])
+        novel_ok = fold_mask[j] & (nov >= jnp.int32(min_novelty))
+        do = novel_ok & (nov > key[tgt])
         c = CorpusState(
             sched=jnp.where(do, c.sched.at[tgt].set(sched[j]), c.sched),
             sig=jnp.where(do, c.sig.at[tgt].set(sigs[j]), c.sig),
@@ -126,10 +155,18 @@ def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
             filled=jnp.where(do, c.filled.at[tgt].set(True), c.filled),
             gen=c.gen,
             inserted=c.inserted + do.astype(jnp.int32),
+            entry=jnp.where(do, c.entry.at[tgt].set(entries[j]), c.entry),
+            depth=jnp.where(do, c.depth.at[tgt].set(depths[j]), c.depth),
         )
-        return c, n_ins + do.astype(jnp.int32)
+        return (c, n_ins + do.astype(jnp.int32),
+                nov_m.at[j].set(novel_ok), ins_m.at[j].set(do))
 
-    return jax.lax.fori_loop(0, w, body, (corpus, jnp.int32(0)))
+    corpus, n_ins, nov_m, ins_m = jax.lax.fori_loop(
+        0, w, body, (corpus, jnp.int32(0), jnp.zeros((w,), bool),
+                     jnp.zeros((w,), bool)))
+    if with_masks:
+        return corpus, n_ins, nov_m, ins_m
+    return corpus, n_ins
 
 
 # ---------------------------------------------------------------------------
@@ -147,14 +184,18 @@ def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
 # a tier-1 parity test (tests/test_exchange.py) holding them together.
 
 class HostCorpus(NamedTuple):
-    """Host-side corpus snapshot: the four exchanged arrays of a
+    """Host-side corpus snapshot: the exchanged arrays of a
     :class:`CorpusState` (the ``gen``/``inserted`` counters are per-sweep
-    telemetry and stay behind)."""
+    telemetry and stay behind). ``entry``/``depth`` are the lineage
+    lanes (obs/lineage.py), merged through the exchange verbatim so a
+    fleet-merged report can attribute finds across ranges."""
 
     sched: np.ndarray   # (K, F, 4) i32 parent schedules
     sig: np.ndarray     # (K,) u32 behavior signature at insert
     score: np.ndarray   # (K,) i32 novelty at insert
     filled: np.ndarray  # (K,) bool
+    entry: np.ndarray   # (K,) i32 lineage entry id (-1 unfilled)
+    depth: np.ndarray   # (K,) i32 ancestry depth at insert
 
 
 def host_corpus_init(k: int, template: np.ndarray) -> HostCorpus:
@@ -166,8 +207,11 @@ def host_corpus_init(k: int, template: np.ndarray) -> HostCorpus:
     sched[0] = template
     filled = np.zeros((k,), bool)
     filled[0] = True
+    entry = np.full((k,), -1, np.int32)
+    entry[0] = 0                             # the template is entry 0
     return HostCorpus(sched=sched, sig=np.zeros((k,), np.uint32),
-                      score=np.zeros((k,), np.int32), filled=filled)
+                      score=np.zeros((k,), np.int32), filled=filled,
+                      entry=entry, depth=np.zeros((k,), np.int32))
 
 
 def host_popcount32(x: int) -> int:
@@ -178,7 +222,9 @@ def host_popcount32(x: int) -> int:
 
 def host_harvest_fold(corpus: HostCorpus, sched: np.ndarray,
                       sigs: np.ndarray, fold_mask: np.ndarray,
-                      min_novelty: int) -> Tuple[HostCorpus, int]:
+                      min_novelty: int, entries: np.ndarray = None,
+                      depths: np.ndarray = None,
+                      with_masks: bool = False):
     """Bit-identical host twin of :func:`harvest_fold`.
 
     Folds the masked candidates sequentially (index order) into the
@@ -187,17 +233,30 @@ def host_harvest_fold(corpus: HostCorpus, sched: np.ndarray,
     slot is the argmin of ``where(filled, score, -1)`` with ties to the
     lowest index; insert iff masked, ``novelty >= min_novelty`` and
     strictly above the target's key. Returns the updated corpus and the
-    insert count. Parity with the device fold is tier-1-gated.
+    insert count (plus the per-candidate ``(novel, inserted)`` masks
+    under ``with_masks``, like the device fold). ``entries``/``depths``
+    are the candidates' lineage lanes, recorded at insert (defaults
+    -1 / 0, matching the device fold's). Parity with the device fold is
+    tier-1-gated.
     """
     c_sched = np.array(corpus.sched, np.int32, copy=True)
     c_sig = np.array(corpus.sig, np.uint32, copy=True)
     c_score = np.array(corpus.score, np.int32, copy=True)
     c_filled = np.array(corpus.filled, bool, copy=True)
+    c_entry = np.array(corpus.entry, np.int32, copy=True)
+    c_depth = np.array(corpus.depth, np.int32, copy=True)
     sched = np.asarray(sched, np.int32)
     sigs = np.asarray(sigs, np.uint32)
     fold_mask = np.asarray(fold_mask, bool)
+    w = sigs.shape[0]
+    entries = (np.full((w,), -1, np.int32) if entries is None
+               else np.asarray(entries, np.int32))
+    depths = (np.zeros((w,), np.int32) if depths is None
+              else np.asarray(depths, np.int32))
+    nov_m = np.zeros((w,), bool)
+    ins_m = np.zeros((w,), bool)
     n_ins = 0
-    for j in range(sigs.shape[0]):
+    for j in range(w):
         if c_filled.any():
             d = np.array([host_popcount32(int(sigs[j]) ^ int(s))
                           for s in c_sig], np.int32)
@@ -206,15 +265,21 @@ def host_harvest_fold(corpus: HostCorpus, sched: np.ndarray,
             nov = EMPTY_NOVELTY
         key = np.where(c_filled, c_score, np.int32(-1))
         tgt = int(np.argmin(key))            # first-min ties, like argmin
-        if bool(fold_mask[j]) and nov >= int(min_novelty) \
-                and nov > int(key[tgt]):
+        nov_m[j] = bool(fold_mask[j]) and nov >= int(min_novelty)
+        if nov_m[j] and nov > int(key[tgt]):
             c_sched[tgt] = sched[j]
             c_sig[tgt] = sigs[j]
             c_score[tgt] = nov
             c_filled[tgt] = True
+            c_entry[tgt] = entries[j]
+            c_depth[tgt] = depths[j]
+            ins_m[j] = True
             n_ins += 1
-    return HostCorpus(sched=c_sched, sig=c_sig, score=c_score,
-                      filled=c_filled), n_ins
+    out = HostCorpus(sched=c_sched, sig=c_sig, score=c_score,
+                     filled=c_filled, entry=c_entry, depth=c_depth)
+    if with_masks:
+        return out, n_ins, nov_m, ins_m
+    return out, n_ins
 
 
 def merge_corpus(acc: HostCorpus, src: HostCorpus,
@@ -226,11 +291,16 @@ def merge_corpus(acc: HostCorpus, src: HostCorpus,
     retiring tail, so the merged corpus of an epoch is a pure fold over
     (previous merged corpus, per-range snapshots in range-id order).
     Scores are RE-computed against the accumulator (an entry novel
-    within its own range may be redundant fleet-wide).
+    within its own range may be redundant fleet-wide); the lineage
+    lanes (entry id, depth) travel VERBATIM — an entry keeps its
+    origin-range identity, which is what lets the fleet-merged report
+    resolve cross-range ancestry (obs/lineage.py).
     """
     return host_harvest_fold(acc, np.asarray(src.sched, np.int32),
                              np.asarray(src.sig, np.uint32),
-                             np.asarray(src.filled, bool), min_novelty)
+                             np.asarray(src.filled, bool), min_novelty,
+                             entries=np.asarray(src.entry, np.int32),
+                             depths=np.asarray(src.depth, np.int32))
 
 
 def pick_filled(corpus: CorpusState, draws: jnp.ndarray) -> jnp.ndarray:

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from ..obs.coverage import behavior_signature
+from ..obs.lineage import LineageLanes, OperatorTable, credit, ops_bits
 from ..parallel.mesh import scalar_spec, world_sharding
 from .config import SearchConfig
 from .corpus import CorpusState, harvest_fold
@@ -45,7 +46,8 @@ from .mutate import make_children
 def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
     """Compile (and cache per engine) the harvest+generate program.
 
-    Signature: ``(state, sched, idx, corpus, n_act, new_ids) ->
+    Signature (``scfg.lineage=False``, the PR 11 shape):
+    ``(state, sched, idx, corpus, n_act, new_ids) ->
     (children, corpus', (n_filled, n_inserted))`` where ``state`` is the
     post-compaction batch (active-first), ``sched`` the (W, F, 4)
     per-slot schedule array permuted with it, ``idx`` the slot→seed
@@ -54,6 +56,22 @@ def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
     run. With ``scfg.guided=False`` the harvest is compiled out — the
     corpus stays at the seeded template and the children are the
     matched random-mutation baseline.
+
+    With ``scfg.lineage=True`` (default; obs/lineage.py) the program
+    widens to ``(state, sched, idx, corpus, n_act, new_ids, fill_mask,
+    lin, op_tab, lin_base) -> (children, child_lin, corpus', op_tab',
+    stats)``: the retiring tail's provenance lanes ``lin`` feed the
+    per-operator outcome credits (novel / survived at the harvest edge;
+    the ``bug`` outcome folds HOST-side from the per-seed lanes the
+    final fetch carries — see OperatorTable), installed children
+    (``fill_mask``) credit ``produced``, inserted entries record their
+    lineage entry id
+    (``lin_base + seed id + 1``) and depth on the corpus lanes, and
+    ``stats`` grows the per-refill scalars the search telemetry stream
+    emits — ``(n_filled, inserted_total, gen, refill_novel,
+    refill_inserted)``. Everything added is write-only accounting:
+    child bytes, corpus decisions, and the simulation are bit-identical
+    to ``lineage=False`` (tier-1-gated).
     """
     cache = eng.__dict__.setdefault("_searcher_cache", {})
     key = (mesh, w, f_rows, scfg)
@@ -61,24 +79,66 @@ def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
         return cache[key]
 
     rep = NamedSharding(mesh, scalar_spec())
+    ws = world_sharding(mesh)
+    corpus_sh = CorpusState(sched=rep, sig=rep, score=rep, filled=rep,
+                            gen=rep, inserted=rep, entry=rep, depth=rep)
 
-    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids):
+    if not scfg.lineage:
+        def run(state, sched, idx, corpus: CorpusState, n_act, new_ids):
+            if scfg.guided:
+                sigs = behavior_signature(state.metrics)      # (W,) u32
+                rows_r = jnp.arange(w, dtype=jnp.int32)
+                hmask = (rows_r >= n_act) & (idx >= 0) & ~state.active
+                corpus, _ = harvest_fold(corpus, sched, sigs, hmask,
+                                         scfg.min_novelty)
+            gen1 = corpus.gen + jnp.int32(1)
+            children = make_children(scfg, eng.cfg, corpus, new_ids, gen1)
+            corpus = corpus._replace(gen=gen1)
+            n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
+            return children, corpus, (n_filled, corpus.inserted)
+
+        out_sh = (ws, corpus_sh, (rep, rep))
+        fn = jax.jit(run, out_shardings=out_sh)
+        cache[key] = fn
+        return fn
+
+    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids,
+            fill_mask, lin: LineageLanes, op_tab: OperatorTable,
+            lin_base):
+        n_ins = jnp.int32(0)
+        nov_m = jnp.zeros((w,), bool)
         if scfg.guided:
             sigs = behavior_signature(state.metrics)          # (W,) u32
             rows_r = jnp.arange(w, dtype=jnp.int32)
             hmask = (rows_r >= n_act) & (idx >= 0) & ~state.active
-            corpus, _ = harvest_fold(corpus, sched, sigs, hmask,
-                                     scfg.min_novelty)
+            obits = ops_bits(lin.ops)            # (W, N_OPS) bool
+            # Lineage entry id of a retiring world: its (base-offset)
+            # seed position + 1 — globally unique across fleet ranges
+            # by construction (obs/lineage.py).
+            entries = jnp.where(idx >= 0, lin_base + idx + jnp.int32(1),
+                                jnp.int32(-1))
+            corpus, n_ins, nov_m, ins_m = harvest_fold(
+                corpus, sched, sigs, hmask, scfg.min_novelty,
+                entries=entries, depths=lin.depth, with_masks=True)
+            op_tab = op_tab._replace(
+                novel=credit(op_tab.novel, obits, nov_m),
+                survived=credit(op_tab.survived, obits, ins_m))
         gen1 = corpus.gen + jnp.int32(1)
-        children = make_children(scfg, eng.cfg, corpus, new_ids, gen1)
+        children, child_lin = make_children(scfg, eng.cfg, corpus,
+                                            new_ids, gen1, lineage=True)
+        op_tab = op_tab._replace(
+            produced=credit(op_tab.produced, ops_bits(child_lin.ops),
+                            fill_mask))
         corpus = corpus._replace(gen=gen1)
         n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
-        return children, corpus, (n_filled, corpus.inserted)
+        stats = (n_filled, corpus.inserted, corpus.gen,
+                 jnp.sum(nov_m, dtype=jnp.int32), n_ins)
+        return children, child_lin, corpus, op_tab, stats
 
-    out_sh = (world_sharding(mesh),
-              CorpusState(sched=rep, sig=rep, score=rep, filled=rep,
-                          gen=rep, inserted=rep),
-              (rep, rep))
+    out_sh = (ws, LineageLanes(p1=ws, p2=ws, ops=ws, depth=ws),
+              corpus_sh,
+              OperatorTable(produced=rep, novel=rep, survived=rep),
+              (rep, rep, rep, rep, rep))
     fn = jax.jit(run, out_shardings=out_sh)
     cache[key] = fn
     return fn

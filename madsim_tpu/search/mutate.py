@@ -90,7 +90,8 @@ def _flip_op(op: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_children(scfg: SearchConfig, ecfg, corpus: CorpusState,
-                  seed_ids: jnp.ndarray, generation) -> jnp.ndarray:
+                  seed_ids: jnp.ndarray, generation,
+                  lineage: bool = False):
     """Generate one child schedule per slot: ``(W, F, 4)`` i32.
 
     ``seed_ids`` is the (W,) i32 vector of the seed ids the refilled
@@ -100,6 +101,14 @@ def make_children(scfg: SearchConfig, ecfg, corpus: CorpusState,
     corpus contents: bitwise reproducible, replayable, and identical
     between the serial and pipelined sweep loops (which call this at
     identical refill points).
+
+    ``lineage=True`` (obs/lineage.py) additionally returns each child's
+    :class:`~madsim_tpu.obs.lineage.LineageLanes`: the two tournament
+    parents' corpus ENTRY ids, the applied-operator bitmask folded from
+    the per-row masks this function already computes (exposed, never
+    recomputed — no extra draw, no changed draw order, so child BYTES
+    are identical either way), and the ancestry depth ``1 +
+    max(parent depths)``.
     """
     f_rows = corpus.sched.shape[1]
     n = int(ecfg.n_nodes)
@@ -127,8 +136,8 @@ def make_children(scfg: SearchConfig, ecfg, corpus: CorpusState,
     other = corpus.sched[p2]
 
     # Two-parent splice, per row.
-    row = jnp.where((pct(r_splice) < _i32(scfg.splice_pct))[..., None],
-                    other, base)
+    do_splice = pct(r_splice) < _i32(scfg.splice_pct)
+    row = jnp.where(do_splice[..., None], other, base)
     t, op, a, b = (row[..., k] for k in range(4))
     enabled = t >= 0
 
@@ -173,5 +182,19 @@ def make_children(scfg: SearchConfig, ecfg, corpus: CorpusState,
     # schedule identity is bitwise no matter which operator disabled a
     # row.
     disabled = child[..., 0] < 0
-    return jnp.where(disabled[..., None],
-                     jnp.asarray([-1, 0, 0, 0], jnp.int32), child)
+    child = jnp.where(disabled[..., None],
+                      jnp.asarray([-1, 0, 0, 0], jnp.int32), child)
+    if not lineage:
+        return child
+    # Provenance lanes (obs/lineage.py): the per-row operator masks
+    # computed above, OR-folded to one bit per operator class, the two
+    # tournament parents' corpus entry ids, and the ancestry depth.
+    # Write-only — nothing below feeds back into the child bytes.
+    from ..obs.lineage import LineageLanes, pack_ops
+
+    ops = pack_ops([jnp.any(m, axis=-1) for m in
+                    (do_splice, do_dis, do_time, do_node, do_op)])
+    d1, d2 = corpus.depth[p1], corpus.depth[p2]
+    return child, LineageLanes(
+        p1=corpus.entry[p1], p2=corpus.entry[p2], ops=ops,
+        depth=jnp.int32(1) + jnp.maximum(d1, d2))

@@ -215,10 +215,30 @@ def triage(result, out_dir: Optional[str] = None, *,
             error=(f"invariant violation: {fc.invariant_id} "
                    f"(failure class {fc.key})"),
             minimization=(mr.provenance() if mr is not None else None),
+            lineage=_class_lineage(result, fc),
             extra={"failure_class": fc.key, "n_seeds": fc.count,
                    "seeds_sample": [int(s) for s in fc.seeds[:16]]})
     return TriageReport(classes=classes, minimized=minimized,
                         bundles=bundles)
+
+
+def _class_lineage(result, fc: FailureClass) -> Optional[Dict[str, Any]]:
+    """The ``madsim.search.lineage/1`` provenance block for a guided
+    find (obs/lineage.py): the representative's ancestry chain plus the
+    hunt's operator outcome table, so a minimized bundle documents its
+    own derivation. None on non-guided sweeps or lineage-off hunts."""
+    from ..obs.lineage import lineage_block
+
+    rep = getattr(result, "search", None)
+    lin = getattr(rep, "lineage", None) if rep is not None else None
+    if lin is None:
+        return None
+    rows = np.flatnonzero(
+        np.asarray(result.seeds) == np.uint64(fc.representative))
+    if rows.size == 0:
+        return None
+    return lineage_block(lin, int(rows[0]), seeds=np.asarray(result.seeds),
+                         stats=rep.operator_stats)
 
 
 def _class_schedule(result, fc: FailureClass) -> Optional[np.ndarray]:

@@ -106,9 +106,20 @@ def assert_bitwise(a, b, search=True):
             np.testing.assert_array_equal(a.search.schedules,
                                           b.search.schedules)
             for f in ("corpus_sched", "corpus_sig", "corpus_score",
-                      "corpus_filled"):
+                      "corpus_filled", "corpus_entry", "corpus_depth"):
                 np.testing.assert_array_equal(
                     getattr(a.search, f), getattr(b.search, f), err_msg=f)
+            # The lineage surface (obs/lineage.py) is chaos-invariant
+            # too: ancestry attribution and operator accounting must
+            # not depend on kills, duplicates, or torn publishes.
+            la, lb = a.search.lineage, b.search.lineage
+            assert (la is None) == (lb is None)
+            if la is not None:
+                for f in ("parent1", "parent2", "ops", "depth"):
+                    np.testing.assert_array_equal(
+                        getattr(la, f), getattr(lb, f),
+                        err_msg=f"lineage.{f}")
+                assert a.search.operator_stats == b.search.operator_stats
 
 
 # ---------------------------------------------------------------------------
@@ -133,15 +144,26 @@ def test_host_fold_parity_with_device(hunt):
         sigs = rng.randint(0, 2**32, size=(w,),
                            dtype=np.uint64).astype(np.uint32)
         mask = rng.rand(w) < 0.7
+        entries = rng.randint(1, 500, size=(w,)).astype(np.int32)
+        depths = rng.randint(0, 9, size=(w,)).astype(np.int32)
         dev = corpus_init(k, tmpl)
         host = host_corpus_init(k, tmpl)
         for _round in range(2):  # fold twice: non-fresh corpus state too
-            dev, nd = harvest_fold(dev, jnp.asarray(sched),
-                                   jnp.asarray(sigs), jnp.asarray(mask),
-                                   mn)
-            host, nh = host_harvest_fold(host, sched, sigs, mask, mn)
+            dev, nd, dnov, dins = harvest_fold(
+                dev, jnp.asarray(sched), jnp.asarray(sigs),
+                jnp.asarray(mask), mn, entries=jnp.asarray(entries),
+                depths=jnp.asarray(depths), with_masks=True)
+            host, nh, hnov, hins = host_harvest_fold(
+                host, sched, sigs, mask, mn, entries=entries,
+                depths=depths, with_masks=True)
             assert int(nd) == nh
-            for name in ("sched", "sig", "score", "filled"):
+            # The outcome-fold masks the operator table credits from
+            # (obs/lineage.py) must agree too — the host/device
+            # outcome-fold parity half of the PR 13 contract.
+            np.testing.assert_array_equal(np.asarray(dnov), hnov)
+            np.testing.assert_array_equal(np.asarray(dins), hins)
+            for name in ("sched", "sig", "score", "filled", "entry",
+                         "depth"):
                 np.testing.assert_array_equal(
                     np.asarray(getattr(dev, name)),
                     np.asarray(getattr(host, name)),
@@ -150,7 +172,7 @@ def test_host_fold_parity_with_device(hunt):
                                dtype=np.uint64).astype(np.uint32)
     # Host init matches the device init arrays (the epoch-0 seed).
     d0, h0 = corpus_init(4, tmpl), host_corpus_init(4, tmpl)
-    for name in ("sched", "sig", "score", "filled"):
+    for name in ("sched", "sig", "score", "filled", "entry", "depth"):
         np.testing.assert_array_equal(np.asarray(getattr(d0, name)),
                                       np.asarray(getattr(h0, name)))
 
@@ -210,7 +232,8 @@ def test_duplicate_publish_dedupe_tamper_and_torn():
     assert ex.stats["publishes_duplicate"] == 1
     # Tampered duplicate: the determinism contract is broken — loud.
     bad = HostCorpus(sched=snap.sched.copy(), sig=snap.sig.copy(),
-                     score=snap.score.copy(), filled=snap.filled.copy())
+                     score=snap.score.copy(), filled=snap.filled.copy(),
+                     entry=snap.entry.copy(), depth=snap.depth.copy())
     bad.sig[0] ^= np.uint32(1)
     with pytest.raises(FleetIntegrityError, match="bitwise"):
         ex.publish(0, corpus_payload(bad))

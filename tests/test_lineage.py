@@ -1,0 +1,415 @@
+"""The evolution observatory (madsim_tpu/obs/lineage.py, PR 13).
+
+The contract (docs/search.md "Reading the lineage"):
+
+- lineage-on is BITWISE identical to lineage-off on everything the
+  simulation produces — trajectories, observations, materialized
+  schedules, the corpus decision surface (the pinned fuzz-demo numbers
+  ride on this: mutation bytes are sacred per the PR 11 retune rule);
+- zero added host syncs: the provenance lanes and the operator outcome
+  table ride the retire pulls and the final fetch the guided loop
+  already pays (counted through the ``_fetch`` hook);
+- checkpoint→resume restores the lanes, the corpus lineage lanes, and
+  the outcome table bit-exactly (PR 7 aux channel); lineage on/off
+  checkpoint mixups are refused loudly;
+- ancestry chains reconstruct host-side from parent entry ids down to
+  the generation-0 template, across fleet ranges in a merged report;
+- the device outcome fold equals the host twin (host_credit /
+  host_harvest_fold masks — parity also gated in tests/test_exchange);
+- the surfaces exist: SearchReport.lineage/operator_stats/summary(),
+  SweepResult.summary() mentions the hunt, the
+  ``madsim.search.telemetry/1`` stream renders in ``obs watch`` and the
+  per-schema Prometheus snapshot, and triage bundles carry a
+  ``madsim.search.lineage/1`` block the ``obs lineage`` CLI renders.
+
+Compile budget: one module-scoped family engine at the same
+(batch_worlds=32, chunk_steps=32) shapes as tests/test_search.py.
+"""
+import dataclasses as dc
+import importlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import DeviceEngine
+from madsim_tpu.engine.checkpoint import CheckpointError
+from madsim_tpu.obs import lineage as L
+from madsim_tpu.search import (
+    GuidedPairActor,
+    GuidedPairConfig,
+    engine_config,
+    family_schedule,
+)
+from madsim_tpu.search.family import HUNT_NODES, HUNT_ROWS, hunt_search_config
+
+sweep_mod = importlib.import_module("madsim_tpu.parallel.sweep")
+sweep = sweep_mod.sweep
+
+BATCH = dict(recycle=True, batch_worlds=32, chunk_steps=32)
+
+
+@pytest.fixture(scope="module")
+def hunt():
+    acfg = GuidedPairConfig(n=HUNT_NODES)
+    cfg = engine_config(acfg)
+    eng = DeviceEngine(GuidedPairActor(acfg), cfg)
+    return eng, cfg, family_schedule(HUNT_ROWS, acfg)
+
+
+def _guided(eng, cfg, tmpl, n_seeds, lineage=True, guided=True,
+            max_steps=10_000_000, **kw):
+    scfg = dc.replace(hunt_search_config(guided), lineage=lineage)
+    return sweep(None, cfg, np.arange(n_seeds), engine=eng, faults=tmpl,
+                 max_steps=max_steps, search=scfg, **BATCH, **kw)
+
+
+@pytest.fixture(scope="module")
+def find(hunt):
+    """One guided stop-on-first-bug hunt with lineage on — shared by
+    every test that only READS the report."""
+    eng, cfg, tmpl = hunt
+    return _guided(eng, cfg, tmpl, 128, stop_on_first_bug=True)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise invisibility: lineage on == lineage off
+# ---------------------------------------------------------------------------
+
+def test_lineage_on_equals_off_bitwise(hunt, find):
+    """The accounting must be write-only: same trajectories, same
+    materialized schedules, same corpus decisions — the masks are the
+    generator's existing intermediates, exposed not recomputed."""
+    eng, cfg, tmpl = hunt
+    off = _guided(eng, cfg, tmpl, 128, lineage=False,
+                  stop_on_first_bug=True)
+    on = find
+    assert on.failing_seeds, "the guided hunt must reach the bug"
+    assert (on.bug == off.bug).all()
+    for k in on.observations:
+        np.testing.assert_array_equal(np.asarray(on.observations[k]),
+                                      np.asarray(off.observations[k]),
+                                      err_msg=k)
+    np.testing.assert_array_equal(on.search.schedules,
+                                  off.search.schedules)
+    for f in ("corpus_sched", "corpus_sig", "corpus_score",
+              "corpus_filled"):
+        np.testing.assert_array_equal(getattr(on.search, f),
+                                      getattr(off.search, f), err_msg=f)
+    assert on.search.generations == off.search.generations
+    assert on.search.inserted == off.search.inserted
+    np.testing.assert_array_equal(on.coverage.hits, off.coverage.hits)
+    # Only the observability surface differs.
+    assert on.search.lineage is not None
+    assert on.search.operator_stats is not None
+    assert off.search.lineage is None
+    assert off.search.operator_stats is None
+
+
+# ---------------------------------------------------------------------------
+# The report surface: ancestry, outcome identities, summaries
+# ---------------------------------------------------------------------------
+
+def test_find_ancestry_reaches_template_with_operators(find):
+    rep = find.search
+    s0 = find.failing_seeds[0]
+    chain = rep.ancestry(s0, seeds=find.seeds)
+    assert chain[0]["seed"] == s0
+    assert chain[-1]["kind"] == "template"
+    # Depths strictly decrease along the chain's world nodes.
+    depths = [n["depth"] for n in chain if n["kind"] == "world"]
+    assert depths == sorted(depths, reverse=True)
+    assert depths[0] == rep.lineage.depth[int(s0)]
+    # The pair bug is unreachable without mutation: operators named.
+    assert {op for n in chain for op in n.get("ops", [])}
+    # Rendering covers every hop.
+    text = L.render_tree(chain)
+    assert "template (entry 0" in text
+    assert f"seed {s0}" in text
+
+
+def test_operator_outcome_identities(find):
+    """Structural identities of the outcome table: every survivor was
+    novel, every credited retiring world was an installed child, and
+    the host-side bug fold credits the find's operators."""
+    st = find.search.operator_stats
+    assert set(st) == set(L.OP_NAMES)
+    assert sum(r["produced"] for r in st.values()) > 0
+    for name, row in st.items():
+        assert 0 <= row["survived"] <= row["novel"], (name, row)
+        assert row["survived"] <= row["produced"], (name, row)
+        assert row["bug"] <= row["produced"], (name, row)
+    # The find carried at least one operator — its bits got bug credit.
+    s0 = find.failing_seeds[0]
+    ops = L.op_names(int(find.search.lineage.ops[int(s0)]))
+    assert ops and all(st[o]["bug"] >= 1 for o in ops)
+    # summary() renders the effectiveness table.
+    text = find.search.summary()
+    assert "top operator" in text and "survived" in text
+
+
+def test_sweep_summary_and_banner_mention_the_hunt(find):
+    text = find.summary()
+    assert "guided search: corpus" in text
+    assert "top operator" in text
+    assert "guided search" in find.repro_banner()
+
+
+# ---------------------------------------------------------------------------
+# Sync discipline: zero added host pulls
+# ---------------------------------------------------------------------------
+
+def test_lineage_adds_zero_host_syncs(hunt, monkeypatch):
+    eng, cfg, tmpl = hunt
+    calls = []
+    real_fetch = sweep_mod._fetch
+
+    def counting_fetch(tree):
+        calls.append(1)
+        return real_fetch(tree)
+
+    monkeypatch.setattr(sweep_mod, "_fetch", counting_fetch)
+    res = _guided(eng, cfg, tmpl, 96)
+    st = res.loop_stats
+    assert st["retire_fetches"] >= 1
+    assert len(calls) == st["scalar_fetches"] + st["retire_fetches"] + 1
+    assert res.search.lineage is not None
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint → resume: lanes + outcome table bit-exact
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_resume_restores_lineage_bit_exact(hunt, tmp_path):
+    eng, cfg, tmpl = hunt
+    unbroken = _guided(eng, cfg, tmpl, 96)
+    path = str(tmp_path / "lin.npz")
+    _part = _guided(eng, cfg, tmpl, 96, max_steps=64 * 32,
+                    checkpoint_path=path, checkpoint_every_chunks=4)
+    full = _guided(eng, cfg, tmpl, 96, checkpoint_path=path, resume=True)
+    for f in ("parent1", "parent2", "ops", "depth"):
+        np.testing.assert_array_equal(
+            getattr(unbroken.search.lineage, f),
+            getattr(full.search.lineage, f), err_msg=f)
+    assert unbroken.search.operator_stats == full.search.operator_stats
+    np.testing.assert_array_equal(unbroken.search.corpus_entry,
+                                  full.search.corpus_entry)
+    np.testing.assert_array_equal(unbroken.search.corpus_depth,
+                                  full.search.corpus_depth)
+    # Lineage on/off mixups are refused with a pointed error.
+    with pytest.raises(CheckpointError, match="lineage"):
+        _guided(eng, cfg, tmpl, 96, lineage=False, checkpoint_path=path,
+                resume=True)
+
+
+# ---------------------------------------------------------------------------
+# Host/device outcome-fold parity (the credit twin)
+# ---------------------------------------------------------------------------
+
+def test_host_credit_matches_device_credit():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    for _ in range(8):
+        w = int(rng.randint(1, 40))
+        ops = rng.randint(0, 32, size=(w,)).astype(np.int8)
+        mask = rng.rand(w) < 0.5
+        base = rng.randint(0, 100, size=(L.N_OPS,)).astype(np.int32)
+        dev = L.credit(jnp.asarray(base), L.ops_bits(jnp.asarray(ops)),
+                       jnp.asarray(mask))
+        host = L.host_credit(base, ops, mask)
+        np.testing.assert_array_equal(np.asarray(dev), host)
+
+
+def test_lineage_lane_unit_helpers():
+    import jax.numpy as jnp
+
+    # pack/unpack round-trip over all 32 masks.
+    masks = np.arange(32, dtype=np.int32)
+    bits = L.host_ops_bits(masks)
+    packed = L.pack_ops([jnp.asarray(bits[:, i]) for i in range(L.N_OPS)])
+    assert packed.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(packed), masks.astype(np.int8))
+    np.testing.assert_array_equal(
+        np.asarray(L.ops_bits(jnp.asarray(masks.astype(np.int8)))), bits)
+    assert L.op_names(0b10001) == ["splice", "op_flip"]
+    # Origin lanes: generation 0, no parents, depth 0.
+    lanes = L.lanes_origin(4)
+    assert (np.asarray(lanes.p1) == L.NO_PARENT).all()
+    assert (np.asarray(lanes.depth) == 0).all()
+
+
+def test_ancestry_unit_resolution_and_externals():
+    # Hand-built per-seed table: 0 = gen-0 world; 1 = child of entry 1
+    # (seed 0); 2 = child of entry 99 (external/exchange-seeded).
+    lin = L.SearchLineage(
+        parent1=np.asarray([-1, 1, 99], np.int32),
+        parent2=np.asarray([-1, 1, 99], np.int32),
+        ops=np.asarray([0, 0b01000, 0b00100], np.int32),
+        depth=np.asarray([0, 1, 7], np.int32))
+    chain = L.ancestry(lin, 1)
+    assert [n["kind"] for n in chain] == ["world", "world", "template"]
+    assert chain[0]["ops"] == ["node_rotate"]
+    ext = L.ancestry(lin, 2)
+    assert ext[-1]["kind"] == "external" and ext[-1]["entry"] == 99
+    assert "external entry 99" in L.render_tree(ext)
+    # entry_base arithmetic: a range at lo=48 resolves 48-based entries.
+    lin48 = L.SearchLineage(parent1=np.asarray([-1, 50], np.int32),
+                            parent2=np.asarray([-1, 50], np.int32),
+                            ops=np.zeros(2, np.int32),
+                            depth=np.asarray([0, 1], np.int32),
+                            entry_base=48)
+    assert lin48.resolve(50) == 1
+    assert lin48.resolve(3) is None       # another range's entry
+    assert lin48.resolve(L.TEMPLATE_ENTRY) is None
+
+
+def test_merge_operator_stats_and_top():
+    a = L.operator_stats(np.asarray([4, 0, 0, 0, 0]),
+                         np.asarray([2, 0, 0, 0, 0]),
+                         np.asarray([1, 0, 0, 0, 0]),
+                         np.asarray([0, 0, 0, 0, 0]))
+    b = L.operator_stats(np.asarray([4, 0, 8, 0, 0]),
+                         np.asarray([2, 0, 6, 0, 0]),
+                         np.asarray([1, 0, 4, 0, 0]),
+                         np.asarray([1, 0, 0, 0, 0]))
+    merged = L.merge_operator_stats([a, b])
+    assert merged["splice"]["produced"] == 8
+    assert merged["splice"]["survived"] == 2
+    assert merged["splice"]["survival_pct"] == 25.0
+    assert L.top_operator(merged) == "time_jitter"
+    assert L.top_operator(None) is None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry stream + Prometheus per-schema counters (satellite)
+# ---------------------------------------------------------------------------
+
+def test_search_telemetry_stream_watch_and_prom(hunt, tmp_path):
+    from madsim_tpu.obs import observatory
+
+    eng, cfg, tmpl = hunt
+    stream = str(tmp_path / "tele.jsonl")
+    res = _guided(eng, cfg, tmpl, 128, stop_on_first_bug=True,
+                  observe=stream)
+    recs = [json.loads(ln) for ln in open(stream) if ln.strip()]
+    srch = [r for r in recs
+            if r.get("schema") == "madsim.search.telemetry/1"]
+    assert len(srch) == res.loop_stats["retire_fetches"]
+    need = {"event", "generation", "corpus_size", "corpus_inserted",
+            "refill_novel", "refill_inserted", "op_produced_splice",
+            "op_survived_node_rotate"}
+    assert all(need <= set(r) for r in srch), srch[0]
+    summ = next(r for r in recs if r.get("event") == "summary")
+    assert summ["search"]["operator_stats"]
+    assert summ["search"]["finds"][0]["schema"] == L.LINEAGE_SCHEMA
+    # watch renders the search schema in follow and summary modes.
+    buf = io.StringIO()
+    assert observatory.watch(stream, follow=True, interval=0.01,
+                             out=buf) == 0
+    tail = buf.getvalue()
+    assert "[search]" in tail and "corpus=" in tail
+    buf = io.StringIO()
+    assert observatory.watch(stream, out=buf) == 0
+    assert "search:" in buf.getvalue()
+    # The Prometheus snapshot carries per-schema counters + both gauge
+    # families (the satellite: fleet/search activity must not vanish
+    # behind the newest record).
+    text = observatory.prometheus_snapshot(recs)
+    assert "madsim_records_sweep" in text
+    assert "madsim_records_search" in text
+    assert "madsim_sweep_seeds_total" in text
+    assert "madsim_search_corpus_size" in text
+
+
+def test_prometheus_snapshot_counts_fleet_and_exchange_schemas():
+    from madsim_tpu.obs import observatory
+
+    recs = [
+        {"schema": "madsim.sweep.telemetry/1", "n_active": 3,
+         "seeds_total": 8},
+        {"schema": "madsim.fleet.telemetry/1", "event": "lease_issued"},
+        {"schema": "madsim.fleet.telemetry/1", "event": "lease_issued"},
+        {"schema": "madsim.fleet.exchange/1", "event": "publish"},
+        {"schema": "madsim.search.telemetry/1", "event": "refill",
+         "corpus_size": 2},
+    ]
+    text = observatory.prometheus_snapshot(recs)
+    assert "madsim_records_fleet 2" in text
+    assert "madsim_records_exchange 1" in text
+    assert "madsim_fleet_events_lease_issued 2" in text
+    assert "madsim_exchange_events_publish 1" in text
+    assert "madsim_sweep_n_active 3" in text
+    assert "madsim_search_corpus_size 2" in text
+
+
+# ---------------------------------------------------------------------------
+# Bundles + the `obs lineage` CLI
+# ---------------------------------------------------------------------------
+
+def test_triage_bundle_carries_lineage_and_cli_renders(find, tmp_path,
+                                                       capsys):
+    from madsim_tpu.obs.cli import main as obs_main
+    from madsim_tpu.triage import triage
+
+    report = triage(find, out_dir=str(tmp_path), chunk_steps=32,
+                    max_steps=20_000)
+    bundle_path = list(report.bundles.values())[0]
+    bundle = json.load(open(bundle_path))
+    block = bundle["lineage"]
+    assert block["schema"] == L.LINEAGE_SCHEMA
+    assert block["seed"] == find.failing_seeds[0]
+    assert block["operators_applied"]
+    assert block["chain"][-1]["kind"] == "template"
+    assert set(block["operator_stats"]) == set(L.OP_NAMES)
+    # The CLI renders the tree + the outcome table, exit 0.
+    assert obs_main(["lineage", bundle_path]) == 0
+    out = capsys.readouterr().out
+    assert "template (entry 0" in out
+    assert "operator" in out and "survived" in out
+    # A lineage-free file exits 2 with a pointed message.
+    plain = tmp_path / "plain.json"
+    plain.write_text(json.dumps({"version": 1, "kind": "host_test"}))
+    assert obs_main(["lineage", str(plain)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Fleet: merged reports resolve ancestry across ranges
+# ---------------------------------------------------------------------------
+
+def test_fleet_merged_lineage_resolves_across_ranges(hunt):
+    """Each range writes entry ids at base range.lo, so the merged
+    per-seed table resolves any parent with entry-1 arithmetic — and
+    an exchanged fleet's later epochs may point at earlier ranges'
+    entries (cross-range attribution, the PR 13 fleet satellite)."""
+    from madsim_tpu.fleet import ExchangeConfig, fleet_sweep
+
+    eng, cfg, tmpl = hunt
+    res = fleet_sweep(None, cfg, np.arange(96), engine=eng, faults=tmpl,
+                      n_workers=2, range_size=48, max_steps=10_000_000,
+                      search=hunt_search_config(True),
+                      exchange=ExchangeConfig(every=1), **BATCH)
+    lin = res.search.lineage
+    assert lin is not None and lin.entry_base == 0
+    assert lin.parent1.shape == (96,)
+    # Range-1 children (rows 48+) carry parents; every in-fleet parent
+    # entry resolves to a real seed position.
+    p = lin.parent1[48:]
+    real = p[p > 0]
+    assert real.size, "epoch-1 ranges generated no children?"
+    for e in real:
+        pos = lin.resolve(int(e))
+        assert pos is None or 0 <= pos < 96
+    # At least one range-1 world descends from a range-0 entry (the
+    # exchange seeded epoch 1 from epoch 0's merged corpus).
+    assert any(lin.resolve(int(e)) is not None and lin.resolve(int(e)) < 48
+               for e in real), \
+        "no cross-range ancestry: exchange lineage is not merging"
+    # Ancestry from a range-1 world chains through without error.
+    pos = 48 + int(np.flatnonzero(p > 0)[0])
+    chain = res.search.ancestry(pos)
+    assert chain[-1]["kind"] in ("template", "external")
+    # The merged operator table sums the ranges'.
+    assert sum(r["produced"]
+               for r in res.search.operator_stats.values()) > 0

@@ -108,6 +108,17 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.guided_hunt.raft.random_bugs_found", False),
     ("guided raft novelty area",
      "configs.guided_hunt.raft.guided_novelty_area", True),
+    # Evolution observatory (obs/lineage.py, PR 13): ancestry depth of
+    # the guided pair hunt and the corpus-survival credit of the
+    # node-rotation operator (the one the pair bug NEEDS) — the
+    # operator-credit signals a future adaptive scheduler will feed on.
+    ("guided pair lineage depth",
+     "configs.guided_hunt.pair.guided_lineage_depth", True),
+    ("guided pair node_rotate survived",
+     "configs.guided_hunt.pair.guided_operator_stats.node_rotate.survived",
+     True),
+    ("guided fleet lineage depth",
+     "configs.guided_fleet.lineage_depth", True),
     # Cross-range corpus exchange (docs/fleet.md "Corpus exchange";
     # bench_guided_fleet): the fleet-level staircase — an exchanged
     # fleet must keep reaching the pair bug on ranges too small to

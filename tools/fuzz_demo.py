@@ -89,6 +89,35 @@ def main() -> int:
               f"regressed.", file=sys.stderr)
         return 1
 
+    # -- 2b: the find's lineage (obs/lineage.py, docs/search.md
+    # "Reading the lineage"): the ancestry chain must reach a
+    # generation-0 template parent and name at least one mutation
+    # operator — the pair bug is UNREACHABLE without mutation, so an
+    # operator-free chain means provenance accounting broke.
+    from madsim_tpu.obs.lineage import render_operator_table, render_tree
+
+    chain = g.search.ancestry(g.failing_seeds[0], seeds=g.seeds)
+    print("fuzz-demo: find derivation:\n"
+          + render_tree(chain), file=sys.stderr)
+    print(render_operator_table(g.search.operator_stats), file=sys.stderr)
+    if chain[-1].get("kind") != "template":
+        print(f"fuzz-demo: ancestry chain does not terminate at the "
+              f"generation-0 template: {chain[-1]}", file=sys.stderr)
+        return 1
+    chain_ops = {op for node in chain for op in node.get("ops", [])}
+    if not chain_ops:
+        print("fuzz-demo: the find's ancestry names NO mutation "
+              "operators — the pair bug cannot be reached without "
+              "mutation, so the lineage lanes are broken",
+              file=sys.stderr)
+        return 1
+    bug_ops = {name for name, row in g.search.operator_stats.items()
+               if row["bug"] > 0}
+    if not bug_ops:
+        print("fuzz-demo: operator outcome table credits no operator "
+              "with the find (bug row all zero)", file=sys.stderr)
+        return 1
+
     # -- 3: triage the guided find to a 1-minimal replayable bundle ----
     with tempfile.TemporaryDirectory() as td:
         report = triage(g, out_dir=td, chunk_steps=32, max_steps=20_000)
@@ -110,9 +139,19 @@ def main() -> int:
             return 1
         bundle_path = report.bundles[key]
         with open(bundle_path, encoding="utf-8") as f:
-            block = json.load(f).get("minimization") or {}
+            bundle = json.load(f)
+        block = bundle.get("minimization") or {}
         if block.get("final_rows") != 2:
             print(f"fuzz-demo: bundle minimization block off: {block}",
+                  file=sys.stderr)
+            return 1
+        lin_block = bundle.get("lineage") or {}
+        if lin_block.get("schema") != "madsim.search.lineage/1" or \
+                not lin_block.get("operators_applied") or \
+                (lin_block.get("chain") or [{}])[-1].get("kind") \
+                != "template":
+            print(f"fuzz-demo: bundle lineage block missing/incomplete: "
+                  f"{ {k: lin_block.get(k) for k in ('schema', 'operators_applied')} }",
                   file=sys.stderr)
             return 1
         trace_path = os.path.join(td, "trace.json")
