@@ -10,7 +10,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint detlint tracelint test smoke dryrun determinism \
         dualmode native clean replay-demo bench-diff chaos chaos-full \
-        triage-demo fuzz-demo
+        triage-demo fuzz-demo actorc-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -85,6 +85,13 @@ smoke:
 	    (p.get('random_seeds_to_bug') is None or \
 	     p['guided_seeds_to_bug']<p['random_seeds_to_bug']), \
 	    f'guided search did not beat random on the pair family: {p}'; \
+	px=gh.get('paxos'); \
+	assert isinstance(px,dict) and px.get('guided_seeds_to_bug') and \
+	    (px.get('random_seeds_to_bug') is None or \
+	     px['guided_seeds_to_bug']<px['random_seeds_to_bug']), \
+	    f'guided did not beat random on the actorc Paxos family: {px}'; \
+	assert px.get('guided_lineage_depth',0)>=1, \
+	    f'paxos find has no ancestry depth: {px.get(\"guided_lineage_depth\")}'; \
 	rneed={'guided_bugs_found','random_bugs_found', \
 	       'guided_novelty_area','random_novelty_area'}; \
 	assert rneed<=set(gh['raft']), f'guided_hunt raft leg: {gh[\"raft\"]}'; \
@@ -142,6 +149,18 @@ triage-demo:
 # any miss. CI runs this after triage-demo.
 fuzz-demo:
 	$(CPU_ENV) $(PY) tools/fuzz_demo.py
+
+# The actor compiler end to end (docs/actorc.md; ROADMAP item 3):
+# build the multi-decree Paxos spec, compile it, crosscheck the device
+# actor against its generated host twin per event (bitwise), run the
+# guided hunt over the forgetful-acceptor consistency violation —
+# guided must reach the bug in strictly fewer seeds than the matched
+# random baseline — then triage the find to a verified 1-minimal
+# bundle and replay it through `python -m madsim_tpu.obs replay` in a
+# fresh process. Nonzero exit on any miss. CI runs this after
+# fuzz-demo.
+actorc-demo:
+	$(CPU_ENV) $(PY) tools/actorc_demo.py
 
 # Regression table between two bench rounds (tools/bench_diff.py):
 # compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls

@@ -736,7 +736,7 @@ def bench_guided_hunt(budget: int) -> dict:
 
     from madsim_tpu.engine import DeviceEngine
     from madsim_tpu.parallel.sweep import sweep
-    from madsim_tpu.search.hunts import pair_hunt, raft_hunt
+    from madsim_tpu.search.hunts import pair_hunt, paxos_hunt, raft_hunt
 
     def leg(hunt, stop_first: bool) -> dict:
         eng = DeviceEngine(hunt.actor, hunt.cfg)
@@ -777,7 +777,20 @@ def bench_guided_hunt(budget: int) -> dict:
         f"guided ({pair['guided_seeds_to_bug']}) did not beat random " \
         f"({r}) on the pair family"
     raft = leg(raft_hunt(), stop_first=False)
-    out = {"n_seed_budget": budget, "pair": pair, "raft": raft}
+    # The actorc-compiled DSL-only family (docs/actorc.md): multi-decree
+    # Paxos, forgetful-acceptor consistency violation. Same gate shape
+    # as the pair leg — guided must reach the bug strictly first
+    # (measured: guided ~191, random not found in 512).
+    paxos = leg(paxos_hunt(), stop_first=True)
+    assert paxos["guided_seeds_to_bug"] is not None, \
+        "guided search missed the Paxos forgetful-acceptor bug inside " \
+        "the budget"
+    rp = paxos["random_seeds_to_bug"]
+    assert rp is None or paxos["guided_seeds_to_bug"] < rp, \
+        f"guided ({paxos['guided_seeds_to_bug']}) did not beat random " \
+        f"({rp}) on the Paxos family"
+    out = {"n_seed_budget": budget, "pair": pair, "raft": raft,
+           "paxos": paxos}
     log(f"guided_hunt[{jax.default_backend()}]: {out}")
     return out
 

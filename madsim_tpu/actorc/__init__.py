@@ -1,0 +1,34 @@
+"""actorc — the actor compiler (docs/actorc.md; ROADMAP item 3).
+
+A declarative protocol-state-machine DSL that lowers to the device
+engine's packed-lane actor protocol, with a generated plain-Python host
+twin for conformance crosscheck:
+
+- :mod:`~madsim_tpu.actorc.spec` — the spec model (lanes with declared
+  value ranges, typed messages/timers, guarded transitions, invariants,
+  disk-vs-memory restart annotations) and its pointed validation;
+- :mod:`~madsim_tpu.actorc.compile` — the device compiler: packed lane
+  layout from declared ranges, widen/narrow boundaries by construction
+  (TRC005-clean), merged-handler dispatch, one ``make_outbox`` assembly,
+  the bounded-draw RNG discipline, generated ``kind_names`` and
+  counter-derived ``observe()``;
+- :mod:`~madsim_tpu.actorc.host` — the generated host reference
+  interpreter (same spec, same transition callables, numpy backend);
+- :mod:`~madsim_tpu.actorc.conformance` — the lockstep per-event
+  state/outbox/bug crosscheck between the two;
+- :mod:`~madsim_tpu.actorc.families` — the shipped spec-defined
+  families: tpc and pb (migrated from hand-written actors, their
+  original test suites unchanged) and multi-decree Paxos (the first
+  DSL-only family).
+"""
+from .compile import CompiledActor, Ctx, compile_actor
+from .conformance import HostTwinMismatch, crosscheck
+from .host import HostActor, HostOutbox
+from .spec import ActorSpec, Lane, Message, SpecError, Word, validate_spec
+
+__all__ = [
+    "ActorSpec", "Lane", "Message", "Word", "SpecError", "validate_spec",
+    "CompiledActor", "Ctx", "compile_actor",
+    "HostActor", "HostOutbox",
+    "crosscheck", "HostTwinMismatch",
+]

@@ -1,0 +1,652 @@
+"""The actor compiler: lower an :class:`~madsim_tpu.actorc.spec.ActorSpec`
+to a DeviceEngine actor.
+
+What the compiler owns — the craft that previously had to be re-threaded
+by hand through every actor family (ROADMAP item 3):
+
+- **Lane layout**: at-rest dtypes selected from the declared value
+  ranges (:func:`~madsim_tpu.actorc.spec.lane_dtype`), so the PR 10
+  wide-in-flight/narrow-at-rest packing discipline holds by
+  construction. Every lane *read* passes through ``lanes.widen`` (the
+  one sanctioned narrow-to-wide site, tracelint TRC005) and every
+  *write* through the saturating ``narrow`` inside ``upd``/``upd2`` —
+  a compiled family cannot leak a narrow dtype into handler arithmetic
+  even if its author has never heard of the discipline.
+- **Merged-handler dispatch** (docs/ACTORS.md "write them merged"):
+  every kind's transition is evaluated once per step against shared
+  reads, writes are combined with kind-predicate ``where`` chains, and
+  the whole outbox is assembled through ONE ``actor_util.make_outbox``
+  call — the (N peers + 1 timer) layout all families share.
+- **The bounded-RNG-draw discipline** ``engine/conformance.py``
+  checks: exactly one u32 is drawn per step; transitions that consume
+  it advance the counter conditionally, so draw counts are static and
+  trajectories replay bit-exactly (the ``rng._replace(counter=...)``
+  pattern, generated instead of hand-written).
+- **Restart semantics** from the ``durable`` annotations: volatile
+  lanes reset for the restarting node before the spec's ``on_restart``
+  hook runs — the disk-vs-memory decision is a declaration, not code.
+- **Observability**: ``kind_names`` always populated from the message
+  declarations, counters auto-exported through ``observe()``.
+
+The same spec feeds :mod:`madsim_tpu.actorc.host`, the plain-Python
+reference interpreter used as a conformance oracle
+(:mod:`madsim_tpu.actorc.conformance`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine.actor_util import make_outbox
+from ..engine.core import EngineConfig, Outbox
+from ..engine.lanes import sel, sel2, upd, upd2, widen
+from ..engine.queue import Event, FLAG_TIMER
+from ..engine.rng import DevRng, _u32_to_range, next_u32, uniform_u32
+from .spec import (
+    ActorSpec,
+    KIND_COUNTER,
+    Lane,
+    SCOPE_NODE,
+    SCOPE_NODE_TABLE,
+    SCOPE_WORLD,
+    SCOPE_WORLD_VEC,
+    SpecError,
+    lane_dtype,
+    validate_spec,
+)
+
+__all__ = ["CompiledActor", "Ctx", "compile_actor"]
+
+
+@dataclasses.dataclass
+class _Send:
+    msg: str
+    dst: Any          # None => broadcast
+    to: Any           # broadcast target mask override (None => others)
+    words: Tuple[Any, ...]
+    when: Any
+
+
+@dataclasses.dataclass
+class _Arm:
+    msg: str
+    delay: Any
+    words: Tuple[Any, ...]
+    when: Any
+    dst: Any          # None => the handling node
+
+
+class Ctx:
+    """The restricted expression surface a spec transition writes against.
+
+    One instance is passed to each transition callable; the SAME
+    callable runs under the device compiler (values are traced jnp
+    scalars) and the host interpreter (values are plain ints), so a
+    transition body must restrict itself to:
+
+    - arithmetic / comparison / bitwise Python operators on ctx values;
+    - the ctx helpers (``where``, ``maximum``, ``minimum``, ``clip``,
+      ``popcount``, ``arange``, and ``np`` for vector expressions);
+    - reads (``read*``), guarded writes (``write*``, ``count``),
+      message/timer emission (``send``/``broadcast``/``arm``), the
+      ``bug`` predicate, and at most ONE RNG draw (``u32``/``uniform``).
+
+    No Python ``if`` on ctx values, no raw indexing, no other imports —
+    the compiler cannot check Python control flow, but the host-twin
+    crosscheck (docs/actorc.md) catches divergence the moment a
+    transition steps outside the shared semantics.
+    """
+
+    def __init__(self, spec: ActorSpec, cfg_payload_words: int,
+                 me, now, src, msg=None):
+        self._spec = spec
+        self._pw = cfg_payload_words
+        self.me = me
+        self.now = now
+        self.src = src
+        self._msg = msg
+        self._writes: List[Tuple[str, str, Any, Any, Any]] = []
+        self._sends: List[_Send] = []
+        self._arms: List[_Arm] = []
+        self._bugs: List[Any] = []
+        self._drew = False
+
+    # -- payload words -------------------------------------------------
+    def arg(self, name: str):
+        """The named payload word of the event being handled (wide)."""
+        if self._msg is None:
+            raise SpecError(f"spec {self._spec.name!r}: arg({name!r}) is "
+                            "only available inside a message handler")
+        for i, wd in enumerate(self._msg.words):
+            if wd.name == name:
+                return self._payload_word(i)
+        raise SpecError(
+            f"spec {self._spec.name!r}: message {self._msg.name!r} has no "
+            f"word {name!r} (declared: "
+            f"{[w.name for w in self._msg.words]})")
+
+    # -- guarded writes ------------------------------------------------
+    def write(self, lane: str, value, when=True) -> None:
+        """Write the handling node's value of a per-node lane."""
+        self._record(SCOPE_NODE, lane, None, value, when)
+
+    def write_at(self, lane: str, col, value, when=True) -> None:
+        """Write the handling node's row of a node-table lane at ``col``
+        (clipped into range, like every ctx column index)."""
+        self._record(SCOPE_NODE_TABLE, lane, col, value, when)
+
+    def write_vec_at(self, lane: str, idx, value, when=True) -> None:
+        self._record(SCOPE_WORLD_VEC, lane, idx, value, when)
+
+    def write_vec(self, lane: str, value, when=True) -> None:
+        """Full-vector write of a world-vector lane; ``when`` may be a
+        per-element mask."""
+        self._record("world_vec_full", lane, None, value, when)
+
+    def write_scalar(self, lane: str, value, when=True) -> None:
+        self._record(SCOPE_WORLD, lane, None, value, when)
+
+    def count(self, lane: str, amount=1, when=True) -> None:
+        """Increment a counter lane (auto-exported by ``observe()``)."""
+        if self._spec.lane(lane).kind != KIND_COUNTER:
+            raise SpecError(f"spec {self._spec.name!r}: count() targets "
+                            f"counter lanes; {lane!r} is not one")
+        self._record("count", lane, None, amount, when)
+
+    def _record(self, op: str, lane: str, idx, value, when) -> None:
+        ln = self._spec.lane(lane)
+        expect = {SCOPE_NODE: SCOPE_NODE, SCOPE_NODE_TABLE: SCOPE_NODE_TABLE,
+                  SCOPE_WORLD_VEC: SCOPE_WORLD_VEC,
+                  "world_vec_full": SCOPE_WORLD_VEC,
+                  SCOPE_WORLD: SCOPE_WORLD, "count": SCOPE_WORLD}[op]
+        if ln.scope != expect:
+            raise SpecError(
+                f"spec {self._spec.name!r}: lane {lane!r} has scope "
+                f"{ln.scope!r}; this write form needs {expect!r}")
+        self._writes.append((op, lane, idx, value, when))
+
+    # -- messages / timers --------------------------------------------
+    def send(self, msg: str, dst, words=(), when=True) -> None:
+        """Send one message to node ``dst``."""
+        self._emit_msg(msg, timer=False)
+        self._sends.append(_Send(msg, dst, None, tuple(words), when))
+
+    def broadcast(self, msg: str, words=(), when=True, to=None) -> None:
+        """Send one message to every other node (or the ``to`` mask)."""
+        self._emit_msg(msg, timer=False)
+        self._sends.append(_Send(msg, None, to, tuple(words), when))
+
+    def arm(self, timer: str, delay, words=(), when=True, dst=None) -> None:
+        """Arm one timer: delivered to ``dst`` (default: this node)
+        after ``delay`` µs, generation-checked like every timer."""
+        self._emit_msg(timer, timer=True)
+        self._arms.append(_Arm(timer, delay, tuple(words), when, dst))
+
+    def _emit_msg(self, name: str, timer: bool) -> None:
+        m = self._spec.message(name)
+        if m.timer != timer:
+            kindw = "a timer" if m.timer else "a message"
+            raise SpecError(f"spec {self._spec.name!r}: {name!r} is "
+                            f"declared {kindw}; use "
+                            f"{'arm' if m.timer else 'send/broadcast'}()")
+
+    def _check_words(self, msg: str, words) -> None:
+        m = self._spec.message(msg)
+        if len(words) != len(m.words):
+            raise SpecError(
+                f"spec {self._spec.name!r}: {msg!r} declares "
+                f"{len(m.words)} payload words "
+                f"({[w.name for w in m.words]}); got {len(words)}")
+
+    # -- the bug flag --------------------------------------------------
+    def bug(self, when) -> None:
+        """Latch the world's bug flag when ``when`` holds — the
+        event-time invariant form docs/ACTORS.md prefers."""
+        self._bugs.append(when)
+
+    # -- RNG (at most one draw per transition) -------------------------
+    def u32(self):
+        """The step's raw u32 draw; marks it consumed."""
+        self._mark_draw()
+        return self._raw_u32()
+
+    def uniform(self, lo: int, hi: int):
+        """The step's draw mapped to [lo, hi) — engine
+        ``uniform_u32`` parity, so host and device agree bit-for-bit."""
+        self._mark_draw()
+        return self._uniform(lo, hi)
+
+    def _mark_draw(self) -> None:
+        if self._drew:
+            raise SpecError(
+                f"spec {self._spec.name!r}: a transition may draw at most "
+                "once per event (the static-draw-shape rule, "
+                "docs/ACTORS.md); combine draws into one mapped value")
+        self._drew = True
+
+
+class _DeviceCtx(Ctx):
+    """Device backend: reads widen, helpers are jnp, writes/sends are
+    recorded for the compiler's merge pass."""
+
+    np = jnp
+
+    def __init__(self, spec, cfg: EngineConfig, state, me, now, src,
+                 msg=None, ev=None, u=None):
+        super().__init__(spec, cfg.payload_words, me, now, src, msg)
+        self._cfg = cfg
+        self._state = state
+        self._ev = ev
+        self._u = u
+
+    # reads (widen-on-read: the TRC005 boundary, placed by construction)
+    def read(self, lane: str):
+        return widen(sel(self._state[self._lane(lane, SCOPE_NODE)], self.me))
+
+    def read_node(self, lane: str, node):
+        ln = self._lane(lane, SCOPE_NODE)
+        return widen(sel(self._state[ln], self.clip(node, 0,
+                                                    self._spec.n_nodes - 1)))
+
+    def read_at(self, lane: str, col):
+        ln = self._spec.lane(lane)
+        self._lane(lane, SCOPE_NODE_TABLE)
+        return widen(sel2(self._state[lane], self.me,
+                          self.clip(col, 0, ln.cols - 1)))
+
+    def read_row(self, lane: str):
+        self._lane(lane, SCOPE_NODE_TABLE)
+        return widen(sel(self._state[lane], self.me))
+
+    def read_vec_at(self, lane: str, idx):
+        ln = self._spec.lane(lane)
+        self._lane(lane, SCOPE_WORLD_VEC)
+        return widen(sel(self._state[lane], self.clip(idx, 0, ln.cols - 1)))
+
+    def read_vec(self, lane: str):
+        self._lane(lane, SCOPE_WORLD_VEC)
+        return widen(self._state[lane])
+
+    def read_scalar(self, lane: str):
+        self._lane(lane, SCOPE_WORLD)
+        return widen(self._state[lane])
+
+    def _lane(self, lane: str, scope: str) -> str:
+        ln = self._spec.lane(lane)
+        if ln.scope != scope:
+            raise SpecError(f"spec {self._spec.name!r}: lane {lane!r} has "
+                            f"scope {ln.scope!r}; this read form needs "
+                            f"{scope!r}")
+        return lane
+
+    # expression helpers
+    @staticmethod
+    def where(c, a, b):
+        return jnp.where(c, a, b)
+
+    @staticmethod
+    def maximum(a, b):
+        return jnp.maximum(a, b)
+
+    @staticmethod
+    def minimum(a, b):
+        return jnp.minimum(a, b)
+
+    @staticmethod
+    def clip(x, lo, hi):
+        return jnp.clip(x, lo, hi)
+
+    @staticmethod
+    def popcount(x):
+        return lax.population_count(jnp.asarray(x, jnp.int32))
+
+    @staticmethod
+    def arange(k: int):
+        return jnp.arange(k)
+
+    def others(self):
+        """(N,) bool: every node but the handling one."""
+        return jnp.arange(self._spec.n_nodes) != self.me
+
+    def _payload_word(self, i: int):
+        return self._ev.payload[i]
+
+    def _raw_u32(self):
+        return self._u
+
+    def _uniform(self, lo, hi):
+        return _u32_to_range(self._u, lo, hi)
+
+
+class _DeviceRestartCtx(_DeviceCtx):
+    """on_restart hook backend: draws advance the carried rng cursor
+    unconditionally (a restart is one concrete event, not a merged
+    kind), matching the hand-written actors' restart hooks."""
+
+    def __init__(self, spec, cfg, state, node, now, rng: DevRng):
+        super().__init__(spec, cfg, state, me=node, now=now, src=node)
+        self._rng = rng
+
+    def _mark_draw(self) -> None:
+        pass  # unconditional draws; each call advances the cursor
+
+    def _raw_u32(self):
+        x, self._rng = next_u32(self._rng)
+        return x
+
+    def _uniform(self, lo, hi):
+        x, self._rng = uniform_u32(self._rng, lo, hi)
+        return x
+
+
+class _InitCtx:
+    """Spec ``init`` backend: schedules the world's seed events.
+
+    Draw order is the contract: ``uniform``/``u32`` advance the world
+    RNG cursor in call order, exactly like a hand-written ``init``."""
+
+    np = jnp
+
+    def __init__(self, spec: ActorSpec, cfg: EngineConfig, rng: DevRng):
+        self._spec = spec
+        self._cfg = cfg
+        self._rng = rng
+        self._events: List[Event] = []
+
+    def event(self, msg: str, time, dst=0, src=None, words=()) -> None:
+        """Schedule one seed event (a timer when ``msg`` is declared
+        one — timers are generation-checked from the start)."""
+        m = self._spec.message(msg)
+        if len(words) != len(m.words):
+            raise SpecError(
+                f"spec {self._spec.name!r}: init event {msg!r} needs "
+                f"{len(m.words)} words ({[w.name for w in m.words]}); "
+                f"got {len(words)}")
+        self._events.append(Event.make(
+            time=time, kind=self._spec.kind_of(msg),
+            payload_words=self._cfg.payload_words,
+            flags=FLAG_TIMER if m.timer else 0,
+            src=dst if src is None else src, dst=dst,
+            payload=list(words)))
+
+    def uniform(self, lo: int, hi: int):
+        x, self._rng = uniform_u32(self._rng, lo, hi)
+        return x
+
+    def u32(self):
+        x, self._rng = next_u32(self._rng)
+        return x
+
+
+class _VecReader:
+    """Full-lane views for ``invariant`` bodies: every lane widened,
+    vector helpers through ``np`` (jnp here; numpy in the host twin).
+    The widening function is injected — ``lanes.widen`` on device (the
+    sanctioned TRC005 site; invariant runs inside the registered
+    ``engine.run`` program), a plain numpy cast in the host twin."""
+
+    def __init__(self, spec: ActorSpec, state, np_mod, widen_fn,
+                 sel_fn=None):
+        self._spec = spec
+        self._state = state
+        self.np = np_mod
+        self._widen = widen_fn
+        self._sel = sel_fn
+
+    def lane(self, name: str):
+        self._spec.lane(name)
+        return self._widen(self._state[name])
+
+    def sel(self, name: str, i):
+        """Row ``i`` of a lane, by a possibly-traced index (the one-hot
+        ``lanes.sel`` on device; plain indexing in the host twin)."""
+        self._spec.lane(name)
+        return self._sel(self._state[name], i)
+
+    def n_nodes(self) -> int:
+        return self._spec.n_nodes
+
+
+class _ObsReader:
+    """Raw batched lane views for derived ``observe`` entries — device
+    only (observations never feed the host twin), so bodies may use
+    jnp reductions with the batched axis conventions of
+    docs/ACTORS.md (reduce node axes with axis=-1/-2)."""
+
+    np = jnp
+
+    def __init__(self, spec: ActorSpec, state):
+        self._spec = spec
+        self._state = state
+
+    def raw(self, name: str):
+        self._spec.lane(name)
+        return self._state[name]
+
+
+class CompiledActor:
+    """An :class:`~madsim_tpu.actorc.spec.ActorSpec` lowered to the
+    DeviceEngine actor protocol (docs/ACTORS.md). Use exactly like a
+    hand-written actor::
+
+        eng = DeviceEngine(CompiledActor(my_spec), EngineConfig(...))
+    """
+
+    def __init__(self, spec: ActorSpec):
+        validate_spec(spec)  # spec-internal checks at construction
+        self.spec = spec
+        self.num_kinds = len(spec.messages)
+        # Generated families always trace/replay readably: the
+        # declaration order IS the kind code table.
+        self.kind_names = [m.name for m in spec.messages]
+        self.invariant_id = spec.invariant_id or spec.name
+
+    # ------------------------------------------------------------------
+    def init(self, cfg: EngineConfig, rng: DevRng):
+        validate_spec(self.spec, cfg)  # packed-width guards, pointed
+        lt = cfg.lanes
+        state = {}
+        for ln in self.spec.lanes:
+            dt = lane_dtype(ln, lt)
+            state[ln.name] = jnp.full(self._shape(ln), ln.init, dt)
+        ictx = _InitCtx(self.spec, cfg, rng)
+        self.spec.init(ictx)
+        return state, ictx._events, ictx._rng
+
+    def _shape(self, ln: Lane) -> Tuple[int, ...]:
+        n = self.spec.n_nodes
+        return {SCOPE_NODE: (n,), SCOPE_NODE_TABLE: (n, ln.cols),
+                SCOPE_WORLD_VEC: (ln.cols,), SCOPE_WORLD: ()}[ln.scope]
+
+    # ------------------------------------------------------------------
+    def handle(self, cfg: EngineConfig, s, ev: Event, now, rng: DevRng):
+        spec = self.spec
+        n = spec.n_nodes
+        kind = jnp.clip(ev.kind, 0, self.num_kinds - 1)
+        me = jnp.clip(ev.dst, 0, n - 1)
+        src = jnp.clip(ev.src, 0, n - 1)
+        # ONE draw per step, static shape; transitions that consume it
+        # advance the counter conditionally (the docs/ACTORS.md rule).
+        u, rng_drawn = next_u32(rng)
+        gated: List[Tuple[Any, _DeviceCtx]] = []
+        for k, msg in enumerate(spec.messages):
+            fn = spec.handlers.get(msg.name)
+            if fn is None:
+                continue
+            t = _DeviceCtx(spec, cfg, s, me=me, now=now, src=src,
+                           msg=msg, ev=ev, u=u)
+            fn(t)
+            gated.append((kind == k, t))
+        s2 = self._merge_writes(cfg, s, me, gated)
+        ob = self._merge_outbox(cfg, me, gated)
+        drew = jnp.asarray(False)
+        bug = jnp.asarray(False)
+        for pred, t in gated:
+            if t._drew:
+                drew = drew | pred
+            for b in t._bugs:
+                bug = bug | (pred & b)
+        rng_out = rng._replace(counter=jnp.where(
+            drew, rng_drawn.counter, rng.counter))
+        return s2, ob, rng_out, bug
+
+    # ------------------------------------------------------------------
+    def on_restart(self, cfg: EngineConfig, s, node, now, rng: DevRng):
+        spec = self.spec
+        node = jnp.clip(node, 0, spec.n_nodes - 1)
+        s2 = dict(s)
+        # The disk-vs-memory annotations: volatile lanes lose the
+        # restarting node's row BEFORE the hook runs (fresh NodeInfo
+        # semantics, like the reference's task.rs:229-240).
+        for ln in spec.lanes:
+            if ln.durable:
+                continue
+            if ln.scope == SCOPE_NODE:
+                s2[ln.name] = upd(s2[ln.name], node, jnp.int32(ln.reset))
+            else:  # SCOPE_NODE_TABLE (validate_spec enforces per-node)
+                s2[ln.name] = upd(s2[ln.name], node,
+                                  jnp.full((ln.cols,), ln.reset, jnp.int32))
+        if spec.on_restart is None:
+            # An empty outbox in the SAME (N peers + 1 timer) layout the
+            # merge pass builds (host-twin parity: slot n is the timer
+            # row whether or not anything is armed).
+            return s2, self._merge_outbox(cfg, node, []), rng
+        t = _DeviceRestartCtx(spec, cfg, s2, node, now, rng)
+        spec.on_restart(t)
+        s3 = self._merge_writes(cfg, s2, node, [(jnp.asarray(True), t)])
+        ob = self._merge_outbox(cfg, node, [(jnp.asarray(True), t)])
+        return s3, ob, t._rng
+
+    # ------------------------------------------------------------------
+    def invariant(self, cfg: EngineConfig, s):
+        v = _VecReader(self.spec, s, jnp, widen,
+                       lambda arr, i: widen(sel(arr, i)))
+        return jnp.asarray(self.spec.invariant(v), bool)
+
+    # ------------------------------------------------------------------
+    def observe(self, cfg: EngineConfig, s) -> dict:
+        out = {}
+        for ln in self.spec.lanes:
+            if ln.kind == KIND_COUNTER:
+                out[ln.name] = s[ln.name]
+        o = _ObsReader(self.spec, s)
+        for name, fn in self.spec.observe.items():
+            out[name] = fn(o)
+        return out
+
+    # ==================================================================
+    # Merge passes
+    # ==================================================================
+    def _merge_writes(self, cfg: EngineConfig, s, me, gated):
+        """Fold every transition's recorded writes into one state
+        update per lane, gated on (kind predicate & write condition) —
+        the compiled form of the hand-written nested-``where`` merge.
+        Narrow-write saturation rides ``upd``/``upd2``/``narrow``."""
+        from ..engine.lanes import narrow
+
+        spec = self.spec
+        s2 = dict(s)
+        for ln in spec.lanes:
+            writes = [(pred, w) for pred, t in gated for w in t._writes
+                      if w[1] == ln.name]
+            if not writes:
+                continue
+            arr = s2[ln.name]
+            if ln.scope == SCOPE_NODE:
+                val = widen(sel(arr, me))
+                for pred, (_op, _l, _i, v, when) in writes:
+                    val = jnp.where(pred & when, v, val)
+                arr = upd(arr, me, val)
+            elif ln.scope == SCOPE_NODE_TABLE:
+                for pred, (_op, _l, col, v, when) in writes:
+                    c = jnp.clip(col, 0, ln.cols - 1)
+                    cur = widen(sel2(arr, me, c))
+                    arr = upd2(arr, me, c, jnp.where(pred & when, v, cur))
+            elif ln.scope == SCOPE_WORLD_VEC:
+                for pred, (op, _l, idx, v, when) in writes:
+                    if op == "world_vec_full":
+                        g = pred & when  # ``when`` may be a mask
+                        arr = jnp.where(g, narrow(v, arr.dtype), arr)
+                    else:
+                        i = jnp.clip(idx, 0, ln.cols - 1)
+                        cur = widen(sel(arr, i))
+                        arr = upd(arr, i, jnp.where(pred & when, v, cur))
+            else:  # SCOPE_WORLD (scalars and counters)
+                if ln.kind == KIND_COUNTER:
+                    total = jnp.int32(0)
+                    for pred, (_op, _l, _i, amount, when) in writes:
+                        total = total + jnp.where(
+                            pred & when, jnp.asarray(amount, jnp.int32), 0)
+                    arr = arr + total
+                else:
+                    for pred, (_op, _l, _i, v, when) in writes:
+                        arr = jnp.where(pred & when,
+                                        narrow(v, arr.dtype), arr)
+            s2[ln.name] = arr
+        return s2
+
+    def _merge_outbox(self, cfg: EngineConfig, me, gated) -> Outbox:
+        """ONE ``make_outbox`` assembly for the whole step: the
+        (N peers + 1 timer) layout every family shares, with sends and
+        timer arms merged across kinds by predicate chains."""
+        spec = self.spec
+        n = spec.n_nodes
+        arange = jnp.arange(n)
+        m_valid = jnp.zeros((n,), bool)
+        m_kind = jnp.int32(0)
+        m_words = [jnp.int32(0)] * cfg.payload_words
+        t_valid = jnp.asarray(False)
+        t_kind = jnp.int32(0)
+        t_dst = widen(me)
+        t_delay = jnp.int32(0)
+        t_words = [jnp.int32(0)] * cfg.payload_words
+
+        for pred, t in gated:
+            for snd in t._sends:
+                t._check_words(snd.msg, snd.words)
+                g = pred & snd.when
+                if snd.dst is not None:
+                    mask = arange == jnp.clip(snd.dst, 0, n - 1)
+                elif snd.to is not None:
+                    mask = snd.to
+                else:
+                    mask = arange != me
+                m_valid = jnp.where(g, mask, m_valid)
+                m_kind = jnp.where(g, jnp.int32(spec.kind_of(snd.msg)),
+                                   m_kind)
+                for i, w in enumerate(snd.words):
+                    m_words[i] = jnp.where(g, jnp.asarray(w, jnp.int32),
+                                           m_words[i])
+            for a in t._arms:
+                t._check_words(a.msg, a.words)
+                g = pred & a.when
+                t_valid = t_valid | g
+                t_kind = jnp.where(g, jnp.int32(spec.kind_of(a.msg)),
+                                   t_kind)
+                t_dst = jnp.where(
+                    g, widen(me) if a.dst is None
+                    else jnp.clip(a.dst, 0, n - 1), t_dst)
+                t_delay = jnp.where(g, jnp.asarray(a.delay, jnp.int32),
+                                    t_delay)
+                for i, w in enumerate(a.words):
+                    t_words[i] = jnp.where(g, jnp.asarray(w, jnp.int32),
+                                           t_words[i])
+
+        msg_payload = jnp.broadcast_to(
+            jnp.stack(m_words), (n, cfg.payload_words))
+        return make_outbox(
+            cfg, n,
+            msg_valid=m_valid,
+            msg_kind=jnp.full((n,), m_kind, jnp.int32),
+            msg_payload=msg_payload,
+            timer_valid=t_valid, timer_kind=t_kind, timer_dst=t_dst,
+            timer_delay=t_delay,
+            timer_payload=jnp.stack(t_words))
+
+
+def compile_actor(spec: ActorSpec) -> CompiledActor:
+    """Compile ``spec`` to a DeviceEngine actor (docs/actorc.md)."""
+    return CompiledActor(spec)

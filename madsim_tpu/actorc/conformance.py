@@ -1,0 +1,188 @@
+"""Host-twin conformance: lockstep crosscheck of a compiled actor
+against its generated plain-Python reference interpreter.
+
+The oracle pattern (PR 9's FNV twin, PR 12's corpus-merge twin) applied
+to the actor compiler: one device pass records, per step of a real
+trajectory, the popped event, the engine's deliver/fault gates, the raw
+entropy the handler would draw, the handler's outbox, and the post-step
+actor state; the host twin (:mod:`madsim_tpu.actorc.host`) then replays
+the SAME event stream through the shared transition callables and every
+per-event state lane, outbox row and bug decision is compared bitwise.
+A mismatch is a compiler bug or a spec stepping outside the restricted
+expression surface — either way it surfaces here, with the seed, step,
+event and lane named, instead of as silent divergence deep inside a
+million-seed sweep.
+
+The recorder is one jitted scan vmapped over the seed axis (one compile
+per engine, all sampled seeds in one dispatch); the comparison loop is
+host-side Python over the pulled arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.core import DeviceEngine, EngineConfig, FAULT_RESTART
+from ..engine.lanes import take_small, widen
+from ..engine.queue import FLAG_FAULT, FLAG_TIMER, GEN_MASK, eligible_mask, pop
+from ..ops.threefry import threefry2x32_jax
+from .compile import CompiledActor
+from .host import HostActor
+from .spec import ActorSpec
+
+__all__ = ["crosscheck", "HostTwinMismatch", "ENTROPY_WORDS"]
+
+# Raw u32 words recorded per step: the handler draws at most one (the
+# compiler's static-draw rule), restart hooks may draw a few more —
+# sequential next_u32 values ARE the Threefry stream at consecutive
+# counters, so recording a block covers both.
+ENTROPY_WORDS = 4
+
+
+class HostTwinMismatch(AssertionError):
+    """Device actor and generated host twin disagreed on an event."""
+
+
+def _recorder(eng: DeviceEngine, max_steps: int):
+    """One world's instrumented replay: scan ``max_steps`` engine steps,
+    recording the per-step event, gates, entropy, handler/restart
+    outputs and post-step state. Vmapped over worlds by the caller."""
+    cfg = eng.cfg
+    actor = eng.actor
+    n = cfg.n_nodes
+
+    def body(s, _):
+        # The same peek + gate derivation DeviceEngine.trace uses: the
+        # step's own pop happens inside _step_one below.
+        _q, ev, found = pop(
+            s.queue, eligible_mask(s.queue, s.paused, n) & s.active)
+        now = jnp.where(found, jnp.maximum(s.now, ev.time), s.now)
+        in_time = now < jnp.int32(cfg.t_limit_us)
+        dst = jnp.clip(ev.dst, 0, n - 1)
+        is_fault = (ev.flags & FLAG_FAULT) != 0
+        is_timer = (ev.flags & FLAG_TIMER) != 0
+        stale = is_timer & (ev.gen != (widen(take_small(s.gen, dst))
+                                       & GEN_MASK))
+        dead = ~take_small(s.alive, dst)
+        deliver = found & in_time & ~is_fault & ~stale & ~dead
+        do_fault = found & in_time & is_fault
+        restart = do_fault & (ev.kind == FAULT_RESTART)
+        rnode = jnp.clip(ev.src, 0, n - 1)
+
+        # The entropy block the handler/restart hook would consume:
+        # consecutive counters from the current cursor.
+        ctrs = s.rng.counter + jnp.arange(ENTROPY_WORDS, dtype=jnp.uint32)
+        entropy, _ = threefry2x32_jax(
+            s.rng.k0, s.rng.k1, ctrs,
+            jnp.zeros((ENTROPY_WORDS,), jnp.uint32))
+
+        # What the step WILL do, recorded from the same calls it makes.
+        _sh, ob_h, _rh, hbug = actor.handle(cfg, s.astate, ev, now, s.rng)
+        _sr, ob_r, _rr = actor.on_restart(cfg, s.astate, rnode, now, s.rng)
+
+        s2 = eng._step_one(s)
+        rec = dict(
+            found=found, deliver=deliver, restart=restart, rnode=rnode,
+            now=now, kind=ev.kind, dst=ev.dst, src=ev.src,
+            payload=ev.payload, entropy=entropy, hbug=hbug,
+            ob_h=ob_h, ob_r=ob_r, astate=s2.astate, bug=s2.bug)
+        return s2, rec
+
+    def run(state0):
+        _final, recs = jax.lax.scan(body, state0, None, length=max_steps)
+        return recs
+
+    return run
+
+
+def _neq(a, b) -> bool:
+    return not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _cmp_state(where: str, dev: Dict[str, Any], host: Dict[str, Any]):
+    for name in host:
+        if _neq(dev[name], host[name]):
+            raise HostTwinMismatch(
+                f"{where}: lane {name!r} diverged\n  device: "
+                f"{np.asarray(dev[name])!r}\n  host:   "
+                f"{np.asarray(host[name])!r}")
+
+
+def _cmp_outbox(where: str, dev, host):
+    for field in ("valid", "is_timer", "kind", "dst", "delay_us",
+                  "payload"):
+        d, h = getattr(dev, field), getattr(host, field)
+        if _neq(d, h):
+            raise HostTwinMismatch(
+                f"{where}: outbox field {field!r} diverged\n  device: "
+                f"{np.asarray(d)!r}\n  host:   {np.asarray(h)!r}")
+
+
+def crosscheck(spec: ActorSpec, cfg: EngineConfig,
+               seeds: Sequence[int], faults: Optional[np.ndarray] = None,
+               max_steps: int = 400,
+               engine: Optional[DeviceEngine] = None) -> Dict[str, Any]:
+    """Crosscheck compiled-vs-host on real trajectories; see module
+    docstring. Raises :class:`HostTwinMismatch` on the first
+    divergence; returns an accounting report otherwise."""
+    eng = engine or DeviceEngine(CompiledActor(spec), cfg)
+    host = HostActor(spec, packed=cfg.packed,
+                     payload_words=cfg.payload_words)
+    seeds = np.asarray(seeds, np.uint64)
+    states = eng.init(seeds, faults=faults)
+    recs = jax.jit(jax.vmap(_recorder(eng, max_steps)))(states)
+    recs = jax.device_get(recs)
+
+    lanes = [ln.name for ln in spec.lanes]
+    delivered = restarts = checked = 0
+    for w, seed in enumerate(seeds):
+        hstate = host.init_state()
+        tag0 = f"spec {spec.name!r} seed {int(seed)}"
+        _cmp_state(f"{tag0} initial state",
+                   {k: np.asarray(states.astate[k])[w] for k in lanes},
+                   hstate)
+        hlatch = False
+        for i in range(max_steps):
+            tag = f"{tag0} step {i}"
+            ent = [int(x) for x in recs["entropy"][w, i]]
+            if recs["deliver"][w, i]:
+                hstate, hob, hbug = host.handle(
+                    hstate, kind=int(recs["kind"][w, i]),
+                    dst=int(recs["dst"][w, i]),
+                    src=int(recs["src"][w, i]),
+                    payload=[int(x) for x in recs["payload"][w, i]],
+                    now=int(recs["now"][w, i]), entropy=ent)
+                _cmp_outbox(
+                    f"{tag} (deliver kind "
+                    f"{eng.actor.kind_names[int(recs['kind'][w, i]) % len(eng.actor.kind_names)]})",
+                    jax.tree.map(lambda x: x[w, i], recs["ob_h"]), hob)
+                if bool(recs["hbug"][w, i]) != hbug:
+                    raise HostTwinMismatch(
+                        f"{tag}: handler bug flag diverged (device "
+                        f"{bool(recs['hbug'][w, i])}, host {hbug})")
+                hlatch = hlatch or hbug
+                delivered += 1
+            elif recs["restart"][w, i]:
+                hstate, hob = host.on_restart(
+                    hstate, node=int(recs["rnode"][w, i]),
+                    now=int(recs["now"][w, i]), entropy=ent)
+                _cmp_outbox(f"{tag} (restart node "
+                            f"{int(recs['rnode'][w, i])})",
+                            jax.tree.map(lambda x: x[w, i], recs["ob_r"]),
+                            hob)
+                restarts += 1
+            _cmp_state(tag,
+                       {k: np.asarray(recs["astate"][k])[w, i]
+                        for k in lanes}, hstate)
+            hlatch = hlatch or host.invariant(hstate)
+            if bool(recs["bug"][w, i]) != bool(hlatch):
+                raise HostTwinMismatch(
+                    f"{tag}: bug decision diverged (device "
+                    f"{bool(recs['bug'][w, i])}, host twin {hlatch})")
+            checked += 1
+    return {"n_seeds": len(seeds), "steps_checked": checked,
+            "events_delivered": delivered, "restarts": restarts,
+            "max_steps": max_steps}

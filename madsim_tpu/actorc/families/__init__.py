@@ -1,0 +1,11 @@
+"""Spec-defined protocol families (docs/actorc.md).
+
+``tpc`` and ``pb`` are the migrated families: their specs transliterate
+the formerly hand-written merged handlers 1:1 and the original test
+suites (tests/test_tpc_actor.py, tests/test_pb_actor.py) run unchanged
+against the compiled actors. ``paxos`` is the first DSL-only family —
+multi-decree Paxos with a forgetful-acceptor bug switch for the guided
+hunt (search/hunts.py ``paxos_hunt``). The raft actor deliberately
+stays hand-written in :mod:`madsim_tpu.engine.raft_actor` as the craft
+reference the compiler's output is compared against.
+"""

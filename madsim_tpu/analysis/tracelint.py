@@ -474,6 +474,46 @@ def _build_triage_candidate_eval() -> Built:
                                   jnp.int32(SWEEP_K_MAX)))
 
 
+# Compiled-actor (actorc) run shapes: the whole point of registering
+# these is TRC005 — the compiler CLAIMS its widen-on-read /
+# narrow-on-write boundaries are placed by construction, and the
+# narrow-discipline scan over a compiled family's full run program is
+# what holds it to that. Small widths: the contract is width-invariant.
+ACTORC_WORLDS = 64
+ACTORC_MAX_STEPS = 4_000
+
+
+def _build_actorc_run(family: str) -> Callable[[], Built]:
+    def build() -> Built:
+        import numpy as np
+
+        key = f"actorc_{family}"
+        if key not in _ENGINE_CACHE:
+            from ..engine import DeviceEngine
+
+            if family == "paxos":
+                from ..actorc.families.paxos import (PaxosActor,
+                                                     PaxosConfig,
+                                                     engine_config)
+
+                acfg = PaxosConfig()
+                _ENGINE_CACHE[key] = DeviceEngine(PaxosActor(acfg),
+                                                  engine_config(acfg))
+            else:  # tpc — the migrated hand-written family
+                from ..engine import EngineConfig, TPCActor, TPCDeviceConfig
+
+                _ENGINE_CACHE[key] = DeviceEngine(
+                    TPCActor(TPCDeviceConfig(n=4, n_txns=4)),
+                    EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                                 t_limit_us=2_000_000, stop_on_bug=False))
+        eng = _ENGINE_CACHE[key]
+        state = eng.init(np.arange(ACTORC_WORLDS))
+        return Built(fn=eng._run, args=(state, ACTORC_MAX_STEPS),
+                     trace_fn=lambda s: eng._run_impl(s, ACTORC_MAX_STEPS),
+                     trace_args=(state,))
+    return build
+
+
 BRIDGE_SLOTS = 8
 BRIDGE_CAP = 16
 BRIDGE_K_EVENTS = 2
@@ -600,6 +640,17 @@ def registry() -> Dict[str, TraceProgram]:
             "dispatch (undonated like sweep.compactor — gathers cannot "
             "alias)", _build_compactor_sched, budget=True,
             donates=False),
+        TraceProgram(
+            "actorc.tpc_run", "compiled two-phase-commit run loop "
+            f"(actorc spec, W={ACTORC_WORLDS}; TRC005 holds the "
+            "compiler to its by-construction widen/narrow claim, "
+            "docs/actorc.md)", _build_actorc_run("tpc"), budget=True,
+            donates=True, unit_div=ACTORC_WORLDS, packed=True),
+        TraceProgram(
+            "actorc.paxos_run", "compiled multi-decree Paxos run loop "
+            f"(DSL-only family, W={ACTORC_WORLDS})",
+            _build_actorc_run("paxos"), budget=True, donates=True,
+            unit_div=ACTORC_WORLDS, packed=True),
         TraceProgram(
             "bridge.step", "bridge decision-kernel lockstep round "
             f"(W={BRIDGE_SLOTS}, cap={BRIDGE_CAP})", _build_bridge_step,

@@ -38,9 +38,24 @@ from .lanes import PACKED, WIDE, Lanes
 from .checkpoint import CheckpointError
 from .checkpoint import load as load_checkpoint
 from .checkpoint import save as save_checkpoint
-from .pb_actor import PBActor, PBDeviceConfig
 from .raft_actor import RaftActor, RaftDeviceConfig
-from .tpc_actor import TPCActor, TPCDeviceConfig
+
+# The compiled families (tpc, pb) resolve lazily: their modules import
+# the actor compiler (madsim_tpu.actorc), which itself builds on the
+# engine submodules — eager imports here would close an import cycle
+# whenever actorc is imported first. PEP 562 keeps
+# ``from madsim_tpu.engine import TPCActor`` working unchanged.
+_LAZY = {"TPCActor": ".tpc_actor", "TPCDeviceConfig": ".tpc_actor",
+         "PBActor": ".pb_actor", "PBDeviceConfig": ".pb_actor"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name], __name__),
+                       name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "DeviceEngine", "EngineConfig", "Event", "Outbox", "WorldState",

@@ -1,8 +1,13 @@
 """Shared actor-side helpers: payload padding and the outbox layout.
 
-Both actor families (raft_actor, pb_actor) assemble the same
-(N peer messages + 1 timer) Outbox shape; keeping the layout in one place
-means a change to it cannot silently diverge the actors.
+EVERY actor family assembles the same (N peer messages + 1 timer)
+Outbox shape through :func:`make_outbox` — the hand-written craft
+reference (raft_actor) calls it directly, and the actor compiler
+(madsim_tpu/actorc/compile.py) emits exactly one call per compiled
+step for the spec-defined families (tpc, pb, paxos). Keeping the
+layout in one place means a change to it cannot silently diverge the
+actors — and the compiled/host-twin crosscheck (actorc/conformance.py)
+now pins the layout bitwise per event on top.
 """
 from __future__ import annotations
 

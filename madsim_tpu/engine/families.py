@@ -1,0 +1,146 @@
+"""The actor-family registry: every workload family the repo ships, in
+one place.
+
+Three consumers read this table:
+
+- the obs replay CLI (``python -m madsim_tpu.obs replay --actor <name>``
+  and bundle replay) resolves ``name -> (actor class, config class)``;
+- triage names the family inside repro bundles
+  (:func:`madsim_tpu.triage.corpus._actor_bundle_info`);
+- the conformance tier-1 test (tests/test_conformance.py) runs
+  ``engine.conformance.check_actor`` over EVERY entry — hand-written
+  and compiled alike — via each entry's canonical ``conformance()``
+  shape, instead of the per-actor opt-in it used to be.
+
+Imports are lazy per entry: building the table costs nothing until a
+family is actually constructed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """One registered actor family."""
+
+    name: str
+    load: Callable[[], Tuple[type, type]]   # -> (actor_cls, config_cls)
+    # Canonical (actor, EngineConfig) for the conformance sweep — the
+    # clean (bug switches off) shape check_actor validates.
+    conformance: Callable[[], Tuple[Any, Any]]
+    compiled: bool = False                   # actorc-spec family?
+    # Synthetic fixture families are deliberately schedule-driven: the
+    # fault-free trajectory is seed-invariant, so check_actor's
+    # distinct-seeds-diverge requirement is waived for them.
+    divergent: bool = True
+
+    @property
+    def actor_cls(self) -> type:
+        return self.load()[0]
+
+    @property
+    def config_cls(self) -> type:
+        return self.load()[1]
+
+
+def _raft() -> Family:
+    def load():
+        from .raft_actor import RaftActor, RaftDeviceConfig
+
+        return RaftActor, RaftDeviceConfig
+
+    def conf():
+        from .core import EngineConfig
+
+        cls, cfg = load()
+        return cls(cfg(n=3, n_proposals=2)), EngineConfig(
+            n_nodes=3, outbox_cap=4, queue_cap=64, t_limit_us=2_000_000)
+    return Family("raft", load, conf)
+
+
+def _pb() -> Family:
+    def load():
+        from .pb_actor import PBActor, PBDeviceConfig
+
+        return PBActor, PBDeviceConfig
+
+    def conf():
+        from .core import EngineConfig
+
+        cls, cfg = load()
+        return cls(cfg(n=3, n_writes=3)), EngineConfig(
+            n_nodes=3, outbox_cap=4, queue_cap=64, t_limit_us=2_000_000)
+    return Family("pb", load, conf, compiled=True)
+
+
+def _tpc() -> Family:
+    def load():
+        from .tpc_actor import TPCActor, TPCDeviceConfig
+
+        return TPCActor, TPCDeviceConfig
+
+    def conf():
+        from .core import EngineConfig
+
+        cls, cfg = load()
+        return cls(cfg(n=4, n_txns=4)), EngineConfig(
+            n_nodes=4, outbox_cap=5, queue_cap=64, t_limit_us=2_000_000)
+    return Family("tpc", load, conf, compiled=True)
+
+
+def _paxos() -> Family:
+    def load():
+        from ..actorc.families.paxos import PaxosActor, PaxosConfig
+
+        return PaxosActor, PaxosConfig
+
+    def conf():
+        from ..actorc.families.paxos import engine_config
+
+        cls, cfg = load()
+        acfg = cfg()
+        return cls(acfg), engine_config(acfg)
+    return Family("paxos", load, conf, compiled=True)
+
+
+def _pair_restart() -> Family:
+    def load():
+        from ..triage.synthetic import PairRestartActor, PairRestartConfig
+
+        return PairRestartActor, PairRestartConfig
+
+    def conf():
+        from ..triage.synthetic import engine_config
+
+        cls, cfg = load()
+        acfg = cfg()
+        return cls(acfg), engine_config(acfg)
+    return Family("pair_restart", load, conf, divergent=False)
+
+
+def _guided_pair() -> Family:
+    def load():
+        from ..search.family import GuidedPairActor, GuidedPairConfig
+
+        return GuidedPairActor, GuidedPairConfig
+
+    def conf():
+        from ..search.family import engine_config
+
+        cls, cfg = load()
+        acfg = cfg()
+        return cls(acfg), engine_config(acfg)
+    return Family("guided_pair", load, conf, divergent=False)
+
+
+def actor_families() -> Dict[str, Family]:
+    """name -> :class:`Family`, for every shipped workload family."""
+    fams = [_raft(), _pb(), _tpc(), _paxos(), _pair_restart(),
+            _guided_pair()]
+    return {f.name: f for f in fams}
+
+
+def family(name: str) -> Optional[Family]:
+    return actor_families().get(name)

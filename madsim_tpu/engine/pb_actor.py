@@ -1,48 +1,35 @@
-"""Primary-backup replication actor for the batched device engine.
+"""Primary-backup replication actor — the second workload family, now
+compiled.
 
-The second workload family (alongside :mod:`madsim_tpu.engine.raft_actor`),
-proving the DeviceEngine actor protocol generalizes: a view-based
-primary-backup log (VR/chain-replication style) — the primary of view v is
-node ``v % n``; clients write to the primary, the primary replicates to
-every backup and commits an entry once EVERY replica has acked it (static
-membership, chain-replication-strength durability). There is deliberately
-no retransmission, log repair, or reconfiguration: a replicate lost to a
-dead backup or the network permanently caps the commit index (safety is
-the subject under test, not liveness — madsim worlds are finite). Backups
-that miss the primary's heartbeat long enough start a view change; the
-primary of a view is fixed by construction (``v % n``), so single-primary
-holds definitionally and is not separately checked.
+Since the actor compiler landed (docs/actorc.md), this module holds only
+the config dataclass and a thin wrapper: the protocol lives as a
+declarative spec in :mod:`madsim_tpu.actorc.families.pb`, lowered by
+:class:`~madsim_tpu.actorc.compile.CompiledActor` to the DeviceEngine
+protocol — bit-identical trajectories to the retired hand-written
+implementation (this module's original test suite,
+tests/test_pb_actor.py, runs unchanged). The protocol, its durability
+invariant, and the restart (disk-vs-memory) annotations are documented
+on the spec.
 
-On-device invariant (the bug flag): **durability of committed writes** —
-every entry the old primary reported committed must exist in the new
-primary's log after a failover. The
-``buggy_commit_early`` switch makes the primary commit after the FIRST ack
-instead of all acks; a fault schedule that kills the primary mid-window
-then loses a committed write at failover, and seed sweeps catch it at the
-view change. All state is fixed-shape int32 arrays via the one-hot lane
-helpers (no gather/scatter), exactly like the Raft actor.
+A view-based primary-backup log (VR/chain-replication style): the
+primary of view v is node ``v % n``; writes commit once EVERY replica
+acked. ``buggy_commit_early`` commits after the FIRST ack — a fault
+schedule that kills the primary mid-window then loses a committed write
+at failover, which the durability checker flags at the view change.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import List, NamedTuple, Tuple
 
-import jax
-import jax.numpy as jnp
+from ..actorc.compile import CompiledActor
 
-from .actor_util import bcast_payload, make_outbox, pad_payload
-from .core import EngineConfig, Outbox
-from .lanes import narrow, sel, sel2, upd, upd2, widen
-from .queue import Event, FLAG_TIMER, INF_TIME
-from .rng import DevRng, uniform_u32
-
-# Event kinds.
-K_WRITE = 0        # scheduled client write [cmd] (delivered to all; primary acts)
+# Event kinds (spec declaration order — kept for callers and tests).
+K_WRITE = 0        # scheduled client write [cmd]
 K_REPLICATE = 1    # primary -> backup [view, idx, cmd]
 K_ACK = 2          # backup -> primary [view, idx, backup]
 K_COMMIT = 3       # primary -> backup [view, commit_idx]
-K_HEARTBEAT = 4    # timer on primary [view]
-K_WATCHDOG = 5     # timer on backup [view] — primary silence detector
+K_HEARTBEAT = 4    # timer on primary [view, epoch]
+K_WATCHDOG = 5     # timer on backup [view, epoch]
 NUM_KINDS = 6
 
 
@@ -64,291 +51,11 @@ class PBDeviceConfig:
     buggy_commit_early: bool = False
 
 
-class PBState(NamedTuple):
-    """Lane dtypes follow ``EngineConfig.lanes`` (engine/lanes.py):
-    views/indices/epochs ride the slot lane (i16 packed), log commands
-    the payload lane; ack bitmasks and the wide counters stay i32.
-    Reads widen, writes saturate (the raft actor's discipline)."""
+class PBActor(CompiledActor):
+    """Primary-backup replication, compiled from its actorc spec."""
 
-    view: jnp.ndarray        # (N,) slot lane — each node's current view
-    log_len: jnp.ndarray     # (N,) slot lane
-    log_cmd: jnp.ndarray     # (N, L) payload lane
-    commit: jnp.ndarray      # (N,) slot lane — entries each node knows
-                             # committed
-    acks: jnp.ndarray        # (N, L) i32 bitmask of backup acks (primary rows)
-    wd_epoch: jnp.ndarray    # (N,) slot lane — invalidates stale watchdogs
-    committed_cmd: jnp.ndarray   # (L,) payload lane — globally committed
-                                 # prefix record
-    committed_max: jnp.ndarray   # slot lane — high-water committed index
-    views_changed: jnp.ndarray   # i32
-    writes_done: jnp.ndarray     # i32
+    def __init__(self, pcfg: PBDeviceConfig = PBDeviceConfig()):
+        from ..actorc.families.pb import pb_spec
 
-
-class PBActor:
-    """Primary-backup actor implementing the DeviceEngine protocol."""
-
-    num_kinds = NUM_KINDS
-    kind_names = ["Write", "Replicate", "Ack", "Commit", "Heartbeat",
-                  "Watchdog"]
-
-    def __init__(self, pcfg: PBDeviceConfig):
+        super().__init__(pb_spec(pcfg))
         self.pcfg = pcfg
-
-    # ------------------------------------------------------------------
-    def init(self, cfg: EngineConfig, rng: DevRng
-             ) -> Tuple[PBState, List[Event], DevRng]:
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        if cfg.n_nodes != n:
-            raise ValueError("EngineConfig.n_nodes must match PBDeviceConfig.n")
-        if cfg.m != n + 1:
-            raise ValueError("PBActor needs outbox_cap == n + 1")
-        if cfg.payload_words < 4:
-            raise ValueError("PBActor needs payload_words >= 4")
-        lt = cfg.lanes
-        s = PBState(
-            view=jnp.zeros((n,), lt.slot),
-            log_len=jnp.zeros((n,), lt.slot),
-            log_cmd=jnp.zeros((n, L), lt.payload),
-            commit=jnp.zeros((n,), lt.slot),
-            acks=jnp.zeros((n, L), jnp.int32),
-            wd_epoch=jnp.zeros((n,), lt.slot),
-            committed_cmd=jnp.zeros((L,), lt.payload),
-            committed_max=jnp.zeros((), lt.slot),
-            views_changed=jnp.int32(0),
-            writes_done=jnp.int32(0),
-        )
-        events: List[Event] = []
-        # Primary of view 0 (node 0) heartbeats; backups watch.
-        events.append(Event.make(
-            time=p.heartbeat_us, kind=K_HEARTBEAT,
-            payload_words=cfg.payload_words, flags=FLAG_TIMER,
-            src=0, dst=0, payload=[0]))
-        for i in range(1, n):
-            delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
-            events.append(Event.make(
-                time=delay, kind=K_WATCHDOG, payload_words=cfg.payload_words,
-                flags=FLAG_TIMER, src=i, dst=i, payload=[0, 0]))
-        for w in range(p.n_writes):
-            t = p.write_start_us + w * p.write_interval_us
-            for i in range(n):  # broadcast; only the current primary acts
-                events.append(Event.make(
-                    time=t, kind=K_WRITE, payload_words=cfg.payload_words,
-                    src=i, dst=i, payload=[w + 1]))
-        return s, events, rng
-
-    # ------------------------------------------------------------------
-    def on_restart(self, cfg: EngineConfig, s: PBState, node, now, rng: DevRng
-                   ) -> Tuple[PBState, Outbox, DevRng]:
-        p = self.pcfg
-        n = p.n
-        me = jnp.clip(node, 0, n - 1)
-        # Log and commit are persistent (disk); view is too. Volatile ack
-        # bookkeeping resets; the watchdog re-arms.
-        epoch2 = widen(sel(s.wd_epoch, me)) + 1
-        s2 = s._replace(
-            acks=upd(s.acks, me, jnp.zeros((p.log_cap,), jnp.int32)),
-            wd_epoch=upd(s.wd_epoch, me, epoch2),
-        )
-        delay, rng = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
-        ob = self._outbox(
-            cfg,
-            msg_valid=jnp.zeros((n,), bool),
-            msg_kind=jnp.zeros((n,), jnp.int32),
-            msg_payload=jnp.zeros((n, cfg.payload_words), jnp.int32),
-            timer_valid=jnp.asarray(True), timer_kind=jnp.int32(K_WATCHDOG),
-            timer_dst=me, timer_delay=delay,
-            timer_payload=self._pad(cfg, [widen(sel(s2.view, me)), epoch2]))
-        return s2, ob, rng
-
-    # ------------------------------------------------------------------
-    def handle(self, cfg: EngineConfig, s: PBState, ev: Event, now, rng: DevRng
-               ) -> Tuple[PBState, Outbox, DevRng, jnp.ndarray]:
-        """Merged handler (same rationale as RaftActor.handle: under vmap a
-        switch runs every branch for every world, so shared work — views,
-        log row reads, outbox assembly, the watchdog-delay draw — is
-        computed once and combined with kind-masked writes). Bit-identical
-        to the former six-branch ``lax.switch`` (verified state-for-state
-        over fault-schedule workloads with the bug switch on and off)."""
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        kind = jnp.clip(ev.kind, 0, NUM_KINDS - 1)
-        me = jnp.clip(ev.dst, 0, n - 1)
-        pl = ev.payload
-        is_w = kind == K_WRITE
-        is_rep = kind == K_REPLICATE
-        is_ack = kind == K_ACK
-        is_cm = kind == K_COMMIT
-        is_hb = kind == K_HEARTBEAT
-        is_wd = kind == K_WATCHDOG
-
-        # Narrow-lane reads widen to i32 (the wide-in-flight discipline,
-        # engine/lanes.py); writes saturate back through upd/upd2.
-        view_me = widen(sel(s.view, me))
-        llen = widen(sel(s.log_len, me))
-        epoch_me = widen(sel(s.wd_epoch, me))
-        commit_me = widen(sel(s.commit, me))
-        arange_n = jnp.arange(n)
-        i_am_primary = me == self._primary_of(view_me)
-
-        # One watchdog-delay draw serves replicate and watchdog (same
-        # range, same counter); the counter advances only for those kinds.
-        delay, rng_drawn = uniform_u32(rng, p.watchdog_min_us, p.watchdog_max_us)
-        rng = rng._replace(counter=jnp.where(is_rep | is_wd,
-                                             rng_drawn.counter, rng.counter))
-
-        # -- write (primary appends) --
-        accept = is_w & i_am_primary & (llen < L)
-        pos_w = jnp.clip(llen, 0, L - 1)
-        llen_w = llen + accept.astype(jnp.int32)
-
-        # -- replicate (backup appends in order, adopts view) --
-        v_rep, idx_rep, cmd_rep = pl[0], pl[1], pl[2]
-        current = is_rep & (v_rep >= view_me)
-        view_rep = jnp.maximum(view_me, jnp.where(is_rep, v_rep, view_me))
-        in_order = current & (idx_rep == llen + 1) & (idx_rep <= L)
-        pos_r = jnp.clip(idx_rep - 1, 0, L - 1)
-
-        # -- ack (primary counts; commit on quorum) --
-        backup = jnp.clip(pl[2], 0, n - 1)
-        live_ack = is_ack & (pl[0] == view_me) & i_am_primary & \
-            (pl[1] >= 1) & (pl[1] <= L)
-        pos_a = jnp.clip(pl[1] - 1, 0, L - 1)
-        acks2 = sel2(s.acks, me, pos_a) | jnp.where(live_ack, 1 << backup, 0)
-        if p.buggy_commit_early:
-            # THE BUG: one ack is "enough". A fault schedule that kills
-            # the primary before the rest replicate loses the entry.
-            quorum = jax.lax.population_count(acks2) >= 2
-        else:
-            quorum = acks2 == jnp.int32((1 << n) - 1)
-        committed = live_ack & quorum & (pl[1] > commit_me)
-        commit_a = jnp.where(committed, pl[1], commit_me)
-        krange = jnp.arange(L)
-        fill = committed & (krange >= commit_me) & (krange < pl[1])
-
-        # -- commit message (backup adopts commit index) --
-        cm_current = is_cm & (pl[0] >= view_me)
-        commit_c = jnp.where(cm_current,
-                             jnp.maximum(commit_me, jnp.minimum(pl[1], llen)),
-                             commit_me)
-
-        # -- heartbeat --
-        live_hb = is_hb & (pl[0] == view_me) & i_am_primary
-
-        # -- watchdog (view change) --
-        epoch_ok = is_wd & (pl[1] == epoch_me)
-        fire = epoch_ok & ~(pl[0] < view_me) & ~i_am_primary
-        cand = view_me + ((me - self._primary_of(view_me)) % n + n) % n
-        view_wd = jnp.where(fire, jnp.maximum(cand, view_me + 1), view_me)
-        became_primary = fire & (me == self._primary_of(view_wd))
-
-        # -- combined single-position log/acks writes --
-        pos = jnp.where(is_rep, pos_r, jnp.where(is_ack, pos_a, pos_w))
-        cmd_at = widen(sel2(s.log_cmd, me, pos))
-        ack_at = sel2(s.acks, me, pos)
-        log_cmd_new = jnp.where(in_order, cmd_rep,
-                                jnp.where(accept, pl[0], cmd_at))
-        acks_new = jnp.where(is_ack, acks2,
-                             jnp.where(accept, 1 << me, ack_at))
-
-        view2 = jnp.where(is_rep, view_rep, jnp.where(is_wd, view_wd, view_me))
-        epoch2 = epoch_me + current.astype(jnp.int32) + fire.astype(jnp.int32)
-
-        s2 = s._replace(
-            view=upd(s.view, me, view2),
-            log_cmd=upd2(s.log_cmd, me, pos, log_cmd_new),
-            log_len=upd(s.log_len, me, jnp.where(
-                in_order, idx_rep, jnp.where(is_w, llen_w, llen))),
-            acks=upd2(s.acks, me, pos, acks_new),
-            commit=upd(s.commit, me, jnp.where(
-                is_ack, commit_a, jnp.where(is_cm, commit_c, commit_me))),
-            wd_epoch=upd(s.wd_epoch, me, jnp.where(
-                is_rep | is_wd, epoch2, epoch_me)),
-            # Same-dtype payload-lane select (no widen needed); the
-            # high-water index is a direct _replace, so it narrows
-            # explicitly rather than through upd.
-            committed_cmd=jnp.where(fill, sel(s.log_cmd, me), s.committed_cmd),
-            committed_max=narrow(
-                jnp.maximum(widen(s.committed_max),
-                            jnp.where(committed, pl[1], 0)),
-                s.committed_max.dtype),
-            views_changed=s.views_changed + fire.astype(jnp.int32),
-            writes_done=s.writes_done + accept.astype(jnp.int32),
-        )
-
-        # -- combined outbox --
-        primary_rep = self._primary_of(view_rep)
-        msg_valid = jnp.where(
-            is_rep, in_order & (arange_n == primary_rep),
-            jnp.where(is_ack, committed & (arange_n != me),
-                      (accept | live_hb | became_primary) & (arange_n != me)))
-        msg_kind = jnp.full((n,), jnp.where(
-            is_rep, K_ACK, jnp.where(is_ack, K_COMMIT, K_REPLICATE)),
-            jnp.int32)
-        w0 = jnp.where(is_rep | is_wd, view2, view_me)
-        w1 = jnp.where(is_w, llen_w,
-                       jnp.where(is_rep, idx_rep,
-                                 jnp.where(is_ack, commit_a, 0)))
-        w2 = jnp.where(is_w, pl[0], jnp.where(is_rep, me, 0))
-        msg_payload = self._bcast(cfg, [w0, w1, w2, 0])
-
-        timer_valid = current | live_hb | epoch_ok | fire
-        hb_timer = live_hb | became_primary
-        ob = self._outbox(
-            cfg,
-            msg_valid=msg_valid, msg_kind=msg_kind, msg_payload=msg_payload,
-            timer_valid=timer_valid,
-            timer_kind=jnp.where(hb_timer, K_HEARTBEAT,
-                                 K_WATCHDOG).astype(jnp.int32),
-            timer_dst=me,
-            timer_delay=jnp.where(hb_timer, jnp.int32(p.heartbeat_us),
-                                  delay).astype(jnp.int32),
-            timer_payload=self._pad(cfg, [
-                jnp.where(is_rep | is_wd, view2, view_me),
-                jnp.where(is_rep | is_wd, epoch2, 0)]))
-        return s2, ob, rng, jnp.asarray(False)
-
-    # ------------------------------------------------------------------
-    def invariant(self, cfg: EngineConfig, s: PBState) -> jnp.ndarray:
-        """Durability: the current primary's log must contain every entry
-        ever reported committed, verbatim."""
-        p = self.pcfg
-        n, L = p.n, p.log_cap
-        primary = widen(jnp.max(s.view)) % n
-        k = jnp.arange(L)
-        mask = k < widen(s.committed_max)
-        plog = sel(s.log_cmd, primary)                    # (L,) payload lane
-        plen = widen(sel(s.log_len, primary))
-        missing = jnp.any(mask & ((k >= plen) | (plog != s.committed_cmd)))
-        return missing
-
-    # ------------------------------------------------------------------
-    def observe(self, cfg: EngineConfig, s: PBState) -> dict:
-        # Called on BATCHED state (leading world axis): node-axis
-        # reductions must keep the world axis (axis=-1), unlike
-        # invariant(), which runs per-world under vmap.
-        return {
-            "max_view": jnp.max(s.view, axis=-1),
-            "views_changed": s.views_changed,
-            "committed_max": s.committed_max,
-            "writes_done": s.writes_done,
-            "min_commit": jnp.min(s.commit, axis=-1),
-        }
-
-    # ==================================================================
-    # Helpers (same layout discipline as the Raft actor)
-    # ==================================================================
-    def _primary_of(self, view):
-        return view % jnp.int32(self.pcfg.n)
-
-    # ==================================================================
-    # Helpers (same layout discipline as the Raft actor)
-    # ==================================================================
-    def _bcast(self, cfg, words):
-        return bcast_payload(cfg, self.pcfg.n, words)
-
-    def _pad(self, cfg, words) -> jnp.ndarray:
-        return pad_payload(cfg, words)
-
-    def _outbox(self, cfg, *args, **kwargs) -> Outbox:
-        return make_outbox(cfg, self.pcfg.n, *args, **kwargs)

@@ -1,42 +1,28 @@
-"""Two-phase-commit actor: the third device-engine workload family.
+"""Two-phase-commit actor — the third workload family, now compiled.
 
-Alongside consensus (:mod:`madsim_tpu.engine.raft_actor`) and primary-backup
-replication (:mod:`madsim_tpu.engine.pb_actor`), this covers the third
-classic distributed-systems protocol class: atomic commitment. Node 0 is
-the transaction coordinator; nodes 1..n-1 are participants. Transactions
-arrive on a schedule at the coordinator, which runs textbook 2PC: PREPARE
-to every participant, collect votes, COMMIT iff every vote is yes, ABORT
-otherwise or on timeout. A participant that votes no aborts unilaterally
-(it holds no locks for a transaction it rejected); one that votes yes is
-*blocked* until the coordinator's decision arrives — 2PC's famous blocking
-window, which fault schedules (coordinator kill, partitions) make visible
-in the ``blocked`` observable.
+Since the actor compiler landed (docs/actorc.md), this module holds only
+the config dataclass and a thin wrapper: the protocol itself lives as a
+declarative spec in :mod:`madsim_tpu.actorc.families.tpc`, and
+:class:`~madsim_tpu.actorc.compile.CompiledActor` lowers it to the
+DeviceEngine protocol — same lanes at the same packed dtypes (now
+derived from declared ranges), same merged-handler dispatch, same
+single ``make_outbox`` assembly, bit-identical trajectories to the
+retired hand-written implementation (this module's original test suite,
+tests/test_tpc_actor.py, runs unchanged). The protocol description and
+the atomicity invariant are documented on the spec.
 
-On-device invariant (the bug flag): **atomicity** — no transaction may be
-applied as COMMIT at one node and ABORT at another. The
-``buggy_presumed_commit`` switch makes the coordinator decide COMMIT on
-vote timeout (the "presumed commit" shortcut applied where it is unsound):
-a participant whose no-vote (or whose PREPARE) was lost to the network
-then aborts unilaterally while everyone else commits, and seed sweeps
-catch the divergence at apply time.
-
-All state is fixed-shape int32 via the one-hot lane helpers; the handler is
-merged (kind-masked writes, one outbox build) per docs/ACTORS.md.
+Node 0 is the transaction coordinator; 1..n-1 participate. PREPARE /
+VOTE / DECIDE with a vote timeout; ``buggy_presumed_commit`` decides
+COMMIT on timeout (the "presumed commit" shortcut applied where it is
+unsound) and seed sweeps catch the atomicity divergence at apply time.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import List, NamedTuple, Tuple
 
-import jax.numpy as jnp
+from ..actorc.compile import CompiledActor
 
-from .actor_util import bcast_payload, make_outbox, pad_payload
-from .core import EngineConfig, Outbox
-from .lanes import sel, sel2, upd, upd2, widen
-from .queue import Event
-from .rng import DevRng, next_u32
-
-# Event kinds.
+# Event kinds (spec declaration order — kept for callers and tests).
 K_TXN = 0       # scheduled at the coordinator [txn]
 K_PREPARE = 1   # coord -> participant [txn]
 K_VOTE = 2      # participant -> coord [txn, yes, voter]
@@ -66,191 +52,11 @@ class TPCDeviceConfig:
     buggy_presumed_commit: bool = False
 
 
-class TPCState(NamedTuple):
-    """Decision/vote codes ride the i8 code lane under the packed
-    profile (``EngineConfig.lanes``); the yes-bitmask and counters stay
-    i32. Reads widen, writes saturate (the raft actor's discipline)."""
+class TPCActor(CompiledActor):
+    """Two-phase commit, compiled from its actorc spec."""
 
-    decision: jnp.ndarray    # (N, T) code lane — applied outcome per node
-    voted: jnp.ndarray       # (N, T) code lane — participant's sent vote
-                             # (NONE/COMMIT=yes/ABORT=no)
-    votes_yes: jnp.ndarray   # (T,) i32 — coordinator's yes bitmask
-    decided: jnp.ndarray     # (T,) code lane — coordinator's decision record
-    txns_seen: jnp.ndarray   # i32
-    commits: jnp.ndarray     # i32 — coordinator-side COMMIT decisions
-    aborts: jnp.ndarray      # i32
+    def __init__(self, tcfg: TPCDeviceConfig = TPCDeviceConfig()):
+        from ..actorc.families.tpc import tpc_spec
 
-
-class TPCActor:
-    """Two-phase commit implementing the DeviceEngine actor protocol."""
-
-    num_kinds = NUM_KINDS
-    kind_names = ["Txn", "Prepare", "Vote", "Decide", "Timeout"]
-
-    def __init__(self, tcfg: TPCDeviceConfig):
+        super().__init__(tpc_spec(tcfg))
         self.tcfg = tcfg
-
-    # ------------------------------------------------------------------
-    def init(self, cfg: EngineConfig, rng: DevRng
-             ) -> Tuple[TPCState, List[Event], DevRng]:
-        t = self.tcfg
-        n, T = t.n, t.n_txns
-        if cfg.n_nodes != n:
-            raise ValueError("EngineConfig.n_nodes must match TPCDeviceConfig.n")
-        if cfg.m != n + 1:
-            raise ValueError("TPCActor needs outbox_cap == n + 1")
-        if cfg.payload_words < 3:
-            raise ValueError("TPCActor needs payload_words >= 3")
-        if n < 2 or n > 31:
-            raise ValueError("TPCActor needs 2..31 nodes (int32 vote bitmask)")
-        lt = cfg.lanes
-        s = TPCState(
-            decision=jnp.zeros((n, T), lt.code),
-            voted=jnp.zeros((n, T), lt.code),
-            votes_yes=jnp.zeros((T,), jnp.int32),
-            decided=jnp.zeros((T,), lt.code),
-            txns_seen=jnp.int32(0),
-            commits=jnp.int32(0),
-            aborts=jnp.int32(0),
-        )
-        events = [Event.make(
-            time=t.txn_start_us + i * t.txn_interval_us, kind=K_TXN,
-            payload_words=cfg.payload_words, src=COORD, dst=COORD,
-            payload=[i]) for i in range(t.n_txns)]
-        return s, events, rng
-
-    # ------------------------------------------------------------------
-    def on_restart(self, cfg: EngineConfig, s: TPCState, node, now, rng: DevRng
-                   ) -> Tuple[TPCState, Outbox, DevRng]:
-        # Decisions, votes, and the coordinator's decision log are durable
-        # (the 2PC write-ahead records); the coordinator's in-flight yes
-        # bitmasks for UNdecided txns are volatile — those txns stay
-        # pending until their timeout fires (or forever if it already
-        # did: the blocking window).
-        volatile = (s.decided == NONE)
-        s2 = s._replace(
-            votes_yes=jnp.where((node == COORD) & volatile, 0, s.votes_yes))
-        return s2, Outbox.empty(cfg), rng
-
-    # ------------------------------------------------------------------
-    def handle(self, cfg: EngineConfig, s: TPCState, ev: Event, now, rng: DevRng
-               ) -> Tuple[TPCState, Outbox, DevRng, jnp.ndarray]:
-        t = self.tcfg
-        n, T = t.n, t.n_txns
-        kind = jnp.clip(ev.kind, 0, NUM_KINDS - 1)
-        me = jnp.clip(ev.dst, 0, n - 1)
-        txn = jnp.clip(ev.payload[0], 0, T - 1)
-        arange_n = jnp.arange(n)
-        is_txn = kind == K_TXN
-        is_prep = kind == K_PREPARE
-        is_vote = kind == K_VOTE
-        is_dec = kind == K_DECIDE
-        is_to = kind == K_TIMEOUT
-
-        at_coord = me == COORD
-        # Narrow-lane reads widen to i32 (engine/lanes.py discipline).
-        decided_t = widen(sel(s.decided, txn))
-
-        # One draw per step (static shape); only PREPARE consumes it.
-        u, rng_drawn = next_u32(rng)
-        rng = rng._replace(counter=jnp.where(is_prep, rng_drawn.counter,
-                                             rng.counter))
-
-        # -- K_TXN (coordinator): start 2PC for txn --
-        start = is_txn & at_coord & (decided_t == NONE)
-
-        # -- K_PREPARE (participant): vote once, abort locally on no --
-        my_vote = widen(sel2(s.voted, me, txn))
-        fresh = is_prep & ~at_coord & (my_vote == NONE) & \
-            (widen(sel2(s.decision, me, txn)) == NONE)
-        vote_no = (u % jnp.uint32(256)) < jnp.uint32(t.no_vote_num)
-        vote_val = jnp.where(vote_no, ABORT, COMMIT)  # ABORT code == "no"
-        # A no-voter aborts unilaterally at vote time.
-        abort_local = fresh & vote_no
-
-        # -- K_VOTE (coordinator): collect; all-yes => COMMIT --
-        voter = jnp.clip(ev.payload[2], 0, n - 1)
-        yes = ev.payload[1] == 1
-        live_vote = is_vote & at_coord & (decided_t == NONE)
-        mask_all = jnp.int32((1 << n) - 2)  # bits 1..n-1
-        yes2 = sel(s.votes_yes, txn) | jnp.where(
-            live_vote & yes, 1 << voter, 0)
-        all_yes = live_vote & (yes2 == mask_all)
-        any_no = live_vote & ~yes
-        # -- K_TIMEOUT (coordinator): decide for the stragglers --
-        fire_to = is_to & at_coord & (decided_t == NONE)
-        to_decision = COMMIT if t.buggy_presumed_commit else ABORT
-
-        decide_now = all_yes | any_no | fire_to
-        decision_val = jnp.where(all_yes, COMMIT,
-                                 jnp.where(any_no, ABORT,
-                                           jnp.int32(to_decision)))
-
-        # -- K_DECIDE (participant): apply, unless it aborted unilaterally
-        # and the coordinator says COMMIT — that conflict IS the apply-time
-        # state; the invariant reads it.
-        applied = widen(sel2(s.decision, me, txn))
-        apply_dec = is_dec & ~at_coord & (applied == NONE)
-
-        # -- state writes (one per field) --
-        dec_mine = jnp.where(
-            abort_local, ABORT,
-            jnp.where(apply_dec, ev.payload[1],
-                      jnp.where(decide_now & at_coord, decision_val, applied)))
-        write_dec = abort_local | apply_dec | (decide_now & at_coord)
-        s2 = s._replace(
-            decision=upd2(s.decision, me, txn,
-                          jnp.where(write_dec, dec_mine, applied)),
-            voted=upd2(s.voted, me, txn, jnp.where(fresh, vote_val, my_vote)),
-            votes_yes=upd(s.votes_yes, txn, yes2),
-            decided=upd(s.decided, txn,
-                        jnp.where(decide_now, decision_val, decided_t)),
-            txns_seen=s.txns_seen + start.astype(jnp.int32),
-            commits=s.commits
-            + (decide_now & (decision_val == COMMIT)).astype(jnp.int32),
-            aborts=s.aborts
-            + (decide_now & (decision_val == ABORT)).astype(jnp.int32),
-        )
-
-        # -- outbox --
-        participants = arange_n != COORD
-        msg_valid = jnp.where(
-            start, participants,
-            jnp.where(fresh, arange_n == COORD,
-                      jnp.where(decide_now, participants,
-                                jnp.zeros((n,), bool))))
-        msg_kind = jnp.full((n,), jnp.where(
-            start, K_PREPARE, jnp.where(fresh, K_VOTE, K_DECIDE)), jnp.int32)
-        w1 = jnp.where(fresh, (vote_val == COMMIT).astype(jnp.int32),
-                       jnp.where(decide_now, decision_val, 0))
-        payload = bcast_payload(cfg, n, [txn, w1, me])
-        ob = make_outbox(
-            cfg, n,
-            msg_valid=msg_valid, msg_kind=msg_kind, msg_payload=payload,
-            timer_valid=start, timer_kind=jnp.int32(K_TIMEOUT),
-            timer_dst=jnp.int32(COORD),
-            timer_delay=jnp.int32(t.vote_timeout_us),
-            timer_payload=pad_payload(cfg, [txn]),
-        )
-        return s2, ob, rng, jnp.asarray(False)
-
-    # ------------------------------------------------------------------
-    def invariant(self, cfg: EngineConfig, s: TPCState) -> jnp.ndarray:
-        """Atomicity: no txn both committed and aborted across nodes."""
-        committed = jnp.any(s.decision == COMMIT, axis=0)  # (T,)
-        aborted = jnp.any(s.decision == ABORT, axis=0)     # (T,)
-        return jnp.any(committed & aborted)
-
-    # ------------------------------------------------------------------
-    def observe(self, cfg: EngineConfig, s: TPCState) -> dict:
-        # Batched state: node axis is -2, txn axis is -1.
-        applied = s.decision[..., 1:, :]  # participants only
-        return {
-            "txns_seen": s.txns_seen,
-            "commits": s.commits,
-            "aborts": s.aborts,
-            "blocked": jnp.sum(
-                jnp.any((s.voted[..., 1:, :] == COMMIT)
-                        & (applied == NONE), axis=-2).astype(jnp.int32),
-                axis=-1),
-        }

@@ -43,22 +43,15 @@ from .timeline import dump_chrome, render_text, trace_to_chrome
 
 
 def _actor_registry() -> Dict[str, tuple]:
-    from ..engine import (PBActor, PBDeviceConfig, RaftActor,
-                          RaftDeviceConfig, TPCActor, TPCDeviceConfig)
-    from ..search.family import GuidedPairActor, GuidedPairConfig
-    from ..triage.synthetic import PairRestartActor, PairRestartConfig
+    # One shared family table (engine/families.py): the replay CLI,
+    # triage's bundle naming, and the all-families conformance test all
+    # read the same registry, so a new family — hand-written or
+    # actorc-compiled — registers once and replays/triages/validates
+    # everywhere.
+    from ..engine.families import actor_families
 
-    return {
-        "raft": (RaftActor, RaftDeviceConfig),
-        "pb": (PBActor, PBDeviceConfig),
-        "tpc": (TPCActor, TPCDeviceConfig),
-        # The triage fixture actor (triage/synthetic.py): minimized
-        # corpus bundles from tests/demos replay through the same CLI.
-        "pair_restart": (PairRestartActor, PairRestartConfig),
-        # The guided-hunt family (search/family.py): bundles triaged out
-        # of a guided sweep (`make fuzz-demo`) replay the same way.
-        "guided_pair": (GuidedPairActor, GuidedPairConfig),
-    }
+    return {name: (fam.actor_cls, fam.config_cls)
+            for name, fam in actor_families().items()}
 
 
 def _replay_device(seed: int, actor_name: str, actor_config: Dict[str, Any],

@@ -23,6 +23,19 @@ gate asks for:
   the bugs-at-budget gate (first-bug ties are expected here: both modes
   share generation-1 children by construction, and the residual
   seed-dependent collision floor is reachable by either).
+
+- **paxos** — the first actorc-compiled DSL-only family
+  (docs/actorc.md): multi-decree Paxos with forgetful acceptors
+  (``PaxosConfig(buggy_forgetful_acceptor=True)`` flips ONE
+  ``durable`` annotation — the textbook stable-storage violation).
+  Every decree is contended, so each opens a ~20 ms amnesia window
+  between the first proposer's accept-quorum and the rival's
+  promise-quorum; the consistency violation needs TWO restarts
+  jittered from the benign early template into a window (one
+  in-window restart violates ~1%/seed, two up to ~7%), while one
+  in-window restart already perturbs rounds visibly — the staircase.
+  Measured: guided reaches the conflict at seed ~191 where random
+  finds nothing in 512 (``make actorc-demo``).
 """
 from __future__ import annotations
 
@@ -102,5 +115,30 @@ def raft_hunt() -> Hunt:
         template=template,
         search=search,
         sweep_kw=dict(recycle=True, batch_worlds=32, chunk_steps=64,
+                      max_steps=50_000_000),
+    )
+
+
+def paxos_hunt() -> Hunt:
+    """The multi-decree Paxos forgetful-acceptor hunt — the first
+    DSL-only family leg (see module docstring for the staircase
+    shape; tuning measured in actorc/families/paxos.py)."""
+    from ..actorc.families.paxos import (PaxosActor, PaxosConfig,
+                                         engine_config, hunt_template)
+
+    xcfg = PaxosConfig(buggy_forgetful_acceptor=True, contend_all=True)
+
+    def search(guided: bool = True) -> SearchConfig:
+        return SearchConfig(corpus=32, guided=guided, splice_pct=20,
+                            disable_pct=5, time_pct=40, node_pct=15,
+                            op_pct=5, time_jitter_us=60_000)
+
+    return Hunt(
+        name="paxos_forgetful_acceptor",
+        actor=PaxosActor(xcfg),
+        cfg=engine_config(xcfg, metrics=True),
+        template=hunt_template(xcfg),
+        search=search,
+        sweep_kw=dict(recycle=True, batch_worlds=32, chunk_steps=32,
                       max_steps=50_000_000),
     )

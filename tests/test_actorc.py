@@ -1,0 +1,317 @@
+"""Actor-compiler tests (madsim_tpu/actorc/, docs/actorc.md).
+
+The tier-1 gates of the subsystem:
+
+- host-twin parity: the generated plain-Python interpreter agrees with
+  the compiled device actor on per-event state/outbox/bug decisions
+  over sampled faulted trajectories, for the migrated families (tpc,
+  pb) AND the DSL-only one (paxos) — and the oracle actually CATCHES a
+  backend divergence when one is planted;
+- spec validation: the packed-width guards and malformed declarations
+  surface as pointed SpecErrors naming the offending lane/message/word,
+  never as deep trace-time failures;
+- lowering contracts: dtype selection from declared ranges, generated
+  kind_names rendering in traces, the one-draw discipline, restart
+  (disk-vs-memory) annotations;
+- the Paxos family itself: clean runs are safe, the forgetful-acceptor
+  bug is reachable through well-placed restarts only.
+"""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu.actorc import (
+    ActorSpec, CompiledActor, HostActor, HostTwinMismatch, Lane, Message,
+    SpecError, Word, crosscheck,
+)
+from madsim_tpu.actorc.spec import lane_dtype, validate_spec
+from madsim_tpu.engine import DeviceEngine, EngineConfig
+from madsim_tpu.engine.core import FAULT_KILL, FAULT_RESTART
+from madsim_tpu.engine.lanes import PACKED, WIDE
+
+
+# ---------------------------------------------------------------------------
+# A minimal well-formed spec the validation tests mutate.
+# ---------------------------------------------------------------------------
+def _ping_spec(n=3, hi=100, word_hi=100, name="ping"):
+    def h_ping(c):
+        cnt = c.read("count")
+        c.write("count", cnt + 1)
+        c.send("Ping", dst=(c.me + 1) % n, words=[c.arg("x")],
+               when=cnt < 5)
+
+    def init(c):
+        c.event("Ping", time=10, dst=0, words=[1])
+
+    return ActorSpec(
+        name=name, n_nodes=n,
+        lanes=(Lane("count", hi=hi),),
+        messages=(Message("Ping", (Word("x", 0, word_hi),)),),
+        handlers={"Ping": h_ping},
+        init=init,
+        invariant=lambda v: v.np.any(v.lane("count") > 1_000_000),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-twin parity — the conformance oracle (acceptance criterion).
+# ---------------------------------------------------------------------------
+def test_host_twin_parity_tpc():
+    from madsim_tpu.actorc.families.tpc import tpc_spec
+    from madsim_tpu.engine import TPCDeviceConfig
+
+    tcfg = TPCDeviceConfig(n=4, n_txns=4, buggy_presumed_commit=True)
+    cfg = EngineConfig(n_nodes=4, outbox_cap=5, queue_cap=64,
+                       t_limit_us=2_000_000, loss_rate=0.1)
+    faults = np.array([[200_000, FAULT_KILL, 0, 0],
+                       [500_000, FAULT_RESTART, 0, 0]], np.int32)
+    rep = crosscheck(tpc_spec(tcfg), cfg, seeds=[0, 3], faults=faults,
+                     max_steps=250)
+    assert rep["events_delivered"] > 20
+    assert rep["restarts"] >= 1
+
+
+def test_host_twin_parity_pb():
+    from madsim_tpu.actorc.families.pb import pb_spec
+    from madsim_tpu.engine import PBDeviceConfig
+
+    pcfg = PBDeviceConfig(n=3, n_writes=3, buggy_commit_early=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=48,
+                       t_limit_us=2_000_000, loss_rate=0.2)
+    faults = np.array([[130_000, FAULT_KILL, 0, 0],
+                       [900_000, FAULT_RESTART, 0, 0]], np.int32)
+    # pb's on_restart draws (watchdog re-arm): the restart leg checks
+    # the recorded-entropy path of the twin.
+    rep = crosscheck(pb_spec(pcfg), cfg, seeds=[0, 9], faults=faults,
+                     max_steps=250)
+    assert rep["events_delivered"] > 20
+    assert rep["restarts"] >= 1
+
+
+def test_host_twin_parity_paxos():
+    from madsim_tpu.actorc.families.paxos import (PaxosConfig,
+                                                  engine_config,
+                                                  paxos_spec)
+
+    xcfg = PaxosConfig(buggy_forgetful_acceptor=True, contend_all=True)
+    faults = np.array([[80_000, FAULT_RESTART, 2, 0]], np.int32)
+    rep = crosscheck(paxos_spec(xcfg), engine_config(xcfg),
+                     seeds=[0, 1, 5], faults=faults, max_steps=250)
+    assert rep["events_delivered"] > 30
+
+
+def test_host_twin_catches_backend_divergence():
+    """The oracle is only worth its compile time if it FAILS when the
+    two backends disagree: plant a transition that writes different
+    values under jnp and numpy."""
+    spec = _ping_spec()
+
+    def evil(c):
+        # Branches on the backend — exactly the kind of out-of-surface
+        # behavior the crosscheck exists to catch.
+        val = 1 if c.np is jnp else 2
+        c.write("count", c.read("count") + val)
+
+    spec = dataclasses.replace(spec, handlers={"Ping": evil})
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=16,
+                       t_limit_us=1_000_000)
+    with pytest.raises(HostTwinMismatch, match="count"):
+        crosscheck(spec, cfg, seeds=[0], max_steps=10)
+
+
+# ---------------------------------------------------------------------------
+# Spec validation: pointed errors, not trace-time failures.
+# ---------------------------------------------------------------------------
+def test_packed_n_nodes_guard_names_the_spec():
+    spec = _ping_spec(n=200, name="wide_ping")
+    # EngineConfig itself refuses packed 200-node clusters (its own
+    # pointed guard), so reaching the SPEC-level guard needs a config
+    # stand-in — validate_spec must still name the spec and the escape
+    # hatch rather than deferring to a trace-time failure.
+    fake = type("Cfg", (), {"n_nodes": 200, "packed": True, "m": 201,
+                            "payload_words": 8})()
+    with pytest.raises(SpecError, match="wide_ping.*n_nodes=200.*int8"):
+        validate_spec(spec, fake)
+    # The wide profile accepts the same spec end to end.
+    validate_spec(spec, EngineConfig(n_nodes=200, outbox_cap=201,
+                                     queue_cap=16, t_limit_us=1_000_000,
+                                     packed=False))
+    # And a spec/config width mismatch is a SpecError naming both.
+    with pytest.raises(SpecError, match="n_nodes=200.*n_nodes=3"):
+        validate_spec(spec, EngineConfig(n_nodes=3, outbox_cap=4,
+                                         queue_cap=16,
+                                         t_limit_us=1_000_000))
+
+
+def test_payload_word_overflow_names_message_and_word():
+    spec = _ping_spec(word_hi=100_000)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=16,
+                       t_limit_us=1_000_000)
+    with pytest.raises(SpecError,
+                       match="'Ping'.*'x'.*100000.*int16"):
+        validate_spec(spec, cfg)
+    # ...and the same guard fires through the engine path before any
+    # trace-time failure could.
+    eng = DeviceEngine(CompiledActor(spec), cfg)
+    with pytest.raises(SpecError, match="'Ping'.*'x'"):
+        eng.init(np.arange(2))
+
+
+def test_outbox_capacity_guard():
+    spec = _ping_spec()
+    cfg = EngineConfig(n_nodes=3, outbox_cap=6, queue_cap=16,
+                       t_limit_us=1_000_000)
+    with pytest.raises(SpecError, match="n_nodes \\+ 1 = 4, got 6"):
+        validate_spec(spec, cfg)
+
+
+def test_malformed_specs_are_pointed():
+    base = _ping_spec()
+    with pytest.raises(SpecError, match="unknown message 'Pong'"):
+        CompiledActor(dataclasses.replace(
+            base, handlers={"Pong": lambda c: None}))
+    with pytest.raises(SpecError, match="inverted"):
+        CompiledActor(dataclasses.replace(
+            base, lanes=(Lane("count", lo=5, hi=2),)))
+    with pytest.raises(SpecError, match="duplicate lane"):
+        CompiledActor(dataclasses.replace(
+            base, lanes=(Lane("count", hi=1), Lane("count", hi=1))))
+    with pytest.raises(SpecError, match="on_restart hook"):
+        CompiledActor(dataclasses.replace(
+            base, lanes=(Lane("g", hi=5, scope="world",
+                              durable=False),)))
+    with pytest.raises(SpecError, match="counter lanes"):
+        spec = dataclasses.replace(
+            base, handlers={"Ping": lambda c: c.count("count")})
+        cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=16,
+                           t_limit_us=1_000_000)
+        eng = DeviceEngine(CompiledActor(spec), cfg)
+        eng.run(eng.init(np.arange(2)), max_steps=1)
+
+
+def test_one_draw_per_transition_rule():
+    spec = _ping_spec()
+
+    def greedy(c):
+        c.uniform(0, 10)
+        c.uniform(0, 10)  # the second draw violates the static rule
+
+    spec = dataclasses.replace(spec, handlers={"Ping": greedy})
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=16,
+                       t_limit_us=1_000_000)
+    with pytest.raises(SpecError, match="at most\\s+once per event"):
+        eng = DeviceEngine(CompiledActor(spec), cfg)
+        eng.run(eng.init(np.arange(2)), max_steps=1)
+
+
+# ---------------------------------------------------------------------------
+# Lowering contracts.
+# ---------------------------------------------------------------------------
+def test_lane_dtype_from_declared_ranges():
+    assert lane_dtype(Lane("a", hi=100), PACKED) == jnp.int8
+    assert lane_dtype(Lane("a", hi=30_000), PACKED) == jnp.int16
+    assert lane_dtype(Lane("a", hi=100_000), PACKED) == jnp.int32
+    assert lane_dtype(Lane("a", hi=100, lo=-200), PACKED) == jnp.int16
+    assert lane_dtype(Lane("a", hi=100, kind="bitmask"),
+                      PACKED) == jnp.int32
+    # The wide profile degrades every category to the i32 reference.
+    assert lane_dtype(Lane("a", hi=100), WIDE) == jnp.int32
+
+
+def test_generated_kind_names_render_in_traces():
+    from madsim_tpu.actorc.families.paxos import (PaxosActor,
+                                                  PaxosConfig,
+                                                  engine_config)
+
+    actor = PaxosActor(PaxosConfig())
+    assert actor.kind_names == ["Cmd", "Prepare", "Promise", "Accept",
+                                "Accepted", "Chosen", "Retry"]
+    eng = DeviceEngine(actor, engine_config(PaxosConfig()))
+    trace = eng.trace(3, max_steps=300)
+    kinds = {e["kind"] for e in trace}
+    assert "Prepare" in kinds and "Promise" in kinds \
+        and "Chosen" in kinds, kinds
+
+
+def test_restart_annotations_reset_volatile_lanes():
+    """durable=False lanes lose the restarting node's row; durable
+    lanes survive — the disk-vs-memory contract, checked end to end on
+    both backends via the pb family's ack bookkeeping (volatile) vs
+    log (durable) under a kill/restart schedule (the crosscheck above)
+    and here directly on a tiny spec."""
+    def h(c):
+        c.write("mem", 7)
+        c.write("disk", 9)
+
+    spec = ActorSpec(
+        name="vol", n_nodes=2,
+        lanes=(Lane("mem", hi=10, durable=False, reset=3),
+               Lane("disk", hi=10)),
+        messages=(Message("Hit", ()),),
+        handlers={"Hit": h},
+        init=lambda c: c.event("Hit", time=10, dst=0),
+        invariant=lambda v: v.np.asarray(False),
+    )
+    host = HostActor(spec, payload_words=2)
+    s = host.init_state()
+    s, _, _ = host.handle(s, kind=0, dst=0, payload=[], now=10)
+    assert s["mem"][0] == 7 and s["disk"][0] == 9
+    s2, _ = host.on_restart(s, node=0, now=20)
+    assert s2["mem"][0] == 3, "volatile lane must reset to its reset value"
+    assert s2["disk"][0] == 9, "durable lane must survive the restart"
+
+
+# ---------------------------------------------------------------------------
+# The Paxos family.
+# ---------------------------------------------------------------------------
+def test_paxos_clean_is_safe_and_decides():
+    from madsim_tpu.actorc.families.paxos import (PaxosActor,
+                                                  PaxosConfig,
+                                                  engine_config)
+
+    xcfg = PaxosConfig(contend_all=True)
+    eng = DeviceEngine(PaxosActor(xcfg), engine_config(xcfg))
+    obs = eng.observe(eng.run(eng.init(np.arange(256)), max_steps=6000))
+    assert not obs["bug"].any()
+    assert not obs["overflow"].any()
+    assert (obs["slots_decided"] == xcfg.n_slots).all(), \
+        "every contended decree must still decide on a clean network"
+
+
+def test_paxos_forgetful_acceptor_violates_under_window_restarts():
+    from madsim_tpu.actorc.families.paxos import (PaxosActor,
+                                                  PaxosConfig,
+                                                  engine_config)
+
+    xcfg = PaxosConfig(buggy_forgetful_acceptor=True, contend_all=True)
+    eng = DeviceEngine(PaxosActor(xcfg), engine_config(xcfg))
+    # Two restarts inside the amnesia window of a contended decree
+    # (tuning measurements in actorc/families/paxos.py).
+    faults = np.array([[80_000, FAULT_RESTART, 0, 0],
+                       [83_000, FAULT_RESTART, 2, 0]], np.int32)
+    obs = eng.observe(eng.run(eng.init(np.arange(512), faults=faults),
+                              max_steps=8000))
+    assert obs["bug"].any(), "amnesia restarts must split a decree"
+    assert not obs["bug"].all(), "only some interleavings race"
+    # The SAME schedule against durable acceptors stays safe: the bug
+    # is the flipped annotation, not the schedule.
+    good = PaxosConfig(contend_all=True)
+    geng = DeviceEngine(PaxosActor(good), engine_config(good))
+    gobs = geng.observe(geng.run(geng.init(np.arange(512),
+                                           faults=faults),
+                                 max_steps=8000))
+    assert not gobs["bug"].any()
+
+
+def test_compiled_actor_state_is_dict_of_declared_lanes():
+    spec = _ping_spec()
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=16,
+                       t_limit_us=1_000_000)
+    eng = DeviceEngine(CompiledActor(spec), cfg)
+    state = eng.init(np.arange(4))
+    assert set(state.astate) == {"count"}
+    assert state.astate["count"].dtype == jnp.int8  # hi=100 -> code lane
+    final = eng.run(state, max_steps=200)
+    assert (np.asarray(final.astate["count"]).sum(axis=-1) >= 6).all()

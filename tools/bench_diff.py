@@ -108,6 +108,15 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.guided_hunt.raft.random_bugs_found", False),
     ("guided raft novelty area",
      "configs.guided_hunt.raft.guided_novelty_area", True),
+    # The actorc-compiled Paxos leg (docs/actorc.md): seeds-to-bug on
+    # the forgetful-acceptor consistency violation — the first DSL-only
+    # family the guided search hunts — plus its staircase depth.
+    ("guided paxos seeds-to-bug",
+     "configs.guided_hunt.paxos.guided_seeds_to_bug", False),
+    ("guided paxos speedup>=",
+     "configs.guided_hunt.paxos.speedup_lower_bound", True),
+    ("guided paxos lineage depth",
+     "configs.guided_hunt.paxos.guided_lineage_depth", True),
     # Evolution observatory (obs/lineage.py, PR 13): ancestry depth of
     # the guided pair hunt and the corpus-survival credit of the
     # node-rotation operator (the one the pair bug NEEDS) — the
