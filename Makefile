@@ -10,7 +10,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: check lint detlint tracelint test smoke dryrun determinism \
         dualmode native clean replay-demo bench-diff chaos chaos-full \
-        triage-demo fuzz-demo actorc-demo
+        triage-demo fuzz-demo actorc-demo bridge-pool-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
@@ -95,6 +95,13 @@ smoke:
 	rneed={'guided_bugs_found','random_bugs_found', \
 	       'guided_novelty_area','random_novelty_area'}; \
 	assert rneed<=set(gh['raft']), f'guided_hunt raft leg: {gh[\"raft\"]}'; \
+	bp=d['configs']['bridge_sweep'].get('pool'); \
+	bneed={'bridge_vs_host','pool_overhead_frac','seeds_per_sec', \
+	       'host_ms_per_round','pack_ms_per_round','dispatch_ms_per_round', \
+	       'settle_ms_per_round','parent_ms_per_round'}; \
+	assert isinstance(bp,dict) and {'j1_w64','j2_w64'}<=set(bp) and \
+	    all(bneed<=set(v) for v in bp.values()), \
+	    f'bridge pool record missing/incomplete: {bp}'; \
 	ls=p.get('guided_operator_stats'); \
 	assert isinstance(ls,dict) and {'splice','node_rotate'}<=set(ls) \
 	    and all({'produced','novel','survived','bug'}<=set(v) \
@@ -161,6 +168,17 @@ fuzz-demo:
 # fuzz-demo.
 actorc-demo:
 	$(CPU_ENV) $(PY) tools/actorc_demo.py
+
+# The bridge worker pool end to end (docs/bridge.md "Parallel task
+# bodies"; ROADMAP item 4): a mixed-outcome suite (values, raises,
+# deadlocks, lossy-RPC send accounting) swept serial, pooled jobs=1,
+# and pooled jobs=2 (uneven W%J split) must be BITWISE identical on
+# traces + outcomes, with and without batch recycling; then SIGKILL a
+# worker mid-round and assert the pointed BridgePoolError (worker /
+# slot range / round) with every shared-memory segment unlinked.
+# Nonzero exit on any miss. CI runs this after actorc-demo.
+bridge-pool-demo:
+	$(CPU_ENV) $(PY) tools/bridge_pool_demo.py
 
 # Regression table between two bench rounds (tools/bench_diff.py):
 # compares seeds/s, utilization, xla_cost flops/bytes, sweep_loop stalls

@@ -1204,16 +1204,9 @@ def bench_bridge_sweep(n_host: int, n_bridge: int) -> dict:
 
     import os
 
-    # jobs sweep FIRST: forked workers need a jax-uninitialized parent.
     jobs = os.cpu_count() or 1
     out = {"world": f"rpc_pingpong x{ROUNDS} (bench config 1)",
            "jobs": jobs}
-    if jobs > 1:
-        t0 = walltime.perf_counter()
-        outs = sweep(world, list(range(n_bridge)), jobs=jobs)
-        dt = walltime.perf_counter() - t0
-        assert all(o.error is None for o in outs)
-        out["bridge_jobs_seeds_per_sec"] = round(n_bridge / dt, 1)
 
     t0 = walltime.perf_counter()
     polls = 0
@@ -1263,9 +1256,65 @@ def bench_bridge_sweep(n_host: int, n_bridge: int) -> dict:
                  "~5-15% decision-kernel fraction — breakdown and ceiling "
                  "analysis in docs/bridge.md"),
     })
-    if "bridge_jobs_seeds_per_sec" in out:
-        out["bridge_jobs_vs_host"] = round(
-            out["bridge_jobs_seeds_per_sec"] / host_rate, 2)
+    # -- the forked worker pool (bridge/pool.py, ROADMAP item 4) ----------
+    # J workers run the task bodies behind the SAME shared kernel, each
+    # packing its slot slice straight into shared memory. Recorded per
+    # (J, W): throughput vs host, the parent-observed per-phase wall
+    # windows, and pool_overhead_frac = (pool - serial)/serial wall on
+    # the same seeds — on a 1-core box the honest number is overhead,
+    # not speedup (docs/bridge.md "Parallel task bodies"); a multi-core
+    # runner's bridge_vs_host at J=4 is the scaling headline.
+    from madsim_tpu.bridge.pool import sweep_pooled
+
+    smoke = n_bridge <= 64
+    pool: dict = {}
+    for Wp in ((64,) if smoke else (64, 512)):
+        pseeds = list(range(Wp))
+        sweep(world, pseeds)  # warm this width's jit shapes off the clock
+        t0 = walltime.perf_counter()
+        outs = sweep(world, pseeds)
+        serial_dt = walltime.perf_counter() - t0
+        assert all(o.error is None for o in outs)
+        for J in ((1, 2) if smoke else (1, 2, 4)):
+            stats: dict = {}
+            t0 = walltime.perf_counter()
+            outs = sweep_pooled(world, pseeds, jobs=J, stats=stats)[0]
+            pdt = walltime.perf_counter() - t0
+            assert all(o.error is None for o in outs)
+            rounds = max(stats["rounds"], 1)
+            pool[f"j{J}_w{Wp}"] = {
+                "seeds_per_sec": round(Wp / pdt, 1),
+                "bridge_vs_host": round((Wp / pdt) / host_rate, 2),
+                "pool_overhead_frac": round((pdt - serial_dt) / serial_dt,
+                                            3),
+                # Parent-observed phase windows: host = workers running
+                # task bodies (+ fork barrier), pack = shared-memory
+                # pack barrier, dispatch = the jitted kernel step,
+                # settle = worker settle + drain chain.
+                "host_ms_per_round": round(
+                    stats["host_s"] / rounds * 1e3, 3),
+                "pack_ms_per_round": round(
+                    stats["pack_s"] / rounds * 1e3, 3),
+                "dispatch_ms_per_round": round(
+                    stats["dispatch_s"] / rounds * 1e3, 3),
+                "settle_ms_per_round": round(
+                    stats["settle_s"] / rounds * 1e3, 3),
+                # The parent's OWN per-round Python work (reset apply +
+                # bucket calc + broadcast bookkeeping, no waiting): the
+                # pack loop is gone from the parent profile, so this
+                # stays ~O(1) in W — compare across the w64/w512 rows.
+                "parent_ms_per_round": round(
+                    stats["parent_s"] / rounds * 1e3, 4),
+                "rounds": stats["rounds"],
+                "drain_rounds": stats["drain_rounds"],
+            }
+    out["pool"] = pool
+    out["pool_note"] = (
+        "jobs=J forked pool behind one shared kernel, bitwise == jobs=1 "
+        "== serial (tests/test_bridge_pool.py); this box has "
+        f"{jobs} core(s), so interpret bridge_vs_host at J>1 "
+        "accordingly — on 1 core the gate is pool_overhead_frac, not "
+        "speedup")
     log(f"bridge_sweep: {out}")
     return out
 

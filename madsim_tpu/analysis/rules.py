@@ -188,6 +188,7 @@ HOT_LOOP_MODULES = frozenset({
     "madsim_tpu/parallel/sweep.py",
     "madsim_tpu/fleet/worker.py",
     "madsim_tpu/obs/observatory.py",
+    "madsim_tpu/bridge/pool.py",
 })
 
 # First-line marker opting any other file into the hot-loop pass (fixtures,

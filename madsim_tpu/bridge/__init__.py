@@ -8,15 +8,17 @@ host — SURVEY §7 stage 4. Per seed, trajectories are bit-identical to
 ``Runtime.block_on`` (tests/test_bridge.py).
 """
 from .kernel import BridgeKernel, HostBatch, StepOut  # noqa: F401
+from .pool import BridgePoolError, sweep_pooled  # noqa: F401
 from .runtime import (  # noqa: F401
     BridgeNetSim,
     BridgeRuntime,
     BridgeTime,
     Outcome,
+    SliceDriver,
     sweep,
     sweep_traced,
 )
 
-__all__ = ["sweep", "sweep_traced", "Outcome", "BridgeRuntime",
-           "BridgeKernel", "BridgeNetSim", "BridgeTime", "HostBatch",
-           "StepOut"]
+__all__ = ["sweep", "sweep_traced", "sweep_pooled", "Outcome",
+           "BridgeRuntime", "BridgeKernel", "BridgeNetSim", "BridgeTime",
+           "BridgePoolError", "HostBatch", "StepOut", "SliceDriver"]

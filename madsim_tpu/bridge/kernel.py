@@ -33,7 +33,7 @@ engine would apply them.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -391,6 +391,39 @@ class BridgeKernel:
                 lane_seq=st.lane_seq.at[slot].set(0),
             )
 
+    def reset_slots(self, pairs) -> None:
+        """Batched :meth:`reset_slot`: re-key ALL of a round's recycled
+        slots in one device write per lane array instead of one dispatch
+        chain per slot — the pool parent's refill path
+        (`bridge/pool.py`), where a wide recycled sweep can retire many
+        slots per round. Bit-identical to sequential ``reset_slot``
+        calls: the slots are distinct, and each row gets exactly the
+        values a fresh kernel keyed on its seed would hold."""
+        if not pairs:
+            return
+        if len(pairs) == 1:
+            self.reset_slot(*pairs[0])
+            return
+        from ..core.rng import STREAM_NET
+        from ..ops.threefry import derive_stream_np
+
+        import jax.numpy as jnp
+
+        slots = np.asarray([int(s) for s, _ in pairs], np.int32)
+        seeds = np.asarray([int(x) for _, x in pairs], np.uint64)
+        k0 = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        k1 = (seeds >> np.uint64(32)).astype(np.uint32)
+        nk0, nk1 = derive_stream_np(k0, k1, STREAM_NET)
+        with self._jax.default_device(self.device), self._enable_x64():
+            self._net_k0 = self._net_k0.at[slots].set(jnp.asarray(nk0))
+            self._net_k1 = self._net_k1.at[slots].set(jnp.asarray(nk1))
+            st = self.state
+            self.state = BridgeState(
+                clock=st.clock.at[slots].set(0),
+                lane_dl=st.lane_dl.at[slots].set(jnp.int64(INF_NS)),
+                lane_seq=st.lane_seq.at[slots].set(0),
+            )
+
     def drain(self) -> DrainOut:
         """Dispatch one pop-only drain round and return LAZY device
         outputs (materialize with ``np.asarray`` at use). The round's
@@ -404,11 +437,20 @@ class BridgeKernel:
             self._mb = mb
             return out
 
-    def step(self, batch: HostBatch) -> StepOut:
+    def step(self, batch: HostBatch, out: Optional[StepOut] = None
+             ) -> StepOut:
+        """One lockstep round. ``batch`` arrays may be backed by ANY
+        buffer — the pool parent hands shared-memory views straight in
+        (the H2D copy reads them in place). ``out``, when given, is a
+        StepOut of caller-owned destination arrays (``None`` fields
+        skipped): the results are scattered into them after
+        materialization — the shared-memory egress seam of
+        `bridge/pool.py`, whose workers read their slice rows without
+        any per-world parent work."""
         import jax.numpy as jnp
 
         with self._jax.default_device(self.device), self._enable_x64():
-            state, mb, out = self._fn(
+            state, mb, res = self._fn(
                 self.state, self._mb, self._net_k0, self._net_k1,
                 jnp.asarray(batch.t_slot), jnp.asarray(batch.t_dl),
                 jnp.asarray(batch.t_seq), jnp.asarray(batch.t_mask),
@@ -421,7 +463,12 @@ class BridgeKernel:
                 jnp.asarray(batch.clock), jnp.asarray(batch.advance))
             self.state = state
             self._mb = mb
-            return StepOut(*[np.asarray(x) for x in out])
+            res = StepOut(*[np.asarray(x) for x in res])
+            if out is not None:
+                for dst, src in zip(out, res):
+                    if dst is not None:
+                        np.copyto(dst, src)
+            return res
 
     def metrics(self):
         """Host copy of the per-slot :class:`BridgeMetrics` block (dict of

@@ -266,12 +266,16 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     ``trace=True`` each world records (task_id, elapsed_ns) per poll for
     trajectory-equality checks.
 
-    ``jobs`` shards seeds across forked worker processes, each running
-    its own lockstep loop — the MADSIM_TEST_JOBS analog
-    (`builder.rs:55-107`; the reference forks OS threads, which a GIL
-    rules out for Python task bodies). Task bodies are CPU-bound Python,
-    so jobs only helps up to the machine's core count; jobs=0 picks
-    ``os.cpu_count()``.
+    ``jobs`` runs the Python task bodies of the W live worlds across a
+    pool of forked worker processes behind ONE shared decision kernel
+    (`bridge/pool.py`, the MADSIM_TEST_JOBS analog of
+    `builder.rs:55-107`; the reference forks OS threads, which a GIL
+    rules out for Python task bodies). Each worker owns a contiguous
+    slot slice of the batch and packs it directly into shared memory, so
+    the parent's per-round work is O(1) in W. Per-seed trajectories stay
+    bit-identical to ``jobs=1`` for every J (tests/test_bridge_pool.py).
+    Task bodies are CPU-bound Python, so jobs only helps up to the
+    machine's core count; jobs=0 picks ``os.cpu_count()``.
 
     ``batch`` bounds how many worlds are live at once (world recycling,
     the host-side analog of ``parallel.sweep(recycle=True)``): seeds
@@ -279,21 +283,21 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     re-keyed (`BridgeKernel.reset_slot`) for the next seed. Memory and
     per-round pack width stay O(batch) however long the seed list, and
     every seed's trajectory stays bit-identical to an unbatched run
-    (tests/test_bridge.py). The bound is per lockstep loop: with
-    ``jobs>1`` each forked worker holds up to ``batch`` live worlds, so
-    the process tree's total is O(jobs*batch). Default: all seeds at
-    once."""
+    (tests/test_bridge.py). The bound is the whole pool's: with
+    ``jobs>1`` the ``batch`` kernel slots are SHARED, sliced across the
+    workers, so the process tree's total stays O(batch)."""
     if jobs == 0:
         # Host driver sizing its own fork pool — no simulation is live here.
         jobs = os.cpu_count() or 1  # detlint: allow[DET004]
-    if jobs > 1 and len(seeds) > 1 and not _jax_initialized():
-        # fork is only safe before this process touches a jax backend
-        # (forked XLA clients deadlock); with jax already live, fall back
-        # to the in-process loop.
-        return _sweep_jobs(world_fn, seeds, jobs, config=config,
-                           configs=configs, cap=cap, k_events=k_events,
-                           time_limit=time_limit, device=device,
-                           batch=batch)
+    seeds = list(seeds)
+    if jobs > 1 and len(seeds) > 1:
+        from .pool import sweep_pooled
+
+        outcomes, _ = sweep_pooled(world_fn, seeds, jobs=jobs, config=config,
+                                   configs=configs, cap=cap,
+                                   k_events=k_events, time_limit=time_limit,
+                                   trace=trace, device=device, batch=batch)
+        return outcomes
     outcomes, _ = _sweep_impl(world_fn, seeds, config=config,
                               configs=configs, cap=cap, k_events=k_events,
                               time_limit=time_limit, trace=trace,
@@ -301,69 +305,14 @@ def sweep(world_fn: Callable, seeds, *, config: Optional[Config] = None,
     return outcomes
 
 
-def _jax_initialized() -> bool:
-    import sys
-
-    xb = sys.modules.get("jax._src.xla_bridge")
-    return bool(xb is not None and getattr(xb, "_backends", None))
-
-
-def _sweep_jobs(world_fn, seeds, jobs, *, configs=None, **kw):
-    """Fork one worker per seed shard; each runs its own kernel + loop.
-
-    fork (not spawn) so ``world_fn`` closures carry over without
-    pickling; outcomes return through pipes. Errors that cannot pickle
-    are re-wrapped as RuntimeError with the original repr."""
-    import pickle
-
-    seeds = list(seeds)
-    jobs = min(jobs, len(seeds))
-    shards = [list(range(i, len(seeds), jobs)) for i in range(jobs)]
-    pipes = []
-    pids = []
-    for shard in shards:
-        r, w = os.pipe()
-        pid = os.fork()
-        if pid == 0:  # child
-            os.close(r)
-            try:
-                sub_cfgs = ([configs[i] for i in shard]
-                            if configs is not None else None)
-                outs, _ = _sweep_impl(world_fn, [seeds[i] for i in shard],
-                                      configs=sub_cfgs, **kw)
-                payload = []
-                for o in outs:
-                    try:
-                        pickle.dumps(o)
-                        payload.append(o)
-                    except Exception:
-                        payload.append(Outcome(
-                            o.seed, None,
-                            RuntimeError(f"unpicklable outcome: {o!r}")))
-                blob = pickle.dumps(payload)
-            except BaseException as exc:  # noqa: BLE001
-                blob = pickle.dumps(RuntimeError(
-                    f"sweep worker failed: {exc!r}"))
-            with os.fdopen(w, "wb") as f:
-                f.write(blob)
-            os._exit(0)
-        os.close(w)
-        pipes.append(r)
-        pids.append(pid)
-    outcomes: List[Optional[Outcome]] = [None] * len(seeds)
-    for shard, r, pid in zip(shards, pipes, pids):
-        with os.fdopen(r, "rb") as f:
-            data = pickle.loads(f.read())
-        os.waitpid(pid, 0)
-        if isinstance(data, BaseException):
-            raise data
-        for idx, o in zip(shard, data):
-            outcomes[idx] = o
-    return outcomes
-
-
-def sweep_traced(world_fn, seeds, **kw) -> Tuple[List[Outcome], List[list]]:
+def sweep_traced(world_fn, seeds, *, jobs: int = 1,
+                 **kw) -> Tuple[List[Outcome], List[list]]:
     """sweep() + per-seed poll traces (testing hook)."""
+    seeds = list(seeds)
+    if jobs > 1 and len(seeds) > 1:
+        from .pool import sweep_pooled
+
+        return sweep_pooled(world_fn, seeds, jobs=jobs, trace=True, **kw)
     return _sweep_impl(world_fn, seeds, trace=True, **kw)
 
 
@@ -388,125 +337,37 @@ def sweep_profiled(world_fn, seeds, **kw) -> Tuple[List[Outcome], dict]:
     return outs, profile
 
 
-def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
-                k_events=4, time_limit=None, trace=False, device=None,
-                profile=None, batch=None):
-    seeds = [int(s) for s in seeds]
-    n = len(seeds)
-    # World recycling: W kernel slots, n seeds streamed through them. A
-    # finished world's slot is re-keyed for the next seed, so batch width
-    # (and host memory) stays O(W) for arbitrarily long seed lists.
-    W = n if batch is None else max(1, min(int(batch), n))
-    wants_seed = len(inspect.signature(world_fn).parameters) >= 1
-    outcomes: List[Optional[Outcome]] = [None] * n
-    traces: List[list] = [[] for _ in range(n)]
-    slots: List[Optional[_World]] = [None] * W
-    free: List[int] = list(range(W - 1, -1, -1))  # pop() fills slot 0 first
-    pending: set = set()            # slots holding a live world
-    next_pos = 0                    # next seed position to admit
-    polls_done = 0                  # poll_count of retired worlds
+class PackBufferCache:
+    """Process-global LRU of preallocated round pack buffers.
 
-    # Profiled sweeps also carry the device-resident observability block
-    # (BridgeMetrics): counters accumulate inside the jitted step and are
-    # pulled ONCE at the end — bit-invisible to trajectories either way.
-    kernel = BridgeKernel(seeds[:W], cap=cap, k_events=k_events,
-                          device=device, metrics=profile is not None)
+    Round buffers are preallocated per (W, T, C, S) bucket and reused:
+    fresh np.zeros for 18 arrays per round was a measured ~6% of sweep
+    wall time at W=512. The cache is BOUNDED: a long recycled sweep (or
+    a process re-sweeping many widths) walks many bucket shapes, and an
+    unbounded dict pins every (W, T, C, S) combination it ever saw —
+    least-recently-used shapes are dropped instead
+    (tests/test_bridge_pool.py gates the bound).
 
-    def finish(w: _World, value=None, error=None):
-        nonlocal polls_done
-        outcomes[w.idx] = Outcome(seeds[w.idx], value, error)
-        w.done = True
-        pending.discard(w.slot)
-        free.append(w.slot)
-        polls_done += w.rt.task.poll_count
+    Buffers come back UNCLEARED: clearing is the packer's job
+    (:meth:`SliceDriver.pack_into` masks-only-clears exactly the rows it
+    owns), which is what lets pool workers share one (W, ...) batch
+    region without any whole-array owner. Mutating a buffer after the
+    kernel ``step()`` returns is safe: StepOut is materialized to numpy
+    before step returns, so the device is done with the inputs.
+    """
 
-    def run_host(w: _World) -> None:
-        """One host burst: run all ready tasks, then settle the root."""
-        ex = w.rt.task
-        with context.enter_handle(w.rt.handle):
-            ex.run_all_ready()
-        if ex._uncaught is not None:
-            exc, ex._uncaught = ex._uncaught, None
-            finish(w, error=exc)
-        elif w.root.done:
-            fut = w.root.join_future
-            if fut._exception is not None:
-                finish(w, error=fut._exception)
-            else:
-                finish(w, value=fut.result())
+    def __init__(self, maxsize: int = 8):
+        from collections import OrderedDict
 
-    def spawn(slot: int, pos: int) -> _World:
-        if configs is not None:
-            cfg = copy.deepcopy(configs[pos])
-        else:
-            cfg = copy.deepcopy(config) if config is not None else None
-        rt = BridgeRuntime(seed=seeds[pos], config=cfg, cap=cap)
-        if time_limit is not None:
-            rt.set_time_limit(time_limit)
-        if trace:
-            rt.task.trace = traces[pos]
-        with context.enter_handle(rt.handle):
-            coro = world_fn(seeds[pos]) if wants_seed else world_fn()
-            root = rt.task.start_root(coro)
-        w = _World(pos, slot, rt, root)
-        slots[slot] = w
-        pending.add(slot)
-        return w
+        self.maxsize = maxsize
+        self._bufs: "Dict[Tuple[int, int, int, int], list]" = OrderedDict()
 
-    def top_up() -> None:
-        """Admit seeds into free slots (runs between rounds only — a slot
-        reset mid-round would let stale kernel rows fire into the fresh
-        world's seq space)."""
-        nonlocal next_pos
-        blocked: List[int] = []
-        while free and next_pos < n:
-            slot = free.pop()
-            old = slots[slot]
-            if old is not None:
-                t = old.rt.time
-                if t.pending_add or t.sends or t.cancels:
-                    # The retiring world's final host burst recorded
-                    # activity that has not been shipped yet (its stats
-                    # ride the next round's batch): recycle this slot one
-                    # round later.
-                    blocked.append(slot)
-                    continue
-                kernel.reset_slot(slot, seeds[next_pos])
-            w = spawn(slot, next_pos)
-            next_pos += 1
-            run_host(w)
-        free.extend(blocked)
+    def __len__(self) -> int:
+        return len(self._bufs)
 
-    if profile is not None:
-        from time import perf_counter
-
-        profile.update(rounds=0, drain_rounds=0, host_s=0.0, pack_s=0.0,
-                       dispatch_s=0.0, settle_s=0.0, events=0, sends=0,
-                       timers=0, polls=0)
-
-        def _clk():
-            # Wall-clock profiling of the sweep driver itself (host side).
-            return perf_counter()  # detlint: allow[DET001]
-    else:
-        def _clk():
-            return 0.0
-
-    t0 = _clk()
-    top_up()
-    if profile is not None:
-        profile["host_s"] += _clk() - t0
-
-    # Round buffers are preallocated per (T, C, S) bucket and reused:
-    # fresh np.zeros for 18 arrays per round was a measured ~6% of sweep
-    # wall time at W=512. Only the mask lanes (and the s_lat_w divisor)
-    # need clearing on reuse — every value lane sits behind a mask the
-    # kernel applies (stale values are jnp.where'd to the dump column).
-    # Mutating after step() returns is safe: StepOut is materialized to
-    # numpy before step returns, so the device is done with the inputs.
-    buffers: Dict[Tuple[int, int, int], list] = {}
-
-    def round_buffers(T, C, S):
-        buf = buffers.get((T, C, S))
+    def get(self, W: int, T: int, C: int, S: int) -> list:
+        key = (W, T, C, S)
+        buf = self._bufs.get(key)
         if buf is None:
             buf = [np.zeros((W, T), np.int32), np.zeros((W, T), np.int64),
                    np.zeros((W, T), np.int64), np.zeros((W, T), np.bool_),
@@ -517,33 +378,184 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                    np.zeros((W, S), np.int64), np.ones((W, S), np.int64),
                    np.zeros((W, S), np.bool_), np.zeros((W, S), np.bool_),
                    np.zeros((W,), np.int64), np.zeros((W,), np.bool_)]
-            buffers[(T, C, S)] = buf
+            self._bufs[key] = buf
+            while len(self._bufs) > self.maxsize:
+                self._bufs.popitem(last=False)
         else:
-            buf[3].fill(False)   # t_mask
-            buf[5].fill(False)   # c_mask
-            buf[13].fill(1)      # s_lat_w (divisor: must stay >= 1)
-            buf[14].fill(False)  # s_mask
-            buf[15].fill(False)  # s_live
+            self._bufs.move_to_end(key)
         return buf
 
-    while pending or next_pos < n:
-        # -- build the padded round batch ---------------------------------
-        t0 = _clk()
+
+_PACK_BUFFERS = PackBufferCache()
+
+
+class SliceDriver:
+    """Host-side driving of a contiguous slice of bridge kernel slots.
+
+    This is the slot-sliced seam the lockstep sweep is built from: the
+    serial loop (`_sweep_impl`) drives ONE slice covering all W slots
+    directly against the kernel; the forked worker pool
+    (`bridge/pool.py`) gives each worker its own slice — worlds,
+    ``Runtime`` object graphs, and seed sub-stream live only in that
+    worker — and moves the kernel interactions to the parent. Every
+    per-world decision here depends only on that world's own rows, which
+    is what makes the per-seed trajectory independent of how slots are
+    sliced (the ``jobs=J == jobs=1 == serial`` bitwise contract,
+    tests/test_bridge_pool.py).
+
+    ``slot_lo`` is the slice's first GLOBAL kernel row; all batch/StepOut
+    indexing below is global (``slot_lo + local``). ``seeds`` is the
+    slice's own seed stream, recycled through its ``n_slots`` slots.
+    """
+
+    def __init__(self, world_fn, seeds, *, slot_lo: int = 0,
+                 n_slots: Optional[int] = None, config=None, configs=None,
+                 cap: int = 128, time_limit=None, trace: bool = False,
+                 profile: Optional[dict] = None):
+        self.world_fn = world_fn
+        self.seeds = [int(s) for s in seeds]
+        n = len(self.seeds)
+        self.slot_lo = slot_lo
+        self.W = n if n_slots is None else n_slots
+        self.wants_seed = len(inspect.signature(world_fn).parameters) >= 1
+        self.config = config
+        self.configs = configs
+        self.cap = cap
+        self.time_limit = time_limit
+        self.trace = trace
+        self.profile = profile
+        self.outcomes: List[Optional[Outcome]] = [None] * n
+        self.traces: List[list] = [[] for _ in range(n)]
+        self.slots: List[Optional[_World]] = [None] * self.W
+        self.free: List[int] = list(range(self.W - 1, -1, -1))  # slot 0 first
+        self.pending: set = set()       # local slots holding a live world
+        self.next_pos = 0               # next seed position to admit
+        self.polls_done = 0             # poll_count of retired worlds
+        self._rounds: Optional[list] = None
+        self._woke: List[_World] = []
+
+    # -- admission / retirement --------------------------------------------
+    @property
+    def live(self) -> int:
+        return len(self.pending)
+
+    @property
+    def left(self) -> int:
+        return len(self.seeds) - self.next_pos
+
+    def live_slots(self) -> List[int]:
+        """GLOBAL row indices of the slots holding a live world."""
+        return [self.slot_lo + s for s in sorted(self.pending)]
+
+    def finish(self, w: _World, value=None, error=None) -> None:
+        self.outcomes[w.idx] = Outcome(self.seeds[w.idx], value, error)
+        w.done = True
+        self.pending.discard(w.slot)
+        self.free.append(w.slot)
+        self.polls_done += w.rt.task.poll_count
+
+    def run_host(self, w: _World) -> None:
+        """One host burst: run all ready tasks, then settle the root."""
+        ex = w.rt.task
+        with context.enter_handle(w.rt.handle):
+            ex.run_all_ready()
+        if ex._uncaught is not None:
+            exc, ex._uncaught = ex._uncaught, None
+            self.finish(w, error=exc)
+        elif w.root.done:
+            fut = w.root.join_future
+            if fut._exception is not None:
+                self.finish(w, error=fut._exception)
+            else:
+                self.finish(w, value=fut.result())
+
+    def spawn(self, slot: int, pos: int) -> _World:
+        if self.configs is not None:
+            cfg = copy.deepcopy(self.configs[pos])
+        else:
+            cfg = (copy.deepcopy(self.config)
+                   if self.config is not None else None)
+        rt = BridgeRuntime(seed=self.seeds[pos], config=cfg, cap=self.cap)
+        if self.time_limit is not None:
+            rt.set_time_limit(self.time_limit)
+        if self.trace:
+            rt.task.trace = self.traces[pos]
+        with context.enter_handle(rt.handle):
+            coro = (self.world_fn(self.seeds[pos]) if self.wants_seed
+                    else self.world_fn())
+            root = rt.task.start_root(coro)
+        w = _World(pos, slot, rt, root)
+        self.slots[slot] = w
+        self.pending.add(slot)
+        return w
+
+    def top_up(self) -> List[Tuple[int, int]]:
+        """Admit seeds into free slots (runs between rounds only — a slot
+        reset mid-round would let stale kernel rows fire into the fresh
+        world's seq space). Returns the (GLOBAL slot, seed) pairs whose
+        kernel rows must be re-keyed (`BridgeKernel.reset_slot`/
+        `reset_slots`) before the next step — the caller owns the kernel
+        (directly in the serial loop; via the pool parent otherwise)."""
+        blocked: List[int] = []
+        resets: List[Tuple[int, int]] = []
+        while self.free and self.next_pos < len(self.seeds):
+            slot = self.free.pop()
+            old = self.slots[slot]
+            if old is not None:
+                t = old.rt.time
+                if t.pending_add or t.sends or t.cancels:
+                    # The retiring world's final host burst recorded
+                    # activity that has not been shipped yet (its stats
+                    # ride the next round's batch): recycle this slot one
+                    # round later.
+                    blocked.append(slot)
+                    continue
+                resets.append((self.slot_lo + slot,
+                               self.seeds[self.next_pos]))
+            w = self.spawn(slot, self.next_pos)
+            self.next_pos += 1
+            self.run_host(w)
+        self.free.extend(blocked)
+        return resets
+
+    # -- the pack seam ------------------------------------------------------
+    def take_rounds(self) -> Tuple[int, int, int]:
+        """Collect each slot's recorded round activity; returns the raw
+        (max timers, max cancels, max sends) widths of this slice — the
+        caller buckets the GLOBAL max so every packer agrees on shape."""
         rounds = []
         t_n = c_n = s_n = 0
-        for w in slots:
+        for w in self.slots:
             adds, cancels, sends = w.rt.time.take_round()
             rounds.append((adds, cancels, sends))
             t_n = max(t_n, len(adds))
             c_n = max(c_n, len(cancels))
             s_n = max(s_n, len(sends))
-        T, C, S = bucket(t_n), bucket(c_n), bucket(s_n)
+        self._rounds = rounds
+        if self.profile is not None:
+            self.profile["timers"] += sum(len(r[0]) for r in rounds)
+            self.profile["sends"] += sum(len(r[2]) for r in rounds)
+        return t_n, c_n, s_n
+
+    def pack_into(self, bufs: list) -> None:
+        """Write this slice's rows of the padded (W, ...) round batch.
+
+        Masks-only clears, restricted to the slice's own rows: every
+        value lane sits behind a mask the kernel applies (stale values
+        are jnp.where'd to the dump column), and the slices of a sweep
+        partition the W rows, so the batch is fully initialized with no
+        per-world work outside the owning slice/worker."""
         (t_slot, t_dl, t_seq, t_mask, c_slot, c_mask,
          s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
-         s_lat_lo, s_lat_w, s_mask, s_live, clock, advance) = \
-            round_buffers(T, C, S)
-        for w, (adds, cancels, sends) in zip(slots, rounds):
-            i = w.slot
+         s_lat_lo, s_lat_w, s_mask, s_live, clock, advance) = bufs
+        lo, hi = self.slot_lo, self.slot_lo + self.W
+        t_mask[lo:hi] = False
+        c_mask[lo:hi] = False
+        s_lat_w[lo:hi] = 1   # divisor: must stay >= 1
+        s_mask[lo:hi] = False
+        s_live[lo:hi] = False
+        for w, (adds, cancels, sends) in zip(self.slots, self._rounds):
+            i = lo + w.slot
             clock[i] = w.rt.time.elapsed_ns
             advance[i] = not w.done
             for j, (slot, (dl, sq)) in enumerate(adds.items()):
@@ -566,24 +578,17 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 s_mask[i, j] = True
                 s_live[i, j] = s.live
 
-        if profile is not None:
-            profile["pack_s"] += _clk() - t0
-            profile["rounds"] += 1
-            profile["timers"] += sum(len(r[0]) for r in rounds)
-            profile["sends"] += sum(len(r[2]) for r in rounds)
-        t0 = _clk()
-        out = kernel.step(HostBatch(
-            t_slot, t_dl, t_seq, t_mask, c_slot, c_mask,
-            s_ctr, s_base, s_slot, s_seq, s_thr, s_lossall,
-            s_lat_lo, s_lat_w, s_mask, s_live, clock, advance))
-        if profile is not None:
-            profile["dispatch_s"] += _clk() - t0
-
-        # -- settle sends, dispatch events, detect stops ------------------
-        t0 = _clk()
-        woke: List[_World] = []
-        for w, (adds, cancels, sends) in zip(slots, rounds):
-            i = w.slot
+    # -- the settle seam ----------------------------------------------------
+    def settle(self, out) -> List[int]:
+        """Settle sends, dispatch popped events, detect stops for this
+        slice's rows of a StepOut-shaped result (numpy arrays — the
+        kernel's own StepOut or the pool's shared-memory views). Returns
+        the GLOBAL rows whose worlds finished during the settle."""
+        newly_done: List[int] = []
+        self._woke = []
+        lo = self.slot_lo
+        for w, (adds, cancels, sends) in zip(self.slots, self._rounds):
+            i = lo + w.slot
             for j, s in enumerate(sends):
                 if out.send_ok[i, j]:
                     w.stat.msg_count += 1
@@ -593,14 +598,16 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                 continue
             w.rt.time.elapsed_ns = int(out.clock[i])
             if out.deadlock[i]:
-                finish(w, error=Deadlock(
+                self.finish(w, error=Deadlock(
                     f"deadlock detected at t={w.rt.time.elapsed_ns / 1e9:.9f}s: "
                     "all tasks are blocked and no timers are pending"))
+                newly_done.append(i)
                 continue
             lim = w.rt.task.time_limit_ns
             if lim is not None and w.rt.time.elapsed_ns >= lim:
-                finish(w, error=TimeLimitExceeded(
+                self.finish(w, error=TimeLimitExceeded(
                     f"time limit ({lim / NANOS_PER_SEC}s) exceeded"))
+                newly_done.append(i)
                 continue
             fired = 0
             with context.enter_handle(w.rt.handle):
@@ -609,10 +616,119 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
                         break
                     w.rt.time.fire(int(out.event_seq[i, k]))
                     fired += 1
-            if profile is not None:
-                profile["events"] += fired
+            if self.profile is not None:
+                self.profile["events"] += fired
             if fired or out.more_due[i]:
-                woke.append(w)
+                self._woke.append(w)
+        return newly_done
+
+    def any_pending_more(self, more: np.ndarray) -> bool:
+        """Serial-loop drain predicate: any live world of this slice with
+        >K events still due (``more`` is globally indexed)."""
+        return bool(self.pending
+                    and np.any(more[[self.slot_lo + s
+                                     for s in self.pending]]))
+
+    def drain_assert(self, more: np.ndarray) -> None:
+        # Drain rounds carry no host batch: anything a fire() callback
+        # recorded would silently miss its own due cluster and fire in
+        # the wrong order vs the host heap. No framework callback does
+        # that today — enforce it rather than assume it.
+        for w in self.slots:
+            if w.done or not more[self.slot_lo + w.slot]:
+                continue
+            t = w.rt.time
+            assert not (t.pending_add or t.sends or t.cancels), (
+                "bridge drain invariant violated: a fire() callback "
+                "recorded timers/sends during event dispatch")
+
+    def fire_drain(self, ev_valid: np.ndarray, ev_seq: np.ndarray,
+                   more: np.ndarray) -> None:
+        """Fire one drain round's popped events for the slice's rows
+        flagged in ``more`` (the PREVIOUS round's more_due — which worlds
+        this drain was dispatched for)."""
+        for w in self.slots:
+            i = self.slot_lo + w.slot
+            if w.done or not more[i]:
+                continue
+            with context.enter_handle(w.rt.handle):
+                for k in range(ev_valid.shape[1]):
+                    if not ev_valid[i, k]:
+                        break
+                    w.rt.time.fire(int(ev_seq[i, k]))
+                    if self.profile is not None:
+                        self.profile["events"] += 1
+
+    def run_woke(self) -> None:
+        """Run the host bursts of the worlds the settled round woke."""
+        for w in self._woke:
+            if not w.done:
+                self.run_host(w)
+        self._woke = []
+
+    def poll_total(self) -> int:
+        return self.polls_done + sum(
+            w.rt.task.poll_count for w in self.slots
+            if w is not None and not w.done)
+
+
+def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
+                k_events=4, time_limit=None, trace=False, device=None,
+                profile=None, batch=None):
+    seeds = [int(s) for s in seeds]
+    n = len(seeds)
+    # World recycling: W kernel slots, n seeds streamed through them. A
+    # finished world's slot is re-keyed for the next seed, so batch width
+    # (and host memory) stays O(W) for arbitrarily long seed lists.
+    W = n if batch is None else max(1, min(int(batch), n))
+    drv = SliceDriver(world_fn, seeds, n_slots=W, config=config,
+                      configs=configs, cap=cap, time_limit=time_limit,
+                      trace=trace, profile=profile)
+
+    # Profiled sweeps also carry the device-resident observability block
+    # (BridgeMetrics): counters accumulate inside the jitted step and are
+    # pulled ONCE at the end — bit-invisible to trajectories either way.
+    kernel = BridgeKernel(seeds[:W], cap=cap, k_events=k_events,
+                          device=device, metrics=profile is not None)
+
+    if profile is not None:
+        from time import perf_counter
+
+        profile.update(rounds=0, drain_rounds=0, host_s=0.0, pack_s=0.0,
+                       dispatch_s=0.0, settle_s=0.0, events=0, sends=0,
+                       timers=0, polls=0)
+
+        def _clk():
+            # Wall-clock profiling of the sweep driver itself (host side).
+            return perf_counter()  # detlint: allow[DET001]
+    else:
+        def _clk():
+            return 0.0
+
+    t0 = _clk()
+    for slot, seed in drv.top_up():  # no resets on the initial fill
+        kernel.reset_slot(slot, seed)
+    if profile is not None:
+        profile["host_s"] += _clk() - t0
+
+    while drv.live or drv.left:
+        # -- build the padded round batch ---------------------------------
+        t0 = _clk()
+        t_n, c_n, s_n = drv.take_rounds()
+        T, C, S = bucket(t_n), bucket(c_n), bucket(s_n)
+        bufs = _PACK_BUFFERS.get(W, T, C, S)
+        drv.pack_into(bufs)
+        if profile is not None:
+            profile["pack_s"] += _clk() - t0
+            profile["rounds"] += 1
+        t0 = _clk()
+        out = kernel.step(HostBatch(*bufs))
+        if profile is not None:
+            profile["dispatch_s"] += _clk() - t0
+
+        # -- settle sends, dispatch events, detect stops ------------------
+        t0 = _clk()
+        drv.settle(out)
 
         # -- drain rounds: >K events due fire before any poll runs --------
         # Pop-only kernel + dispatch-ahead (docs/perf.md "Pipelined
@@ -622,55 +738,32 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
         # the host. The one speculative round at chain end finds nothing
         # due and pops nothing — a semantic no-op on the lanes.
         more = out.more_due
-        inflight_drain = (kernel.drain()
-                          if pending and np.any(more[list(pending)])
+        inflight_drain = (kernel.drain() if drv.any_pending_more(more)
                           else None)
         while inflight_drain is not None:
-            # Drain rounds carry no host batch: anything a fire() callback
-            # recorded would silently miss its own due cluster and fire in
-            # the wrong order vs the host heap. No framework callback does
-            # that today — enforce it rather than assume it.
-            for w in slots:
-                if w.done or not more[w.slot]:
-                    continue
-                t = w.rt.time
-                assert not (t.pending_add or t.sends or t.cancels), (
-                    "bridge drain invariant violated: a fire() callback "
-                    "recorded timers/sends during event dispatch")
+            drv.drain_assert(more)
             if profile is not None:
                 profile["drain_rounds"] += 1
             cur = inflight_drain
             # Dispatch-ahead: queue the next round before materializing
             # this one's events (the device pops while the host fires).
             inflight_drain = kernel.drain()
-            ev_valid = np.asarray(cur.event_valid)
-            ev_seq = np.asarray(cur.event_seq)
-            for w in slots:
-                i = w.slot
-                if w.done or not more[i]:
-                    continue
-                with context.enter_handle(w.rt.handle):
-                    for k in range(ev_valid.shape[1]):
-                        if not ev_valid[i, k]:
-                            break
-                        w.rt.time.fire(int(ev_seq[i, k]))
-                        if profile is not None:
-                            profile["events"] += 1
+            drv.fire_drain(np.asarray(cur.event_valid),
+                           np.asarray(cur.event_seq), more)
             more = np.asarray(cur.more_due)
-            if not (pending and np.any(more[list(pending)])):
+            if not drv.any_pending_more(more):
                 break  # the in-flight round is the no-op tail
 
         if profile is not None:
             profile["settle_s"] += _clk() - t0
         t0 = _clk()
-        for w in woke:
-            if not w.done:
-                run_host(w)
-        top_up()  # recycle freed slots for the next seeds in the stream
+        drv.run_woke()
+        # Recycle freed slots for the next seeds in the stream.
+        for slot, seed in drv.top_up():
+            kernel.reset_slot(slot, seed)
         if profile is not None:
             profile["host_s"] += _clk() - t0
-            profile["polls"] = polls_done + sum(
-                w.rt.task.poll_count for w in slots if not w.done)
+            profile["polls"] = drv.poll_total()
 
     if profile is not None:
         mb = kernel.metrics()
@@ -688,4 +781,4 @@ def _sweep_impl(world_fn, seeds, *, config=None, configs=None, cap=128,
             from ..obs.coverage import coverage_of_counters
 
             profile["coverage"] = coverage_of_counters(mb)
-    return [o for o in outcomes], traces
+    return [o for o in drv.outcomes], drv.traces

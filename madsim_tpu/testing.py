@@ -8,7 +8,12 @@ Environment variables (same names as the reference):
 
 - ``MADSIM_TEST_SEED``   — base seed (default: unix-epoch seconds)
 - ``MADSIM_TEST_NUM``    — number of seeds, seed..seed+num (default 1)
-- ``MADSIM_TEST_JOBS``   — concurrent simulations (threads; default 1)
+- ``MADSIM_TEST_JOBS``   — concurrent simulations (default 1). Host
+  backend: one isolation thread per seed, ``jobs`` threads at once.
+  Bridge backend: the seeds' task bodies run across ``jobs`` FORKED
+  workers behind one shared device decision kernel
+  (``bridge/pool.py``) — per-seed trajectories stay bit-identical to
+  ``jobs=1`` (docs/bridge.md "Parallel task bodies").
 - ``MADSIM_TEST_CONFIG`` — path to a TOML config file
 - ``MADSIM_TEST_TIME_LIMIT``        — virtual-time limit per run, seconds
 - ``MADSIM_TEST_CHECK_DETERMINISM`` — run each seed twice with RNG log/replay
@@ -311,10 +316,15 @@ class Builder:
             sort_keys=True).encode()).hexdigest()[:16]
         # Backend knobs ride the banner too: a bridge-backend failure is
         # only reproducible under the same backend + batch width, and the
-        # defaults depend on the invoking environment.
+        # defaults depend on the invoking environment. jobs is recorded
+        # for completeness even though trajectories are jobs-invariant
+        # (the bridge pool's bitwise contract, tests/test_bridge_pool.py)
+        # — a pool-infrastructure failure is not.
         env_line = f"MADSIM_TEST_BACKEND={self.backend}"
         if self.batch is not None:
             env_line += f" MADSIM_TEST_BATCH={self.batch}"
+        if self.backend == "bridge" and self.jobs > 1:
+            env_line += f" MADSIM_TEST_JOBS={self.jobs}"
         banner = (
             "note: run with environment variable "
             f"MADSIM_TEST_SEED={seed} to reproduce this failure\n"

@@ -305,10 +305,12 @@ def test_bridge_timer_capacity_error_is_actionable():
 
 
 def test_bridge_jobs_sharding():
-    # jobs=2 forks workers (MADSIM_TEST_JOBS analog); same outcomes, by
-    # seed order. Forking requires a jax-uninitialized parent, so this
-    # runs in a fresh interpreter (in-process it silently falls back to
-    # the single-loop path, also exercised here).
+    # jobs=2 runs task bodies across forked pool workers behind one
+    # shared kernel (bridge/pool.py, MADSIM_TEST_JOBS analog); same
+    # outcomes, by seed order. The fresh-interpreter leg exercises the
+    # cold path (no warm jit caches, no prior fork); the in-process leg
+    # pools from a jax-live parent — the pool's own determinism matrix
+    # lives in tests/test_bridge_pool.py.
     import subprocess
     import sys
     import textwrap

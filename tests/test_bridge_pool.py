@@ -1,0 +1,329 @@
+"""Forked worker pool behind the bridge kernel (bridge/pool.py).
+
+The contract under test (ROADMAP item 4): ``sweep(jobs=J)`` runs the W
+live worlds' Python task bodies across J forked workers behind ONE
+shared device decision kernel, and per-seed traces, send accounting, and
+mixed-outcome attribution stay BIT-IDENTICAL to ``jobs=1`` and to the
+serial in-process loop — for every J, every batch width, and every
+W % J remainder, exactly as ``bridge.sweep(batch=N)`` gates batching.
+Worker death mid-round must raise a pointed BridgePoolError (no hangs)
+and leave no orphaned shared-memory segments.
+"""
+import glob
+import os
+import signal
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import time as vtime
+from madsim_tpu.bridge import sweep, sweep_traced
+from madsim_tpu.bridge.pool import BridgePoolError, sweep_pooled
+from madsim_tpu.bridge.runtime import PackBufferCache
+from madsim_tpu.net import Endpoint, rpc
+
+SEEDS = list(range(12))
+
+
+class Ping:
+    __slots__ = ("n",)
+
+    def __init__(self, n):
+        self.n = n
+
+
+async def _await(f):
+    return await f
+
+
+def _pingpong_world(rounds=4, timeout=0.3):
+    """Bench-config-1-shaped RPC world; returns (sum, msg_count) so the
+    outcome VALUE carries the send accounting the kernel's loss draws
+    decide — any accounting divergence fails the value equality."""
+
+    async def world():
+        from madsim_tpu.net import NetSim
+
+        h = ms.Handle.current()
+
+        async def server_init():
+            ep = await Endpoint.bind("10.0.0.1:9000")
+
+            async def handle(req):
+                return req.n + 1
+
+            rpc.add_rpc_handler(ep, Ping, handle)
+            await vtime.sleep(1e6)
+
+        h.create_node(name="server", ip="10.0.0.1", init=server_init)
+        client = h.create_node(name="client", ip="10.0.0.2")
+        done = ms.sync.SimFuture()
+
+        async def client_body():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            got = 0
+            for i in range(rounds):
+                while True:
+                    try:
+                        got += await rpc.call(ep, "10.0.0.1:9000", Ping(i),
+                                              timeout=timeout)
+                        break
+                    except TimeoutError:
+                        pass
+            done.set_result(got)
+
+        client.spawn(client_body())
+        got = await vtime.timeout(600, _await(done))
+        stat = ms.simulator(NetSim).network.stat
+        return got, stat.msg_count
+
+    return world
+
+
+def _lossy_cfg(p=0.1):
+    c = ms.Config()
+    c.net.packet_loss_rate = p
+    return c
+
+
+def _key(outs):
+    return [(o.seed, o.value, type(o.error).__name__ if o.error else None,
+             str(o.error) if o.error else None) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_pool_bitwise_identical_matrix():
+    """jobs=J == jobs=1 (one pooled worker) == serial, bitwise on traces
+    + outcomes + send accounting, for J x batch including non-dividing
+    W % J remainders (batch=5 over J=4 slices as 2/1/1/1)."""
+    world = _pingpong_world()
+    serial, tr_serial = sweep_traced(world, SEEDS, config=_lossy_cfg())
+    for batch in (None, 5):
+        ref, tr_ref = sweep_traced(world, SEEDS, config=_lossy_cfg(),
+                                   batch=batch)
+        assert tr_ref == tr_serial, f"serial batch={batch} diverged"
+        for jobs in (1, 2, 3, 4):
+            outs, trs = sweep_pooled(world, SEEDS, jobs=jobs,
+                                     config=_lossy_cfg(), trace=True,
+                                     batch=batch)
+            assert trs == tr_serial, (jobs, batch)
+            assert _key(outs) == _key(serial), (jobs, batch)
+
+
+def test_pool_config_grid_and_remainder_seeds():
+    """Per-world configs slice correctly across worker seed shards, and a
+    seed count that divides into uneven shards attributes by position."""
+    world = _pingpong_world(rounds=3)
+    seeds, cfgs = [], []
+    for s in range(7):  # 7 seeds over 3 workers: shards of 3/2/2
+        seeds.append(s)
+        cfgs.append(_lossy_cfg(0.0 if s % 2 else 0.15))
+    serial, tr_serial = sweep_traced(world, seeds, configs=cfgs)
+    outs, trs = sweep_pooled(world, seeds, jobs=3, configs=cfgs, trace=True)
+    assert trs == tr_serial
+    assert _key(outs) == _key(serial)
+
+
+def test_pool_mixed_outcomes_with_recycling():
+    """Error attribution across slot generations under jobs x batch: odd
+    seeds raise, even seeds return — same contract as the serial
+    bridge's batched sweep (test_bridge_batched_sweep_mixed_outcomes)."""
+
+    async def world(seed):
+        await vtime.sleep(0.1 * (seed % 3 + 1))
+        if seed % 2:
+            raise ValueError(f"boom {seed}")
+        return seed * 10
+
+    for jobs in (2, 3):
+        outs = sweep(world, list(range(9)), jobs=jobs, batch=2)
+        for seed, o in enumerate(outs):
+            assert o.seed == seed
+            if seed % 2:
+                assert isinstance(o.error, ValueError)
+                assert str(seed) in str(o.error)
+            else:
+                assert o.error is None and o.value == seed * 10
+
+
+def test_pool_single_seed_and_tiny_batches():
+    """Degenerate widths: jobs clamps to W (batch=1 -> one worker), a
+    single seed routes through unchanged."""
+
+    async def world():
+        await vtime.sleep(0.05)
+        return ms.Handle.current().seed + 100
+
+    serial, tr = sweep_traced(world, [7])
+    outs, trs = sweep_pooled(world, [7], jobs=4, trace=True)
+    assert trs == tr and _key(outs) == _key(serial)
+    serial6, tr6 = sweep_traced(world, list(range(6)))
+    outs6, trs6 = sweep_pooled(world, list(range(6)), jobs=4, trace=True,
+                               batch=1)
+    assert trs6 == tr6 and _key(outs6) == _key(serial6)
+
+
+def test_pool_drain_rounds_bit_identical():
+    """Due clusters wider than k_events force drain rounds; the pool's
+    shared-memory drain scatter must fire them in exact host-heap
+    (deadline, seq) order per world."""
+    N = 11
+
+    async def world():
+        order = []
+
+        async def sleeper(i):
+            await vtime.sleep(0.5 if i % 3 else 0.5 + 0.001 * i)
+            order.append(i)
+
+        for i in range(N):
+            ms.task.spawn(sleeper(i))
+        await vtime.sleep(2.0)
+        return tuple(order)
+
+    serial, tr = sweep_traced(world, SEEDS[:4], k_events=2)
+    outs, trs = sweep_pooled(world, SEEDS[:4], jobs=2, trace=True,
+                             k_events=2)
+    assert trs == tr
+    assert _key(outs) == _key(serial)
+
+
+def test_pool_fetch_seam_counts_only_drains(monkeypatch):
+    """Sync discipline: the parent round loop's only blocking drain
+    materializations route through the sanctioned pool._fetch seam —
+    a drain-free sweep crosses it zero times."""
+    from madsim_tpu.bridge import pool as pool_mod
+
+    calls = []
+    real = pool_mod._fetch
+    monkeypatch.setattr(pool_mod, "_fetch",
+                        lambda x: (calls.append(1), real(x))[1])
+
+    async def world():
+        await vtime.sleep(0.05)
+        return ms.Handle.current().seed
+
+    sweep_pooled(world, SEEDS[:4], jobs=2)
+    assert calls == [], "non-drain round crossed the blocking seam"
+
+
+def test_pool_worker_crash_raises_pointed_error():
+    """SIGKILL one worker mid-round: the parent must raise BridgePoolError
+    naming worker/slot-range/round (no hang, no partial batch) and unlink
+    every shared-memory segment."""
+    parent = os.getpid()
+
+    async def world():
+        s = ms.Handle.current().seed
+        await vtime.sleep(0.1)
+        if s == 6 and os.getpid() != parent:
+            os.kill(os.getpid(), signal.SIGKILL)  # die mid host burst
+        return s
+
+    with pytest.raises(BridgePoolError) as ei:
+        sweep_pooled(world, list(range(8)), jobs=2)
+    err = ei.value
+    assert err.worker == 1 and err.slots == (4, 8)
+    assert err.round_no is not None and err.round_no >= 1
+    assert "worker 1" in str(err) and "slots 4..7" in str(err)
+    assert f"round {err.round_no}" in str(err)
+    if os.path.isdir("/dev/shm"):  # the no-orphaned-segments contract
+        assert glob.glob("/dev/shm/msbp-*") == []
+
+
+@pytest.mark.slow
+def test_pool_process_leg_fresh_interpreter():
+    """PR 7-style process leg: the whole pool pipeline in a fresh
+    interpreter (cold jit caches, cold fork server), crash leg included."""
+    import subprocess
+    import sys
+    import textwrap
+
+    src = textwrap.dedent("""
+        import glob, os, signal, sys
+        sys.path.insert(0, %r)
+        import madsim_tpu as ms
+        from madsim_tpu import time as vtime
+        from madsim_tpu.bridge import sweep_traced
+        from madsim_tpu.bridge.pool import BridgePoolError, sweep_pooled
+
+        async def world():
+            s = ms.Handle.current().seed
+            await vtime.sleep(0.05 * (s %% 3 + 1))
+            return s + 100
+
+        serial, tr = sweep_traced(world, list(range(8)))
+        outs, trs = sweep_pooled(world, list(range(8)), jobs=2, trace=True)
+        assert trs == tr and [o.value for o in outs] == \\
+            [o.value for o in serial]
+
+        parent = os.getpid()
+
+        async def crasher():
+            s = ms.Handle.current().seed
+            await vtime.sleep(0.1)
+            if s == 3 and os.getpid() != parent:
+                os.kill(os.getpid(), signal.SIGKILL)
+            return s
+
+        try:
+            sweep_pooled(crasher, list(range(4)), jobs=2)
+            raise SystemExit("crash leg did not raise")
+        except BridgePoolError as e:
+            assert e.worker == 1, e
+        if os.path.isdir("/dev/shm"):
+            assert glob.glob("/dev/shm/msbp-*") == []
+        print("POOL_PROC_OK")
+    """) % str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                          text=True, timeout=300,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "POOL_PROC_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+
+def test_pack_buffer_cache_is_bounded():
+    """The per-(W, T, C, S) pack-buffer cache must not accumulate without
+    limit across sweeps/rounds with varying shapes (LRU bound), while
+    still returning the SAME arrays for a repeated shape."""
+    cache = PackBufferCache(maxsize=8)
+    first = cache.get(4, 4, 4, 4)
+    assert cache.get(4, 4, 4, 4)[0] is first[0]  # hit: same storage
+    for t in range(30):  # 30 distinct shapes stream through
+        cache.get(8, 4 << (t % 5), 4, 4 << (t // 5))
+    assert len(cache) <= 8
+    # a key kept recent survives further churn (LRU, not FIFO)
+    hot = cache.get(4, 4, 4, 4)
+    for t in range(6):
+        cache.get(16, 4, 4 << t, 4)
+        assert cache.get(4, 4, 4, 4)[0] is hot[0]
+    assert len(cache) <= 8
+
+
+def test_module_pack_cache_bounded_across_sweeps():
+    """Re-sweeping many widths must not grow the process-global cache
+    past its bound (each W is a distinct key)."""
+    from madsim_tpu.bridge import runtime as rt_mod
+
+    async def world():
+        await vtime.sleep(0.05)
+        return ms.Handle.current().seed
+
+    for w in range(1, 12):
+        sweep(world, list(range(w)))
+    assert len(rt_mod._PACK_BUFFERS) <= rt_mod._PACK_BUFFERS.maxsize
+
+
+def test_builder_jobs_routes_bridge_backend():
+    """MADSIM_TEST_JOBS / Builder(jobs=) reaches the pool on the bridge
+    backend: same last-seed result as jobs=1."""
+    from madsim_tpu.testing import Builder
+
+    async def body():
+        await vtime.sleep(0.05)
+        return ms.Handle.current().seed * 3
+
+    r1 = Builder(seed=5, count=6, jobs=1, backend="bridge").run(body)
+    r2 = Builder(seed=5, count=6, jobs=2, backend="bridge").run(body)
+    assert r1 == r2 == 10 * 3

@@ -78,6 +78,17 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.time_to_first_bug.coverage.distinct_behaviors", True),
     ("bridge seeds/s", "configs.bridge_sweep.bridge_seeds_per_sec", True),
     ("bridge vs host", "configs.bridge_sweep.bridge_vs_host", True),
+    # Forked worker pool behind the shared kernel (bridge/pool.py,
+    # ROADMAP item 4): throughput vs host at J=2, protocol overhead vs
+    # the serial loop on the same seeds (the 1-core gate), and the
+    # parent's own per-round Python work, which must stay ~O(1) in W
+    # (the pack loop left the parent).
+    ("bridge pool j2 vs host",
+     "configs.bridge_sweep.pool.j2_w64.bridge_vs_host", True),
+    ("bridge pool j2 overhead frac",
+     "configs.bridge_sweep.pool.j2_w64.pool_overhead_frac", False),
+    ("bridge pool j2 parent ms/round",
+     "configs.bridge_sweep.pool.j2_w64.parent_ms_per_round", False),
     ("host engine seeds/s", "configs.host_engine.seeds_per_sec", True),
     # Fleet fabric overhead (docs/fleet.md; bench_fleet_sweep): the
     # 2-worker local fabric's rate vs the single-host sweep on the same

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Bridge worker-pool demo: the jobs=J bitwise contract, end to end.
+
+The `make bridge-pool-demo` CI gate (docs/bridge.md "Parallel task
+bodies"; ROADMAP item 4):
+
+1. Sweep a mixed-outcome suite (values, raised errors, deadlocks, a
+   time limit, lossy RPC send accounting) through the bridge THREE
+   ways — serial in-process, pooled jobs=1, pooled jobs=2 (uneven
+   W % J split) — and assert per-seed poll traces, outcomes, and error
+   attribution are BITWISE identical, with and without batch recycling.
+2. Crash leg: SIGKILL one worker mid-round and assert the parent raises
+   a pointed BridgePoolError naming the worker/slot-range/round, exits
+   cleanly (no hang), and unlinks every shared-memory segment.
+
+Nonzero exit on any miss.
+"""
+import glob
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import madsim_tpu as ms  # noqa: E402
+from madsim_tpu import time as vtime  # noqa: E402
+from madsim_tpu.bridge import sweep_traced  # noqa: E402
+from madsim_tpu.bridge.pool import BridgePoolError, sweep_pooled  # noqa: E402
+from madsim_tpu.core.task import Deadlock  # noqa: E402
+from madsim_tpu.net import Endpoint, NetSim, rpc  # noqa: E402
+
+SEEDS = list(range(10))
+
+
+class Ping:
+    __slots__ = ("n",)
+
+    def __init__(self, n):
+        self.n = n
+
+
+async def _await(f):
+    return await f
+
+
+async def world(seed):
+    """Mixed-outcome world: seeds 0/5 deadlock-adjacent sleeps, 3/7
+    raise, the rest run a lossy RPC exchange and return (sum, sends)."""
+    if seed % 5 == 0:
+        await vtime.sleep(0.2)
+        await _await(ms.sync.SimFuture())  # deadlock: nothing resolves it
+    if seed % 4 == 3:
+        await vtime.sleep(0.1 * (seed % 3 + 1))
+        raise ValueError(f"boom {seed}")
+    h = ms.Handle.current()
+
+    async def server_init():
+        ep = await Endpoint.bind("10.0.0.1:9000")
+
+        async def handle(req):
+            return req.n * 2
+
+        rpc.add_rpc_handler(ep, Ping, handle)
+        await vtime.sleep(1e6)
+
+    h.create_node(name="server", ip="10.0.0.1", init=server_init)
+    client = h.create_node(name="client", ip="10.0.0.2")
+    done = ms.sync.SimFuture()
+
+    async def client_body():
+        ep = await Endpoint.bind("10.0.0.2:0")
+        got = 0
+        for i in range(4):
+            while True:
+                try:
+                    got += await rpc.call(ep, "10.0.0.1:9000", Ping(i),
+                                          timeout=0.3)
+                    break
+                except TimeoutError:
+                    pass
+        done.set_result(got)
+
+    client.spawn(client_body())
+    got = await vtime.timeout(600, _await(done))
+    return got, ms.simulator(NetSim).network.stat.msg_count
+
+
+def lossy():
+    c = ms.Config()
+    c.net.packet_loss_rate = 0.12
+    return c
+
+
+def key(outs):
+    return [(o.seed, o.value, type(o.error).__name__ if o.error else None,
+             str(o.error) if o.error else None) for o in outs]
+
+
+def main() -> int:
+    print("== bridge pool demo: jobs=J bitwise == jobs=1 == serial ==")
+    serial, tr_serial = sweep_traced(world, SEEDS, config=lossy())
+    n_deadlocks = sum(isinstance(o.error, Deadlock) for o in serial)
+    n_raises = sum(isinstance(o.error, ValueError) for o in serial)
+    assert n_deadlocks and n_raises and any(o.value for o in serial), \
+        "suite is not mixed-outcome — demo would prove nothing"
+    for batch in (None, 3):
+        for jobs in (1, 2):
+            outs, trs = sweep_pooled(world, SEEDS, jobs=jobs, trace=True,
+                                     config=lossy(), batch=batch)
+            assert trs == tr_serial, \
+                f"traces diverged at jobs={jobs} batch={batch}"
+            assert key(outs) == key(serial), \
+                f"outcomes diverged at jobs={jobs} batch={batch}"
+            print(f"  jobs={jobs} batch={batch}: {len(SEEDS)} seeds "
+                  f"bitwise ok ({n_deadlocks} deadlocks, {n_raises} raises)")
+
+    print("== crash leg: SIGKILL a worker mid-round ==")
+    parent = os.getpid()
+
+    async def crasher(seed):
+        await vtime.sleep(0.1)
+        if seed == 7 and os.getpid() != parent:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return seed
+
+    try:
+        sweep_pooled(crasher, SEEDS, jobs=2)
+        print("FAIL: worker crash did not raise BridgePoolError")
+        return 1
+    except BridgePoolError as exc:
+        assert exc.worker == 1 and exc.slots == (5, 10), exc
+        assert exc.round_no is not None, exc
+        assert "worker 1" in str(exc) and "slots 5..9" in str(exc), exc
+        print(f"  pointed error ok: {exc}")
+    if os.path.isdir("/dev/shm"):
+        leftover = glob.glob("/dev/shm/msbp-*")
+        assert not leftover, f"orphaned shared-memory segments: {leftover}"
+        print("  no orphaned shared-memory segments")
+    print("BRIDGE POOL DEMO OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
