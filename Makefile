@@ -8,14 +8,15 @@ PY ?= python
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check lint detlint tracelint test smoke dryrun determinism \
-        dualmode native clean replay-demo bench-diff chaos chaos-full \
-        triage-demo fuzz-demo actorc-demo bridge-pool-demo
+.PHONY: check lint detlint tracelint speclint speclint-demo test smoke \
+        dryrun determinism dualmode native clean replay-demo bench-diff \
+        chaos chaos-full triage-demo fuzz-demo actorc-demo \
+        bridge-pool-demo
 
 check: lint test smoke dryrun determinism
 	@echo "ALL CHECKS PASSED"
 
-# The static gate, two layers (docs/detlint.md):
+# The static gate, four passes in three legs (docs/detlint.md):
 #  - detlint: AST passes — nondeterminism escapes (DET*), sim/real API
 #    parity (PAR*), hot-loop sync discipline (DET008/DET009).
 #  - tracelint: program-level pass — jaxpr rules over the compiled
@@ -24,15 +25,28 @@ check: lint test smoke dryrun determinism
 #    compile FRESH (the persistent cache strips cost/alias stats), so
 #    this leg costs real compile time — that is the point: an op-budget
 #    regression fails `make lint` before a bench round ever runs.
+#  - speclint: protocol-level pass (docs/speclint.md) — the shipped
+#    actorc family specs verified BEFORE lowering: reachability,
+#    exhaustiveness, timer discipline, lane-capacity proofs, RNG/effect
+#    budgets, durability flow (SPC*).
 # Zero findings required; intentional sites are covered by
 # detlint-allow.txt and inline `detlint: allow[RULE]` pragmas.
-lint: detlint tracelint
+lint: detlint tracelint speclint
 
 detlint:
 	$(PY) -m madsim_tpu.analysis madsim_tpu tools
 
 tracelint:
 	$(CPU_ENV) $(PY) tools/update_budgets.py --check
+
+speclint:
+	$(CPU_ENV) $(PY) -m madsim_tpu.analysis spec
+
+# Pass 4's protocol card for the Paxos family — the kinds x handlers
+# matrix, timer graph and lane budget table, rendered byte-stably (CI
+# runs it twice and diffs: the static profile must not wobble).
+speclint-demo:
+	$(CPU_ENV) $(PY) -m madsim_tpu.analysis spec --card paxos
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -437,6 +437,12 @@ class CompiledActor:
 
     def __init__(self, spec: ActorSpec):
         validate_spec(spec)  # spec-internal checks at construction
+        # Pass 4 gate: protocol-level verification (reachability,
+        # exhaustiveness, timer discipline, capacity/budget proofs)
+        # BEFORE any lowering — a spec with speclint findings does not
+        # compile. Escape hatch: spec.lint_allow (per code, or "*").
+        from ..analysis.speclint import gate_spec
+        gate_spec(spec)
         self.spec = spec
         self.num_kinds = len(spec.messages)
         # Generated families always trace/replay readably: the

@@ -84,8 +84,10 @@ def paxos_spec(xcfg: PaxosConfig) -> ActorSpec:
              durable=durable_acc),
         Lane("acc_val", hi=I16, scope="node_table", cols=S,
              durable=durable_acc),
-        # Proposer lanes.
-        Lane("prop_bal", hi=I16, scope="node_table", cols=S),
+        # Proposer lanes. prop_bal stops short of the int16 rail: the
+        # retry path bids prop_bal + n, and speclint's capacity proof
+        # (SPC030) demands the bumped ballot still fit the packed lane.
+        Lane("prop_bal", hi=32000, scope="node_table", cols=S),
         Lane("prop_val", hi=I16, scope="node_table", cols=S),
         Lane("promises", hi=(1 << 31) - 1, scope="node_table", cols=S,
              kind="bitmask"),
@@ -108,12 +110,15 @@ def paxos_spec(xcfg: PaxosConfig) -> ActorSpec:
         Message("Promise", (Word("bal", 1, I16), Word("slot", 0, S - 1),
                             Word("abal", 0, I16), Word("aval", 0, I16),
                             Word("voter", 0, n - 1))),
+        # val words admit 0 here: the adopted value is where(seen, seen,
+        # own) whose static lower bound is the lanes' 0 floor, and the
+        # payload-bound proof (SPC031) holds sends to declared ranges.
         Message("Accept", (Word("bal", 1, I16), Word("slot", 0, S - 1),
-                           Word("val", 1, I16))),
+                           Word("val", 0, I16))),
         Message("Accepted", (Word("bal", 1, I16), Word("slot", 0, S - 1),
                              Word("voter", 0, n - 1),
-                             Word("val", 1, I16))),
-        Message("Chosen", (Word("slot", 0, S - 1), Word("val", 1, I16))),
+                             Word("val", 0, I16))),
+        Message("Chosen", (Word("slot", 0, S - 1), Word("val", 0, I16))),
         Message("Retry", (Word("slot", 0, S - 1),), timer=True),
     )
 
@@ -286,6 +291,11 @@ def paxos_spec(xcfg: PaxosConfig) -> ActorSpec:
         observe={"slots_decided": obs_slots_decided,
                  "max_ballot": obs_max_ballot},
         invariant_id="paxos_chosen_conflict",
+        terminal=("Chosen",),
+        # The forgetful-acceptor variant deliberately trips speclint's
+        # durability rule — the amnesia IS the experiment (the lanes go
+        # volatile with nothing to reconstruct them).
+        lint_allow=("SPC050",) if x.buggy_forgetful_acceptor else (),
     )
 
 

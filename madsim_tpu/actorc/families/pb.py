@@ -35,13 +35,17 @@ def pb_spec(pcfg) -> ActorSpec:
     n, L = p.n, p.log_cap
 
     lanes = (
-        Lane("view", hi=I16),                      # current view per node
-        Lane("log_len", hi=I16),
+        # view/log_len/wd_epoch stop short of the int16 rail: their
+        # transitions bump by +1 (or +n for the view-change candidate),
+        # and speclint's capacity proof (SPC030) demands the bumped
+        # value still fit the packed lane the declaration selects.
+        Lane("view", hi=32000),                    # current view per node
+        Lane("log_len", hi=32000),
         Lane("log_cmd", hi=I16, scope="node_table", cols=L),
         Lane("commit", hi=I16),                    # known-committed index
         Lane("acks", hi=(1 << 31) - 1, scope="node_table", cols=L,
              kind="bitmask", durable=False),       # volatile bookkeeping
-        Lane("wd_epoch", hi=I16),                  # stale-watchdog guard
+        Lane("wd_epoch", hi=32000),                # stale-watchdog guard
         Lane("committed_cmd", hi=I16, scope="world_vec", cols=L),
         Lane("committed_max", hi=I16, scope="world"),
         Lane("views_changed", hi=(1 << 31) - 1, scope="world",
@@ -235,4 +239,5 @@ def pb_spec(pcfg) -> ActorSpec:
                  "committed_max": obs("committed_max", None),
                  "min_commit": obs("commit", "min")},
         invariant_id="pb_durability",
+        terminal=("Commit",),
     )

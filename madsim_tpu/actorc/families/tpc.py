@@ -177,4 +177,5 @@ def tpc_spec(tcfg) -> ActorSpec:
         invariant=invariant,
         observe={"blocked": obs_blocked},
         invariant_id="tpc_atomicity",
+        terminal=("Decide",),
     )

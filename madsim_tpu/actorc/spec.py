@@ -121,6 +121,19 @@ class ActorSpec:
     ``on_restart`` (optional) runs after the volatile-lane resets;
     ``invariant`` is the per-step bug predicate over full lane views;
     ``observe`` adds derived metrics beyond the auto-exported counters.
+
+    The last three fields feed pass 4 of the analysis stack
+    (:mod:`madsim_tpu.analysis.speclint`), which gates compilation:
+    ``ignore`` lists message kinds a node may legitimately receive and
+    drop (exhaustiveness rule SPC011 demands every other kind be
+    handled); ``terminal`` lists kinds whose handlers absorb without
+    emitting (declared dead ends — an undeclared no-op transition is
+    SPC012, a terminal kind that still emits is SPC013); ``lint_allow``
+    names SPC codes this spec deliberately trips (the intentionally
+    buggy experiment variants), with ``("*",)`` as the fixture escape
+    hatch that waives the pass entirely. A ``lint_allow`` code that
+    suppresses nothing is itself a finding (SPC900), so allowances
+    cannot go stale.
     """
 
     name: str
@@ -134,6 +147,9 @@ class ActorSpec:
     observe: Mapping[str, Callable[[Any], Any]] = \
         dataclasses.field(default_factory=dict)
     invariant_id: str = ""
+    ignore: Tuple[str, ...] = ()
+    terminal: Tuple[str, ...] = ()
+    lint_allow: Tuple[str, ...] = ()
 
     def lane(self, name: str) -> Lane:
         for ln in self.lanes:

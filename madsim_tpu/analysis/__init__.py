@@ -1,6 +1,6 @@
 """detlint — static determinism analysis for madsim_tpu programs.
 
-Two passes (docs/detlint.md):
+Four passes (docs/detlint.md):
 
 1. **Nondeterminism-escape detection** (:mod:`.escape`): AST scan for
    calls that bypass the sim's interception layer — wall clock, ambient
@@ -20,11 +20,22 @@ Two passes (docs/detlint.md):
    (TRC003), donation contracts (TRC004), and the checked-in cost-budget
    ledger ``analysis/budgets.json`` (BUD001/002, :mod:`.budgets`).
 
-CLI: ``python -m madsim_tpu.analysis`` / ``... trace`` (or
-``tools/detlint.py``); ``make lint`` is the repo gate (detlint +
-tracelint). Suppression: ``# detlint: allow[RULE]`` pragmas (stale ones
-are errors; DET008/009 waivers need ``reason=``) + the checked-in
-``detlint-allow.txt`` (stale lines are DET901 errors).
+4. **Protocol-level speclint** (:mod:`.speclint`): static verification
+   of ``actorc.spec`` state machines before the compiler lowers them —
+   reachability/exhaustiveness over the kind graph, timer discipline,
+   interval proofs that written values fit their packed lane dtypes and
+   emitted payload words fit their declared ranges, per-transition
+   RNG/send/arm budgets against what the lowering supports, and the
+   durability-flow check (SPC0xx). ``CompiledActor`` runs it as a hard
+   compile gate (docs/speclint.md).
+
+CLI: ``python -m madsim_tpu.analysis`` / ``... trace`` / ``... spec``
+(or ``tools/detlint.py``); ``make lint`` is the repo gate (detlint +
+tracelint + speclint). Suppression: ``# detlint: allow[RULE]`` pragmas
+(stale ones are errors; DET008/009 waivers need ``reason=``) + the
+checked-in ``detlint-allow.txt`` (stale lines are DET901 errors) + the
+spec-level ``lint_allow`` tuple for SPC codes (stale entries are
+SPC900 errors).
 """
 from .cli import main, main_trace, run_lint
 from .escape import run_escape_pass, scan_source
@@ -32,7 +43,23 @@ from .parity import run_parity_pass
 from .pragmas import Allowlist, Finding
 from .rules import RULES, Rule
 
+
+def main_spec(argv=None):
+    """Pass-4 CLI entry (lazy: speclint pulls in jax via the specs)."""
+    from .speclint import main_spec as _main_spec
+
+    return _main_spec(argv)
+
+
+def lint_spec(spec, root=None):
+    """Pass-4 library entry (lazy import, same reason as main_spec)."""
+    from .speclint import lint_spec as _lint_spec
+
+    return _lint_spec(spec, root=root)
+
+
 __all__ = [
-    "main", "main_trace", "run_lint", "run_escape_pass", "run_parity_pass",
+    "main", "main_trace", "main_spec", "run_lint", "lint_spec",
+    "run_escape_pass", "run_parity_pass",
     "scan_source", "Allowlist", "Finding", "RULES", "Rule",
 ]

@@ -8,6 +8,9 @@ usage/config error — the Makefile/CI gate is just the exit code):
 - ``python -m madsim_tpu.analysis trace`` — pass 3 (tracelint): jaxpr
   rules over the registered hot-path programs plus the budget-ledger
   diff (``--no-budgets`` for the trace rules alone).
+- ``python -m madsim_tpu.analysis spec`` — pass 4 (speclint): protocol
+  verification of the shipped actorc specs (``--card FAMILY`` prints a
+  family's protocol card instead of linting).
 
 Output: human text (default), ``--json`` machine-readable findings, or
 ``--format=github`` workflow-annotation lines so CI findings surface as
@@ -184,12 +187,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "trace":
         return main_trace(argv[1:])
+    if argv and argv[0] == "spec":
+        _prepare_trace_env()  # specs import jax the same way programs do
+        from .speclint import main_spec
+
+        return main_spec(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="detlint",
         description="madsim_tpu static analyzer: nondeterminism escapes "
                     "(pass 1) + sim/real API parity (pass 2); "
-                    "`trace` subcommand for pass 3 (tracelint)")
+                    "`trace` subcommand for pass 3 (tracelint), `spec` "
+                    "subcommand for pass 4 (speclint)")
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
     ap.add_argument("--root", default=".",

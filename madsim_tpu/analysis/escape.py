@@ -28,8 +28,8 @@ from typing import Dict, List, Optional
 from .pragmas import Allowlist, Finding, apply_pragmas, extract_pragmas
 from .rules import (ATTR_CALLS, CLOCK_DEFAULT_CALLS, CONVERT_BUILTINS,
                     CONVERT_NP, DEVICE_CALLS, EXACT_CALLS, FETCH_NAMES,
-                    HOT_LOOP_MARKER, HOT_LOOP_MODULES, PREFIX_CALLS, RULES,
-                    SYNC_CALLS, SYNC_METHODS)
+                    HOT_LOOP_MARKER, HOT_LOOP_MODULES, LOOP_ATTR_CALLS,
+                    PREFIX_CALLS, RULES, SYNC_CALLS, SYNC_METHODS)
 
 _SORT_BUILTINS = {"sorted", "min", "max"}
 
@@ -145,6 +145,19 @@ class _CallScanner(ast.NodeVisitor):
         # Method-name-only table: receivers with no static type.
         if isinstance(func, ast.Attribute) and func.attr in ATTR_CALLS:
             self._flag(node, ATTR_CALLS[func.attr], f".{func.attr}()")
+            return
+        # Receiver-scoped method table: `loop.time()` reads the host
+        # monotonic clock, but the method name alone is far too common
+        # to flag (`self.time()` is the shim loop's own virtual clock) —
+        # the receiver must be a bare name that IS an event-loop handle
+        # by naming convention (`loop`, `event_loop`, ...).
+        if isinstance(func, ast.Attribute) and func.attr in LOOP_ATTR_CALLS \
+                and isinstance(func.value, ast.Name):
+            lrule, receivers = LOOP_ATTR_CALLS[func.attr]
+            rid = func.value.id
+            if rid in receivers or any(rid.endswith("_" + r)
+                                       for r in receivers):
+                self._flag(node, lrule, f"{rid}.{func.attr}()")
 
 
 def _looks_stdlib(head: str) -> bool:
@@ -434,7 +447,12 @@ def scan_source(source: str, path: str,
     if hot if hot is not None else is_hot_loop_module(path, source):
         findings = findings + run_sync_pass(tree, path, table.names)
     findings.sort(key=lambda f: (f.line, f.rule))
-    return apply_pragmas(findings, extract_pragmas(source), path)
+    # Pass 1 owns DET/TRC/BUD/PAR pragma codes for staleness (DET900);
+    # SPC codes belong to pass 4 (speclint), which runs its own
+    # staleness check over the spec's source files — an allow[SPC...]
+    # on a handler line must not read as stale from here.
+    return apply_pragmas(findings, extract_pragmas(source), path,
+                         owned_prefixes=("DET", "TRC", "BUD", "PAR"))
 
 
 def iter_py_files(root: str, paths: List[str]) -> List[str]:

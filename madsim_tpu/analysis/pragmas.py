@@ -77,9 +77,19 @@ def extract_pragmas(source: str) -> Dict[int, Tuple[int, Dict[str, Optional[str]
 
 def apply_pragmas(findings: List[Finding],
                   pragmas: Dict[int, Tuple[int, Dict[str, Optional[str]]]],
-                  path: str) -> List[Finding]:
+                  path: str,
+                  owned_prefixes: Optional[Tuple[str, ...]] = None,
+                  ) -> List[Finding]:
     """Drop findings covered by a pragma; emit DET900 for unused codes and
-    for sync-discipline waivers missing their ``reason=`` tail."""
+    for sync-discipline waivers missing their ``reason=`` tail.
+
+    ``owned_prefixes`` scopes the staleness check across passes: each
+    pass suppresses any code, but reports DET900 only for codes whose
+    prefix it OWNS (pass 1 owns DET/TRC/BUD/PAR, pass 4 owns SPC) —
+    otherwise a legitimate ``allow[SPC...]`` pragma would read as stale
+    to pass 1, which never produces SPC findings. ``None`` keeps the
+    single-pass behavior: every code is checked.
+    """
     used: Dict[Tuple[int, str], bool] = {}
     for line, (_pline, codes) in pragmas.items():
         for code in codes:
@@ -93,6 +103,9 @@ def apply_pragmas(findings: List[Finding],
         kept.append(f)
     for line, (pline, codes) in sorted(pragmas.items()):
         for code in sorted(codes):
+            if owned_prefixes is not None and \
+                    not code.startswith(owned_prefixes):
+                continue
             if not used.get((line, code), False):
                 kept.append(Finding(
                     path, pline, "DET900",

@@ -18,6 +18,17 @@ they fire on *compiled programs* — the traced jaxprs and XLA executables
 of the hot-path entry points — not on source lines, because the
 determinism and performance contracts of the superstep loop, donated
 buffers, and the coverage fold live below the Python AST.
+
+``SPC`` rules belong to pass 4 (speclint, :mod:`.speclint`): they fire
+on *protocol state machines* — the ``actorc.spec`` declarations —
+before the compiler lowers them to packed lanes. Where passes 1–3
+police how code executes, pass 4 polices what the protocol *says*:
+unreachable kinds, unhandled deliveries, unarmed timers, counters whose
+static bound escapes their packed dtype, transitions leaning on DSL
+features the lowering flattens (multi-send payloads, multi-timer arms,
+>1 RNG draw), and volatile state read with no restart reconstruction.
+SPC900 is the pass's own hygiene code (a stale ``lint_allow`` entry),
+mirroring DET900/DET901.
 """
 from __future__ import annotations
 
@@ -90,6 +101,48 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
     Rule("PAR002", "public sim API without a real-backend dispatch",
          "branch on core.backend.is_real() (directly or via a helper) so the "
          "function works outside the simulation too"),
+    Rule("SPC001", "spec fails validation or abstract evaluation",
+         "fix the declaration/handler the message names — the spec cannot "
+         "lower until its own model is well-formed"),
+    Rule("SPC010", "unreachable message kind",
+         "seed it from init, emit it from a reachable transition, or delete "
+         "the dead kind (and its handler)"),
+    Rule("SPC011", "message kind delivered but not handled",
+         "add a handler, or declare the drop deliberate via ignore=(...) on "
+         "the spec — implicit drops are how real protocol bugs hide"),
+    Rule("SPC012", "transition with no effects (dead no-op handler)",
+         "implement it, delete it, or declare the kind in terminal=(...) if "
+         "absorbing is the point"),
+    Rule("SPC013", "spec declaration hygiene (ignore/terminal misuse)",
+         "ignore/terminal must name declared kinds, an ignored kind cannot "
+         "also be handled, and a terminal kind's handler must not emit"),
+    Rule("SPC020", "timer handled but never armed on any path",
+         "arm it from a transition, the on_restart hook or an init event — "
+         "or delete the dead timer"),
+    Rule("SPC021", "multiple timer arms without provably-disjoint conditions",
+         "the lowering's single merged timer row is last-write-wins; make "
+         "the arm conditions disjoint (when=cond / when=~cond) or split the "
+         "transition"),
+    Rule("SPC030", "written value can exceed the packed lane dtype",
+         "the static bound escapes the rail lane_dtype() chose from the "
+         "declared range — widen the declared range (costs a wider lane), "
+         "clip the expression, or tighten the inputs"),
+    Rule("SPC031", "emitted payload word can escape its declared range",
+         "the receiver's arg() read assumes the declared word range; widen "
+         "the Word declaration or narrow the sent expression"),
+    Rule("SPC040", "multiple sends without provably-disjoint conditions",
+         "the single merged message row broadcasts ONE payload per step — "
+         "per-destination payloads/concurrent sends are a known DSL gap; "
+         "make the send conditions disjoint or split across kinds"),
+    Rule("SPC041", "more than one RNG draw in a single transition",
+         "the static-draw-shape rule allows one draw per event; combine "
+         "draws into one mapped value or move a draw to another kind"),
+    Rule("SPC050", "volatile lane read with no on_restart reconstruction",
+         "a post-restart read sees the reset value; mark the lane durable, "
+         "or add an on_restart hook that rebuilds it"),
+    Rule("SPC900", "stale lint_allow entry: its code suppressed nothing",
+         "delete the code from the spec's lint_allow tuple (or the defect "
+         "it excused came back)"),
 ]}
 
 
@@ -111,6 +164,8 @@ EXACT_CALLS: Dict[str, str] = {
     "time.perf_counter": "DET001",
     "time.perf_counter_ns": "DET001",
     "time.process_time": "DET001",
+    "time.thread_time": "DET001",
+    "time.thread_time_ns": "DET001",
     "time.sleep": "DET001",
     "datetime.datetime.now": "DET001",
     "datetime.datetime.utcnow": "DET001",
@@ -121,6 +176,7 @@ EXACT_CALLS: Dict[str, str] = {
     "os.getrandom": "DET002",
     "uuid.uuid1": "DET002",
     "uuid.uuid4": "DET002",
+    "random.SystemRandom": "DET002",
     # DET003 — real concurrency
     "threading.Thread": "DET003",
     "threading.Timer": "DET003",
@@ -175,6 +231,16 @@ CLOCK_DEFAULT_CALLS: Dict[str, Tuple[str, int]] = {
 # identifies the escape (real threads behind the event loop).
 ATTR_CALLS: Dict[str, str] = {
     "run_in_executor": "DET003",
+}
+
+# Attribute calls that escape only on an *event-loop* receiver: the bare
+# method name is too common to flag everywhere (`self.time()` is the shim
+# loop's own virtual clock), but `loop.time()` on an asyncio loop handle
+# reads the host monotonic clock. Keyed by method name; the value's
+# receiver set is matched against a bare-name receiver (exact name, or a
+# `_`-suffix match like `event_loop`).
+LOOP_ATTR_CALLS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "time": ("DET001", ("loop",)),
 }
 
 

@@ -499,6 +499,13 @@ def _build_actorc_run(family: str) -> Callable[[], Built]:
                 acfg = PaxosConfig()
                 _ENGINE_CACHE[key] = DeviceEngine(PaxosActor(acfg),
                                                   engine_config(acfg))
+            elif family == "pb":
+                from ..engine import EngineConfig, PBActor, PBDeviceConfig
+
+                _ENGINE_CACHE[key] = DeviceEngine(
+                    PBActor(PBDeviceConfig()),
+                    EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                                 t_limit_us=2_000_000))
             else:  # tpc — the migrated hand-written family
                 from ..engine import EngineConfig, TPCActor, TPCDeviceConfig
 
@@ -645,6 +652,12 @@ def registry() -> Dict[str, TraceProgram]:
             f"(actorc spec, W={ACTORC_WORLDS}; TRC005 holds the "
             "compiler to its by-construction widen/narrow claim, "
             "docs/actorc.md)", _build_actorc_run("tpc"), budget=True,
+            donates=True, unit_div=ACTORC_WORLDS, packed=True),
+        TraceProgram(
+            "actorc.pb_run", "compiled primary-backup run loop "
+            f"(actorc spec, W={ACTORC_WORLDS}; closes the BUD002 gap — "
+            "every shipped actorc family step program is in the "
+            "budget ledger)", _build_actorc_run("pb"), budget=True,
             donates=True, unit_div=ACTORC_WORLDS, packed=True),
         TraceProgram(
             "actorc.paxos_run", "compiled multi-decree Paxos run loop "

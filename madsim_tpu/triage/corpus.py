@@ -207,6 +207,19 @@ def triage(result, out_dir: Optional[str] = None, *,
         ecfg = ctx.engine.cfg if ctx is not None else None
         frows = (mr.schedule if mr is not None
                  else _class_schedule(result, fc))
+        extra: Dict[str, Any] = {
+            "failure_class": fc.key, "n_seeds": fc.count,
+            "seeds_sample": [int(s) for s in fc.seeds[:16]]}
+        spec = (getattr(ctx.engine.actor, "spec", None)
+                if ctx is not None else None)
+        if spec is not None:
+            # Spec-backed (actorc) actor: the bundle carries its
+            # protocol card — the speclint static profile (kinds x
+            # handlers, timer graph, lane budgets) — so a minimized
+            # bug documents the protocol shape it was found against.
+            from ..analysis.speclint import protocol_card
+
+            extra["protocol_card"] = protocol_card(spec)
         bundles[fc.key] = write_sweep_bundle(
             out_dir, seed=fc.representative, actor=info["actor"],
             actor_config=info["actor_config"], engine_config=ecfg,
@@ -216,8 +229,7 @@ def triage(result, out_dir: Optional[str] = None, *,
                    f"(failure class {fc.key})"),
             minimization=(mr.provenance() if mr is not None else None),
             lineage=_class_lineage(result, fc),
-            extra={"failure_class": fc.key, "n_seeds": fc.count,
-                   "seeds_sample": [int(s) for s in fc.seeds[:16]]})
+            extra=extra)
     return TriageReport(classes=classes, minimized=minimized,
                         bundles=bundles)
 

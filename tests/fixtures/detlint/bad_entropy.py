@@ -9,4 +9,5 @@ def draw():
     return (os.urandom(8),
             random.random(),
             uuid.uuid4(),
-            secrets.token_bytes(4))
+            secrets.token_bytes(4),
+            random.SystemRandom().randint(0, 7))

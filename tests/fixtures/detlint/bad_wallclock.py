@@ -11,3 +11,11 @@ def stamp():
     t0 = _walltime.time()
     _walltime.sleep(0.1)
     return t0, datetime.now()
+
+
+def cpu_clocks(loop):
+    # Per-thread CPU clocks and the event loop's host monotonic clock —
+    # all three read host time, none pass through the virtual clock.
+    a = _walltime.thread_time()
+    b = _walltime.thread_time_ns()
+    return a, b, loop.time()

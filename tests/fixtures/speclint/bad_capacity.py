@@ -1,0 +1,46 @@
+"""speclint golden fixture: capacity proofs (SPC030 + SPC031).
+
+Two seeded defects of the overflow class TRC005 cannot see, because
+the saturating ``narrow`` on the write path is placed *by design*:
+
+- ``small`` declares [0, 100] and packs to int8, but ``h_ping`` writes
+  ``small + 100`` — static bound [100, 200], past the 127 rail: the
+  value would saturate silently at rest (SPC030);
+- ``h_ping`` sends ``Pong`` with word ``x + 50`` — static bound
+  [50, 150], outside the word's declared [0, 100] that the receiving
+  ``arg()`` read assumes (SPC031).
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("small", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        live = c.read("small") < 100
+        c.write("small", c.read("small") + 100, when=live)
+        c.send("Pong", dst=c.src, words=[c.arg("x") + 50], when=live)
+
+    def h_pong(c):
+        live = c.read("small") < 100
+        c.write("small", c.clip(c.read("small") + 1, 0, 100), when=live)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("small") < 0)
+
+    return ActorSpec(
+        name="lint_capacity",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

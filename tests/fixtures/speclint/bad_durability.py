@@ -1,0 +1,40 @@
+"""speclint golden fixture: durability flow (SPC050).
+
+``mem`` is declared volatile (``durable=False``) and ``h_ping`` reads
+it, but the spec has no ``on_restart`` hook: after a node restart the
+read sees the reset value with nothing to reconstruct it — the classic
+stable-storage violation, statically visible from the declarations.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("mem", hi=100, durable=False),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        live = c.read("mem") < 100
+        c.write("mem", c.clip(c.read("mem") + 1, 0, 100), when=live)
+        c.send("Pong", dst=c.src, words=[c.arg("x")], when=live)
+
+    def h_pong(c):
+        c.write("mem", 1)  # write-only: not a durability read
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("mem") < 0)
+
+    return ActorSpec(
+        name="lint_durability",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

@@ -1,0 +1,48 @@
+"""speclint golden fixture: RNG/effect budgets (SPC040 + SPC041).
+
+Two seeded defects, both known DSL gaps surfaced as diagnostics
+instead of silent miscompiles:
+
+- ``h_ping`` sends ``Pong`` twice with different payloads to different
+  destinations and no disjointness proof — but the lowering has ONE
+  merged message row per step, broadcasting ONE payload: the
+  per-destination-payload pattern cannot lower (SPC040);
+- ``h_pong`` draws from the RNG twice in one transition — the engine
+  hands each event exactly one draw (the static-draw-shape rule), so
+  the second ``u32()`` would alias the first (SPC041).
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100))
+        c.send("Pong", dst=0, words=[c.arg("x")])
+        c.send("Pong", dst=1, words=[0])  # second payload, same row
+
+    def h_pong(c):
+        a = c.u32() % 2
+        b = c.u32() % 2  # the seeded defect: a second draw per event
+        c.write("cnt", c.where(a == b, 1, 0))
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_effects",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

@@ -1,0 +1,39 @@
+"""speclint golden fixture: SPC012 — a handler with no effects at all.
+
+``h_pong`` neither writes, sends, arms, draws nor flags a bug, and
+``Pong`` is not declared terminal: the transition compiles to a no-op
+``where`` chain — dead weight that usually means a forgotten body.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+        c.send("Pong", dst=c.src, words=[c.arg("x")], when=live)
+
+    def h_pong(c):
+        pass  # the seeded defect: no effects, and Pong is not terminal
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_noop",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

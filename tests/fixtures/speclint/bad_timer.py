@@ -1,0 +1,51 @@
+"""speclint golden fixture: timer discipline (SPC020 + SPC021).
+
+Two seeded defects:
+
+- ``h_ping`` arms ``Tick`` twice under conditions (``cnt > 0`` and
+  ``cnt > 1``) with no static disjointness proof — the single merged
+  timer row is last-write-wins, so the first arm silently vanishes
+  whenever both fire (SPC021, a known DSL gap surfaced instead of
+  miscompiled);
+- the ``Dead`` timer has a handler but no transition, restart hook or
+  init event ever arms it (SPC020) — which also makes the kind
+  unreachable (SPC010): the firing path is dead by construction.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Tick", (), timer=True),
+        Message("Dead", (), timer=True),
+    )
+
+    def h_ping(c):
+        some = c.read("cnt") > 0
+        more = c.read("cnt") > 1  # overlaps `some`: not disjoint
+        c.arm("Tick", delay=1_000, when=some)
+        c.arm("Tick", delay=2_000, when=more)
+
+    def h_tick(c):
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100))
+
+    def h_dead(c):
+        c.write("cnt", 0, when=c.read("cnt") > 0)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_timer",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Tick": h_tick, "Dead": h_dead},
+        init=init,
+        invariant=invariant,
+    )

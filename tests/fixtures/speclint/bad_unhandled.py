@@ -1,0 +1,36 @@
+"""speclint golden fixture: SPC011 — a reachable kind with no handler.
+
+``h_ping`` emits ``Drop``, so the kind is live protocol — but nothing
+handles it and it is not declared in ``ignore=(...)``: every delivered
+``Drop`` would be silently swallowed by the compiled dispatch.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Drop", ()),
+    )
+
+    def h_ping(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+        c.send("Drop", dst=c.src, when=live)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_unhandled",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping},
+        init=init,
+        invariant=invariant,
+    )

@@ -1,0 +1,45 @@
+"""speclint golden fixture: SPC010 — a kind nobody seeds or emits.
+
+The ``Lost`` message has a perfectly good handler, but no init event
+seeds it and no reachable transition sends it: dead protocol that a
+fault schedule can never exercise.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+        Message("Lost", ()),
+    )
+
+    def h_ping(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+        c.send("Pong", dst=c.src, words=[c.arg("x")], when=live)
+
+    def h_pong(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+
+    def h_lost(c):
+        # A real transition — effects and all — that can never run.
+        c.write("cnt", 0, when=c.read("cnt") > 0)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_unreachable",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong, "Lost": h_lost},
+        init=init,
+        invariant=invariant,
+    )

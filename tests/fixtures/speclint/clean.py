@@ -1,0 +1,42 @@
+"""speclint golden fixture: a clean minimal protocol.
+
+Two nodes ping-pong a bounded counter. Every declared kind is seeded or
+emitted, every handler has effects, the single write stays inside the
+i8 rail its declared range selects, and the echoed payload word stays
+inside its declared range — zero findings, and the base the seeded-
+defect fixtures in this directory are one edit away from.
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+        c.send("Pong", dst=c.src, words=[c.arg("x")], when=live)
+
+    def h_pong(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_clean",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

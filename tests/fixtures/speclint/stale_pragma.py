@@ -1,0 +1,43 @@
+"""speclint golden fixture: DET900 — a stale SPC pragma.
+
+The spec itself is clean; the ``allow[SPC030]`` pragma below suppresses
+nothing, and pass 4 owns SPC codes, so IT flags the stale pragma as
+DET900 (pass 1 scanning this same file must stay silent about it — it
+does not own the SPC prefix).
+"""
+from madsim_tpu.actorc.spec import ActorSpec, Lane, Message, Word
+
+
+def build() -> ActorSpec:
+    lanes = (Lane("cnt", hi=100),)
+    messages = (
+        Message("Ping", (Word("x", 0, 100),)),
+        Message("Pong", (Word("x", 0, 100),)),
+    )
+
+    def h_ping(c):
+        live = c.read("cnt") < 100
+        # The write below stays inside the i8 rail — the pragma is stale.
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100),
+                when=live)  # detlint: allow[SPC030]
+        c.send("Pong", dst=c.src, words=[c.arg("x")], when=live)
+
+    def h_pong(c):
+        live = c.read("cnt") < 100
+        c.write("cnt", c.clip(c.read("cnt") + 1, 0, 100), when=live)
+
+    def init(c):
+        c.event("Ping", time=1_000, dst=0, words=[0])
+
+    def invariant(v):
+        return v.np.any(v.lane("cnt") < 0)
+
+    return ActorSpec(
+        name="lint_stale_pragma",
+        n_nodes=2,
+        lanes=lanes,
+        messages=messages,
+        handlers={"Ping": h_ping, "Pong": h_pong},
+        init=init,
+        invariant=invariant,
+    )

@@ -15,9 +15,9 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "detlint")
 
 # fixture -> {rule code: expected finding count} (golden findings).
 GOLDEN = {
-    "bad_wallclock.py": {"DET001": 3},
+    "bad_wallclock.py": {"DET001": 6},
     "bad_timeline.py": {"DET001": 3},
-    "bad_entropy.py": {"DET002": 4},
+    "bad_entropy.py": {"DET002": 5},
     "bad_threads.py": {"DET003": 3},
     "bad_hostinfo.py": {"DET004": 2},
     "bad_socket.py": {"DET005": 2},

@@ -1,0 +1,218 @@
+"""speclint (pass 4) tests: seeded-defect golden fixtures, the compile
+gate, suppression mechanics (pragmas, lint_allow, ignore/terminal
+hygiene), the shipped-family cleanliness invariant, and protocol-card
+byte-stability."""
+import dataclasses
+import importlib.util
+import os
+
+import pytest
+
+from madsim_tpu.analysis import scan_source
+from madsim_tpu.analysis.speclint import (gate_spec, lint_spec,
+                                          protocol_card, run_spec_pass,
+                                          shipped_specs)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "speclint")
+
+# fixture -> {rule code: expected finding count} (golden findings).
+GOLDEN = {
+    "clean": {},
+    "bad_unreachable": {"SPC010": 1},
+    "bad_unhandled": {"SPC011": 1},
+    "bad_noop": {"SPC012": 1},
+    "bad_timer": {"SPC010": 1, "SPC020": 1, "SPC021": 1},
+    "bad_capacity": {"SPC030": 1, "SPC031": 1},
+    "bad_effects": {"SPC040": 1, "SPC041": 1},
+    "bad_durability": {"SPC050": 1},
+    "stale_pragma": {"DET900": 1},
+}
+
+# (fixture, rule) -> substrings the finding must name: the offending
+# state / message / word, plus the diagnosis — pointed, not generic.
+POINTED = {
+    ("bad_unreachable", "SPC010"): ("'Lost'", "unreachable"),
+    ("bad_unhandled", "SPC011"): ("'Drop'", "no handler"),
+    ("bad_noop", "SPC012"): ("'Pong'", "no effects"),
+    ("bad_timer", "SPC020"): ("'Dead'", "never armed"),
+    ("bad_timer", "SPC021"): ("'Tick'", "disjoint"),
+    ("bad_capacity", "SPC030"): ("'small'", "[100, 200]"),
+    ("bad_capacity", "SPC031"): ("'x'", "[50, 150]"),
+    ("bad_effects", "SPC040"): ("'Pong'", "disjoint"),
+    ("bad_effects", "SPC041"): ("at most once", "'Pong'"),
+    ("bad_durability", "SPC050"): ("'mem'", "on_restart"),
+    ("stale_pragma", "DET900"): ("SPC030",),
+}
+
+
+def _load(name, path=None):
+    """Import a fixture module fresh (closures and co_filename intact)."""
+    path = path or os.path.join(FIXTURES, name + ".py")
+    mspec = importlib.util.spec_from_file_location(
+        f"speclint_fixture_{name}", path)
+    mod = importlib.util.module_from_spec(mspec)
+    mspec.loader.exec_module(mod)
+    return mod
+
+
+def _build(name):
+    return _load(name).build()
+
+
+@pytest.mark.parametrize("fixture,expected", sorted(GOLDEN.items()))
+def test_golden_fixture_findings(fixture, expected):
+    findings = lint_spec(_build(fixture), root=REPO)
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    assert counts == expected, "\n".join(f.render() for f in findings)
+    rel = f"tests/fixtures/speclint/{fixture}.py"
+    for f in findings:
+        assert f.path == rel and f.line > 0, f.render()
+
+
+@pytest.mark.parametrize("fixture,rule", sorted(POINTED))
+def test_findings_name_the_offender(fixture, rule):
+    findings = [f for f in lint_spec(_build(fixture), root=REPO)
+                if f.rule == rule]
+    assert findings, f"{fixture} produced no {rule}"
+    for needle in POINTED[(fixture, rule)]:
+        assert any(needle in f.message for f in findings), \
+            f"{rule} message lacks {needle!r}: " + \
+            "\n".join(f.render() for f in findings)
+
+
+# -- the compile gate -------------------------------------------------------
+
+def test_compile_gate_rejects_dsl_gap_specs():
+    """The acceptance bar: a spec leaning on a known DSL gap
+    (per-destination payloads, multi-timer arms, >1 RNG draw) is
+    rejected with an SPC diagnostic instead of silently miscompiling."""
+    from madsim_tpu.actorc.compile import CompiledActor
+    from madsim_tpu.actorc.spec import SpecError
+
+    for fixture, code in (("bad_effects", "SPC040"),   # per-dst payloads
+                          ("bad_effects", "SPC041"),   # >1 RNG draw
+                          ("bad_timer", "SPC021"),     # multi-timer arms
+                          ("bad_capacity", "SPC030")):
+        with pytest.raises(SpecError) as ei:
+            CompiledActor(_build(fixture))
+        assert "speclint" in str(ei.value) and code in str(ei.value)
+
+
+def test_compile_gate_passes_clean_spec_and_buggy_shipped_variants():
+    from madsim_tpu.actorc.compile import CompiledActor
+    from madsim_tpu.actorc.families.paxos import PaxosConfig, paxos_spec
+    from madsim_tpu.actorc.families.pb import pb_spec
+    from madsim_tpu.actorc.families.tpc import tpc_spec
+    from madsim_tpu.engine.pb_actor import PBDeviceConfig
+    from madsim_tpu.engine.tpc_actor import TPCDeviceConfig
+
+    CompiledActor(_build("clean"))
+    # The deliberately-buggy experiment configs still compile: the
+    # injected protocol bugs are dynamic (schedule-gated), not spec
+    # malformations — except the forgetful acceptor, whose lint_allow
+    # carries its intentional SPC050.
+    CompiledActor(paxos_spec(PaxosConfig(buggy_forgetful_acceptor=True)))
+    CompiledActor(pb_spec(PBDeviceConfig(buggy_commit_early=True)))
+    CompiledActor(tpc_spec(TPCDeviceConfig(buggy_presumed_commit=True)))
+
+
+# -- the tier-1 invariant ---------------------------------------------------
+
+def test_shipped_families_are_speclint_clean():
+    """Pass 4 over every shipped family spec finds nothing — the same
+    invariant `make lint` and CI enforce. A regression here means a
+    spec edit introduced dead protocol, a capacity hole or an effect-
+    budget violation."""
+    findings = run_spec_pass(root=REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- suppression mechanics --------------------------------------------------
+
+def test_pragma_suppresses_spc_finding_on_its_line(tmp_path):
+    src = open(os.path.join(FIXTURES, "bad_capacity.py"),
+               encoding="utf-8").read()
+    anchor = 'c.write("small", c.read("small") + 100, when=live)'
+    assert anchor in src
+    p = tmp_path / "pragma_capacity.py"
+    p.write_text(src.replace(
+        anchor, anchor + "  # detlint: allow[SPC030]"))
+    spec = _load("pragma_capacity", str(p)).build()
+    rules = [f.rule for f in lint_spec(spec, root=str(tmp_path))]
+    assert rules == ["SPC031"]  # SPC030 suppressed, pragma not stale
+
+
+def test_stale_spc_pragma_is_owned_by_pass4_not_pass1():
+    spec = _build("stale_pragma")
+    (f,) = lint_spec(spec, root=REPO)
+    assert f.rule == "DET900" and "SPC030" in f.message
+    # Pass 1 scans the same file and must NOT claim the SPC pragma:
+    # each pass owns its own rule prefixes (no double DET900s).
+    src = open(os.path.join(FIXTURES, "stale_pragma.py"),
+               encoding="utf-8").read()
+    assert scan_source(src, "stale_pragma.py") == []
+
+
+def test_lint_allow_suppresses_per_code_and_star_waives_pass():
+    allowed = dataclasses.replace(_build("bad_durability"),
+                                  lint_allow=("SPC050",))
+    assert lint_spec(allowed, root=REPO) == []
+    star = dataclasses.replace(_build("bad_timer"), lint_allow=("*",))
+    assert lint_spec(star, root=REPO) == []
+
+
+def test_stale_lint_allow_is_spc900():
+    spec = dataclasses.replace(_build("clean"), lint_allow=("SPC030",))
+    (f,) = lint_spec(spec, root=REPO)
+    assert f.rule == "SPC900" and "SPC030" in f.message
+
+
+def test_ignore_declares_a_kind_unhandled_on_purpose():
+    spec = _build("bad_unhandled")
+    assert lint_spec(dataclasses.replace(spec, ignore=("Drop",)),
+                     root=REPO) == []
+    fs = lint_spec(dataclasses.replace(spec, ignore=("Drop", "Nope")),
+                   root=REPO)
+    assert [f.rule for f in fs] == ["SPC013"]
+    assert "'Nope'" in fs[0].message
+
+
+def test_handled_and_ignored_is_spc013():
+    fs = lint_spec(dataclasses.replace(_build("clean"), ignore=("Pong",)),
+                   root=REPO)
+    assert [f.rule for f in fs] == ["SPC013"]
+    assert "'Pong'" in fs[0].message and "both handled" in fs[0].message
+
+
+def test_terminal_kind_that_emits_is_spc013():
+    fs = lint_spec(dataclasses.replace(_build("clean"),
+                                       terminal=("Ping",)),
+                   root=REPO)
+    assert [f.rule for f in fs] == ["SPC013"]
+    assert "'Ping'" in fs[0].message and "terminal" in fs[0].message
+
+
+# -- protocol cards ---------------------------------------------------------
+
+def test_protocol_card_is_byte_stable():
+    """Two independent renders are identical — the CI demo diffs them."""
+    a = protocol_card(shipped_specs()["paxos"])
+    b = protocol_card(shipped_specs()["paxos"])
+    assert a == b
+    assert a.startswith("protocol card: paxos")
+    for section in ("kinds x handlers", "timer graph", "lane budgets",
+                    "init seeds:"):
+        assert section in a
+
+
+def test_protocol_card_surfaces_protocol_shape():
+    card = protocol_card(_build("bad_unhandled"))
+    assert "UNHANDLED" in card and "Drop" in card
+    card = protocol_card(_build("clean"))
+    assert "handled" in card and "UNHANDLED" not in card
+    # the lane budget row carries the declared range, dtype and the
+    # abstract max-write bound
+    assert "[0, 100]" in card and "i8" in card
