@@ -133,6 +133,17 @@ smoke:
 	assert gf.get('exchanged_seeds_to_bug') and \
 	    gf['exchanged_bugs_found']>=gf['independent_bugs_found'], \
 	    f'exchanged fleet did not hold the cross-range gate: {gf}'; \
+	fs=d['configs'].get('fleet_sweep'); \
+	fsneed={'fabric_overhead_frac','acquire_ms','sweep_ms','merge_ms', \
+	        'rpcs_per_lease','control_rpcs_per_lease', \
+	        'session_reuse_hits','leases_prefetched','grouped_leases'}; \
+	assert isinstance(fs,dict) and fsneed<=set(fs), \
+	    f'fleet_sweep cost-model record missing/incomplete: {fs}'; \
+	assert fs['session_reuse_hits']>=1 and fs['leases_prefetched']>=1, \
+	    f'fleet fabric disciplines inactive: {fs}'; \
+	from madsim_tpu.fleet import MAX_CONTROL_RPCS_PER_LEASE as M; \
+	assert fs['control_rpcs_per_lease']<=M, \
+	    f'control plane over budget ({M}/lease): {fs}'; \
 	print('bench_results.json ok:', d['metric'])"
 	$(CPU_ENV) $(PY) tools/pallas_smoke.py
 

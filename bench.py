@@ -699,6 +699,7 @@ def bench_fleet_sweep(n_worlds: int) -> dict:
     dt_fleet = walltime.perf_counter() - t0
 
     stats = fleet.loop_stats["fleet"]
+    leases = max(1, stats["leases_issued"])
     out = {"n_worlds": n_worlds,
            "n_workers": 2,
            "n_ranges": stats["ranges"],
@@ -706,10 +707,24 @@ def bench_fleet_sweep(n_worlds: int) -> dict:
            "fleet_seeds_per_sec": round(n_worlds / dt_fleet, 2),
            # >0 = the fabric costs throughput vs one big batch (smaller
            # per-range batches + lease bookkeeping); the tracked number.
+           # ISSUE 17 gate: <= 0.15 on this config (sessions + prefetch
+           # + coalesced control plane; docs/fleet.md "Fabric cost
+           # model").
            "fabric_overhead_frac": round(1 - dt_single / dt_fleet, 4),
            "leases_issued": stats["leases_issued"],
            "heartbeats": stats["heartbeats"],
-           "fabric_ticks": stats["fabric_ticks"]}
+           "fabric_ticks": stats["fabric_ticks"],
+           # Per-phase breakdown of the fleet wall (docs/fleet.md
+           # "Fabric cost model"): where each lease's time went, and
+           # the counted control-plane discipline per lease.
+           "acquire_ms": round(1000.0 * stats["acquire_s"] / leases, 3),
+           "sweep_ms": round(1000.0 * stats["sweep_s"] / leases, 3),
+           "merge_ms": round(1000.0 * stats.get("merge_s", 0.0), 3),
+           "rpcs_per_lease": stats["rpcs_per_lease"],
+           "control_rpcs_per_lease": stats["control_rpcs_per_lease"],
+           "session_reuse_hits": stats["session_reuse_hits"],
+           "leases_prefetched": stats["leases_prefetched"],
+           "grouped_leases": stats["grouped_leases"]}
     log(f"fleet_sweep[{jax.default_backend()}]: single {dt_single:.2f}s "
         f"fleet {dt_fleet:.2f}s  {out}")
     return out

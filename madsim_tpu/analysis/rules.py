@@ -253,6 +253,10 @@ LOOP_ATTR_CALLS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
 HOT_LOOP_MODULES = frozenset({
     "madsim_tpu/parallel/sweep.py",
     "madsim_tpu/fleet/worker.py",
+    # The fabric scheduler drives every worker quantum (ISSUE 17: the
+    # per-round loop is now the fleet's only serial section) — a stray
+    # device pull here would stall every worker's pipeline at once.
+    "madsim_tpu/fleet/fabric.py",
     "madsim_tpu/obs/observatory.py",
     "madsim_tpu/bridge/pool.py",
 })

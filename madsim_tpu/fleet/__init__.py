@@ -39,6 +39,7 @@ from .merge import (
     merge_range_results,
 )
 from .rpc import (
+    MAX_CONTROL_RPCS_PER_LEASE,
     InlineTransport,
     RealClock,
     RetryExhausted,
@@ -55,6 +56,7 @@ __all__ = [
     "FleetIntegrityError", "FleetStalledError", "InlineTransport",
     "TornPayloadError",
     "Lease", "LeaseLost", "LeasePreempted", "LeaseTable", "LocalFabric",
+    "MAX_CONTROL_RPCS_PER_LEASE",
     "RealClock", "RetryExhausted", "RetryPolicy", "RpcError",
     "SeedRange", "VirtualClock", "Worker", "WorkerKilled",
     "call_with_retry", "contract_mismatches", "fleet_sweep",

@@ -53,6 +53,11 @@ class Coordinator:
         # publishes.
         self.exchange = exchange
         self.results: Dict[int, SweepResult] = {}
+        # worker_id -> why that worker's last acquire-ahead stopped
+        # short of its requested count (exchange epoch barrier) — the
+        # stall report's "prefetch blocked" line.
+        self._prefetch_blocked: Dict[str, str] = {}
+        self.merge_s = 0.0
         self.stats: Dict[str, int] = {
             "ranges": len(self.ranges),
             "leases_issued": 0,
@@ -76,12 +81,25 @@ class Coordinator:
         self._emit(rec)
 
     # -- the RPC surface -------------------------------------------------
-    def rpc_acquire(self, worker_id: str) -> Optional[Dict[str, Any]]:
-        """Hand the next pending range to ``worker_id`` (None: nothing
-        pending — all ranges leased out or done, or every pending range
-        is held back by the exchange's epoch barrier; idle and retry).
+    def rpc_acquire(self, worker_id: str, count: int = 1
+                    ) -> Optional[Dict[str, Any]]:
+        """Hand the next pending range(s) to ``worker_id``.
 
-        Under an exchange the lease additionally carries the range's
+        ``count=1`` (the legacy wire shape): one lease dict, or None —
+        nothing pending (all ranges leased out or done, or every pending
+        range held back by the exchange's epoch barrier; idle and retry).
+
+        ``count>1`` is the acquire-ahead path (lease prefetch): up to
+        ``count`` leases issue in ONE control turn, returned as
+        ``{"leases": [...]}``; every lease beyond the first is marked
+        ``prefetched``. The exchange epoch barrier is enforced at
+        INSTALL time — issuing stops at the first ineligible range, so a
+        prefetched lease's seed corpus is always its epoch's final
+        merged corpus, exactly as if it had been acquired after the
+        barrier lifted; the barrier reason is remembered per worker for
+        ``stall_report()``.
+
+        Under an exchange each lease additionally carries the range's
         deterministic seed corpus (the merged previous-epoch corpus;
         None for epoch 0) — a re-issued lease for a killed worker's
         range gets the SAME corpus its first holder did, which is the
@@ -89,50 +107,95 @@ class Coordinator:
         self._reap()
         eligible = (self.exchange.eligible
                     if self.exchange is not None else None)
-        lease = self.table.issue(worker_id, self.clock.now(),
-                                 eligible=eligible)
-        if lease is None:
-            return None
-        self.stats["leases_issued"] += 1
-        if lease.generation > 0:
-            self.stats["leases_reissued"] += 1
-        self.emit("lease_issued", worker=worker_id,
-                  lease_id=lease.lease_id, range_id=lease.range.range_id,
-                  lo=lease.range.lo, hi=lease.range.hi,
-                  generation=lease.generation,
-                  reissued=lease.generation > 0,
-                  resume_checkpoint=lease.checkpoint)
-        out = {
-            "lease_id": lease.lease_id,
-            "range_id": lease.range.range_id,
-            "lo": lease.range.lo,
-            "hi": lease.range.hi,
-            "generation": lease.generation,
-            "expires_at": lease.expires_at,
-            "checkpoint": lease.checkpoint,
-        }
-        if self.exchange is not None:
-            rid = lease.range.range_id
-            out["exchange_epoch"] = self.exchange.epoch_of(rid)
-            out["exchange_gen0"] = self.exchange.gen0_of(rid)
-            out["corpus"] = self.exchange.seed_payload(rid,
-                                                      worker=worker_id)
-        return out
+        now = self.clock.now()
+        out_leases: List[Dict[str, Any]] = []
+        for i in range(max(1, int(count))):
+            lease = self.table.issue(worker_id, now, eligible=eligible)
+            if lease is None:
+                break
+            lease.prefetched = i > 0
+            self.stats["leases_issued"] += 1
+            if lease.generation > 0:
+                self.stats["leases_reissued"] += 1
+            self.emit("lease_issued", worker=worker_id,
+                      lease_id=lease.lease_id,
+                      range_id=lease.range.range_id,
+                      lo=lease.range.lo, hi=lease.range.hi,
+                      generation=lease.generation,
+                      reissued=lease.generation > 0,
+                      prefetched=lease.prefetched,
+                      resume_checkpoint=lease.checkpoint)
+            out = {
+                "lease_id": lease.lease_id,
+                "range_id": lease.range.range_id,
+                "lo": lease.range.lo,
+                "hi": lease.range.hi,
+                "generation": lease.generation,
+                "expires_at": lease.expires_at,
+                "checkpoint": lease.checkpoint,
+                "prefetched": lease.prefetched,
+            }
+            if self.exchange is not None:
+                rid = lease.range.range_id
+                out["exchange_epoch"] = self.exchange.epoch_of(rid)
+                out["exchange_gen0"] = self.exchange.gen0_of(rid)
+                out["corpus"] = self.exchange.seed_payload(rid,
+                                                          worker=worker_id)
+            out_leases.append(out)
+        # Remember why the acquire-ahead stopped short (stall_report's
+        # "barrier reason" line): only meaningful under an exchange —
+        # a plain fleet's short acquire just means the queue ran dry.
+        self._prefetch_blocked.pop(worker_id, None)
+        if (len(out_leases) < max(1, int(count))
+                and self.exchange is not None):
+            for rid in sorted(self.table.outstanding()):
+                if self.table.lease_for_range(rid) is not None:
+                    continue
+                reason = self.exchange.blocked_reason(rid)
+                if reason:
+                    self._prefetch_blocked[worker_id] = (
+                        f"range {rid}: {reason}")
+                    break
+        if count == 1:
+            return out_leases[0] if out_leases else None
+        return {"leases": out_leases}
 
-    def rpc_heartbeat(self, worker_id: str, lease_id: int,
-                      progress: Optional[Dict[str, Any]] = None
+    def rpc_heartbeat(self, worker_id: str, lease_id: Optional[int] = None,
+                      progress: Optional[Dict[str, Any]] = None,
+                      lease_ids: Optional[List[int]] = None
                       ) -> Dict[str, Any]:
-        """Extend a lease. ``ok=False`` tells the worker the lease is
-        LOST (expired and possibly re-issued): abandon the range — the
-        fabric guarantees someone (re-)runs it, and if the worker's own
-        run completes anyway the dedup layer absorbs it."""
+        """Extend lease(s). One beat covers every lease the worker holds
+        (``lease_ids`` — the coalesced control plane; ``lease_id`` is
+        the legacy single-lease wire shape). ``ok`` is the conjunction;
+        ``lost`` names the leases that are LOST (expired and possibly
+        re-issued): the worker must abandon those ranges — the fabric
+        guarantees someone (re-)runs them, and if the worker's own run
+        completes anyway the dedup layer absorbs it."""
         self._reap()
-        ok = self.table.heartbeat(lease_id, worker_id, self.clock.now(),
-                                  progress)
+        ids = list(lease_ids) if lease_ids is not None else [lease_id]
+        now = self.clock.now()
+        lost = [i for i in ids
+                if not self.table.heartbeat(i, worker_id, now, progress)]
+        ok = not lost
         self.stats["heartbeats" if ok else "heartbeats_lost"] += 1
-        self.emit("heartbeat", worker=worker_id, lease_id=lease_id,
-                  ok=ok, **(progress or {}))
-        return {"ok": ok}
+        self.emit("heartbeat", worker=worker_id, lease_id=ids[0],
+                  ok=ok, leases=len(ids), **(progress or {}))
+        return {"ok": ok, "lost": lost}
+
+    def rpc_batch(self, worker_id: str, msgs: List[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+        """Server side of one coalesced control turn (the process
+        transport delivers batches whole; the inline transport unpacks
+        them itself so chaos can interpose per logical message).
+        Messages dispatch in order — publish-before-complete keeps the
+        exchange backstop semantics."""
+        out = []
+        for m in msgs:
+            m = dict(m)
+            method = m.pop("method")
+            out.append(getattr(self, f"rpc_{method}")(
+                worker_id=worker_id, **m))
+        return out
 
     def rpc_release(self, worker_id: str, lease_id: int,
                     checkpoint: Optional[str] = None) -> Dict[str, Any]:
@@ -235,22 +298,37 @@ class Coordinator:
         FleetStalledError carries instead of a bare range count, so the
         post-mortem starts at the sick range, not at a grep."""
         now = self.clock.now()
+        # A worker's RUNNING lease is its lowest live lease id; anything
+        # above it marked prefetched is queued behind that run.
+        running: Dict[str, int] = {}
+        for lease in self.table.live_leases():
+            cur = running.get(lease.worker_id)
+            if cur is None or lease.lease_id < cur:
+                running[lease.worker_id] = lease.lease_id
         lines: List[str] = []
         for rid in sorted(self.table.outstanding()):
             lease = self.table.lease_for_range(rid)
             if lease is not None:
                 beat = ("never" if lease.last_heartbeat < 0
                         else f"t={lease.last_heartbeat:g}")
+                role = ""
+                if lease.prefetched and \
+                        running.get(lease.worker_id) != lease.lease_id:
+                    role = (f", prefetched behind lease "
+                            f"{running[lease.worker_id]}")
                 lines.append(
                     f"range {rid}: held by {lease.worker_id} (lease "
                     f"{lease.lease_id}, generation {lease.generation}, "
                     f"heartbeats {lease.heartbeats}, last heartbeat "
-                    f"{beat}, expires t={lease.expires_at:g})")
+                    f"{beat}, expires t={lease.expires_at:g}{role})")
                 continue
             blocked = (self.exchange.blocked_reason(rid)
                        if self.exchange is not None else None)
             lines.append(f"range {rid}: pending"
                          + (f", {blocked}" if blocked else " re-issue"))
+        for wid in sorted(self._prefetch_blocked):
+            lines.append(f"worker {wid}: prefetch blocked at epoch "
+                         f"barrier ({self._prefetch_blocked[wid]})")
         return (f"outstanding ranges at t={now:g}:\n  "
                 + "\n  ".join(lines)) if lines else "no outstanding ranges"
 
@@ -260,12 +338,18 @@ class Coordinator:
         the summary telemetry record. Under an exchange the result also
         carries the fleet-level ``search`` report: the final merged
         corpus plus the per-seed materialized schedules."""
+        import time as _walltime
+
         stats = dict(self.stats)
         if self.exchange is not None:
             stats.update(self.exchange.stats)
         stats.update(fleet_stats or {})
+        t0 = _walltime.perf_counter()  # detlint: allow[DET001] reason=merge-phase wall timing for the fabric cost breakdown; never feeds a sim decision
         result = merge_range_results(self.seeds, self.ranges, self.results,
                                      self.n_devices, fleet_stats=stats)
+        self.merge_s = _walltime.perf_counter() - t0  # detlint: allow[DET001] reason=merge-phase wall timing for the fabric cost breakdown; never feeds a sim decision
+        stats["merge_s"] = round(self.merge_s, 6)
+        result.loop_stats["fleet"]["merge_s"] = stats["merge_s"]
         if self.exchange is not None:
             result.search = self.exchange.fleet_report(
                 int(self.seeds.shape[0]), self.ranges, self.results)

@@ -102,8 +102,28 @@ class LocalFabric:
         agg["workers"] = per_worker
         for key in ("kills", "preemptions", "rpc_retries",
                     "heartbeats_dropped", "checkpoints_recovered",
-                    "checkpoints_discarded"):
+                    "checkpoints_discarded", "leases_prefetched",
+                    "grouped_leases", "leases_lost"):
             agg[key] = sum(w.stats[key] for w in self.workers)
+        for key in ("acquire_s", "sweep_s"):
+            agg[key] = round(sum(w.stats[key] for w in self.workers), 6)
+        # Session reuse: leases that rode an already-open SweepSession's
+        # standing device slots instead of paying a fresh install.
+        agg["session_reuse_hits"] = sum(
+            w._session.reuse_hits for w in self.workers
+            if w._session is not None)
+        # Counted discipline (fleet/rpc.py MAX_CONTROL_RPCS_PER_LEASE):
+        # transport turns per issued lease, heartbeats split out — the
+        # coalesced control plane's "small constant" gate, measured.
+        transport = self.workers[0].transport if self.workers else None
+        if transport is not None and hasattr(transport, "calls"):
+            calls = dict(transport.calls)
+            agg["rpc_turns"] = calls
+            total = sum(calls.values())
+            control = total - calls.get("heartbeat", 0)
+            issued = max(1, self.coordinator.stats["leases_issued"])
+            agg["rpcs_per_lease"] = round(total / issued, 3)
+            agg["control_rpcs_per_lease"] = round(control / issued, 3)
         return agg
 
 
@@ -122,6 +142,7 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
                 max_rounds: int = 100_000,
                 spawn: str = "inline",
                 exchange: Any = None,
+                prefetch: Optional[int] = None,
                 **sweep_kwargs) -> SweepResult:
     """Distribute a seed sweep over a resilient coordinator/worker fleet.
 
@@ -175,6 +196,16 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
     cannot move them — and the merged result's ``search`` carries the
     final fleet corpus plus the per-seed materialized schedules.
     Inline fabric only.
+
+    ``prefetch``: acquire-ahead depth — each worker acquires up to
+    ``1 + prefetch`` leases per control turn, overlapping the next
+    lease's acquisition with the current sweep. Default (None): each
+    worker's fair share of the range count, so a whole fleet costs ONE
+    acquire turn per worker. Prefetched plain leases of one schedule
+    run grouped through the worker's persistent ``SweepSession`` (one
+    standing device batch, split back into bit-identical per-range
+    results); checkpointed / exchange / search leases run solo within
+    the quantum. ``prefetch=0`` restores one-lease-per-quantum.
     """
     from ..engine.core import DeviceEngine
 
@@ -186,6 +217,13 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
         raise ValueError("n_workers must be >= 1")
     if range_size is None:
         range_size = max(1, -(-n // (2 * n_workers)))
+    if prefetch is None:
+        # Acquire-ahead depth: enough for each worker's fair share of
+        # ranges in ONE control turn (the lease-prefetch default). 0
+        # restores one-lease-per-quantum (the pre-session fabric).
+        n_ranges = -(-n // range_size)
+        prefetch = max(0, -(-n_ranges // n_workers) - 1)
+    prefetch = max(0, int(prefetch))
     if exchange is not None:
         scfg = sweep_kwargs.get("search")
         if scfg is None:
@@ -257,7 +295,7 @@ def fleet_sweep(actor: Any, cfg, seeds, *,
                mesh=mesh, retry=retry, chaos=policy, emit=emit,
                checkpoint_dir=checkpoint_dir,
                checkpoint_every_chunks=checkpoint_every_chunks,
-               sweep_kwargs=sweep_kwargs)
+               sweep_kwargs=sweep_kwargs, prefetch=prefetch)
         for i in range(n_workers)]
     fabric = LocalFabric(coordinator, workers, clock, chaos=policy,
                          max_rounds=max_rounds)

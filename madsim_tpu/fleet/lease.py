@@ -73,6 +73,12 @@ class Lease:
     # most recent accepted heartbeat (-1.0 = never beat).
     heartbeats: int = 0
     last_heartbeat: float = -1.0
+    # Acquire-ahead marker: True when this lease was issued beyond the
+    # first slot of a multi-lease acquire (lease prefetch) — the holder
+    # is NOT running it yet, it is queued behind the holder's running
+    # lease. Stall diagnostics must say so, or a prefetched lease reads
+    # as a hung sweep.
+    prefetched: bool = False
 
 
 def split_ranges(n_seeds: int, range_size: int) -> List[SeedRange]:

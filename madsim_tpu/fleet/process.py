@@ -26,6 +26,26 @@ import numpy as np
 from .rpc import RealClock, RetryPolicy, RpcError
 
 
+def _wire_safe(kw: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip the process-local triage context from any SweepResult
+    payload before it crosses the pipe: ``triage_ctx`` holds the live
+    engine (jit closures — unpicklable by design), and the coordinator
+    side never uses it (merged fleet results are 'reconstructed' and
+    carry None there anyway)."""
+    import dataclasses as _dc
+
+    def scrub(v):
+        if getattr(v, "triage_ctx", None) is not None:
+            return _dc.replace(v, triage_ctx=None)
+        return v
+
+    out = {k: scrub(v) for k, v in kw.items()}
+    if isinstance(out.get("msgs"), list):
+        out["msgs"] = [{k: scrub(v) for k, v in m.items()}
+                       for m in out["msgs"]]
+    return out
+
+
 class PipeTransport:
     """Worker-side transport: one request/response per call over the
     process's pipe to the coordinator."""
@@ -36,7 +56,7 @@ class PipeTransport:
     def call(self, method: str, worker_id: str, **kw):
         try:
             self.conn.send({"method": method, "worker_id": worker_id,
-                            "kw": kw})
+                            "kw": _wire_safe(kw)})
             resp = self.conn.recv()
         except (EOFError, OSError, BrokenPipeError) as exc:
             raise RpcError(f"coordinator pipe failed: {exc}") from exc

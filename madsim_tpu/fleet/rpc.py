@@ -161,6 +161,17 @@ def call_with_retry(fn: Callable[[], Any], policy: RetryPolicy, clock,
 
 # -- inline transport --------------------------------------------------------
 
+#: Counted-discipline bound (tests/test_fleet.py): non-heartbeat control
+#: turns (acquire / batch / release / publish-resend / ...) a fleet run
+#: may spend per issued lease. The coalesced control plane holds the
+#: real number well under this — one acquire turn and one batched
+#: publish+complete turn cover a whole prefetched quantum — but idle
+#: acquire polls and chaos-forced re-sends ride the same budget, hence
+#: the slack. This is the "RPC count per lease drops to a small
+#: constant" gate, as a named constant.
+MAX_CONTROL_RPCS_PER_LEASE = 3
+
+
 class InlineTransport:
     """Worker→coordinator calls as plain method dispatch, with the chaos
     policy interposed exactly where a network would sit.
@@ -183,10 +194,42 @@ class InlineTransport:
 
     def call(self, method: str, worker_id: str, **kw):
         self.calls[method] = self.calls.get(method, 0) + 1
+        if method == "batch":
+            return self._call_batch(worker_id, kw["msgs"])
         if self.chaos is not None and self.chaos.rpc_fail(method, worker_id):
             self.injected_failures += 1
             raise RpcError(
                 f"injected transport failure: {method} from {worker_id}")
+        return self._deliver(method, worker_id, kw)
+
+    def _call_batch(self, worker_id: str, msgs):
+        """One batched control turn: several logical messages, one
+        transport round trip (the coalesced control plane). Chaos
+        interposition stays per LOGICAL message — each message draws
+        its rpc_fail / tear-publish / duplicate-completion decisions
+        under its own method name, so chaos schedules keyed on logical
+        traffic are invariant to the coalescing — but failure is
+        atomic: every message's fail draw lands BEFORE any delivery,
+        and one failure fails the whole turn (the worker's retry
+        re-sends all of it; the publish dedupe and completion
+        crosscheck absorb the replays)."""
+        prepared = []
+        failed = None
+        for m in msgs:
+            m = dict(m)
+            lm = m.pop("method")
+            if self.chaos is not None and self.chaos.rpc_fail(lm, worker_id):
+                self.injected_failures += 1
+                if failed is None:
+                    failed = lm
+            prepared.append((lm, m))
+        if failed is not None:
+            raise RpcError(f"injected transport failure: {failed} "
+                           f"(batched) from {worker_id}")
+        return [self._deliver(lm, worker_id, m) for lm, m in prepared]
+
+    def _deliver(self, method: str, worker_id: str, kw: Dict[str, Any]):
+        """Deliver one logical message (tear/duplicate chaos included)."""
         if (method == "publish" and self.chaos is not None
                 and self.chaos.tear_publish(worker_id)):
             # Tear the snapshot IN FLIGHT (flip one byte of the payload)

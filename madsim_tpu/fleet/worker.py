@@ -1,4 +1,4 @@
-"""The fleet worker: acquire a lease, sweep it, heartbeat, report.
+"""The fleet worker: acquire leases, sweep them, heartbeat, report.
 
 A worker is a thin loop around PR 4's pipelined ``sweep()``: one lease =
 one sweep over the leased seed slice, run to completion with the same
@@ -11,16 +11,37 @@ preemption point: chaos kills, SIGTERM preemption, and lease-lost
 aborts all land there, between supersteps, where the sweep's own
 exception path already flushes the async checkpoint writer.
 
+Fabric cost model (docs/fleet.md): three disciplines keep the per-lease
+fabric tax ~O(1) instead of O(fresh sweep):
+
+- **Persistent sweep session** — the worker holds ONE
+  :class:`~madsim_tpu.parallel.sweep.SweepSession` across leases, so
+  per-lease device init, host setup, and compile-cache traffic are paid
+  once per worker, not once per lease.
+- **Lease prefetch** — ``prefetch=k`` acquires up to ``1+k`` leases in
+  a single RPC turn (the coordinator's acquire-ahead path, barrier-
+  checked at install time). Prefetched plain leases of the same
+  schedule run GROUPED through ``SweepSession.run_group`` — one
+  standing device batch at the width the engine is efficient at, split
+  back into per-range results that are bit-identical to solo sweeps.
+  Checkpointed / exchange / search leases always run solo (their
+  per-lease machinery is the contract), sequentially within the same
+  quantum.
+- **Coalesced control plane** — the corpus publish and the completion
+  ride one batched RPC turn; grouped completions batch likewise. Chaos
+  interposition stays per LOGICAL message (fleet/rpc.py), so kill /
+  torn-publish / duplicate-completion schedules are unchanged.
+
 Failure handling per the ISSUE contract:
 
 - **kill** (crash): the sweep aborts mid-flight, nothing is released;
-  the lease expires at the coordinator and re-issues. If the dead
-  worker had checkpointed, the re-issued lease carries the path and the
-  next holder resumes bit-exactly (crash recovery == resume).
+  every held lease expires at the coordinator and re-issues. If the
+  dead worker had checkpointed, the re-issued lease carries the path
+  and the next holder resumes bit-exactly (crash recovery == resume).
 - **SIGTERM preemption**: ``request_preemption()`` (wired to the signal
   by :func:`install_sigterm_handler`) makes the next heartbeat raise;
-  the worker releases the lease WITH its checkpoint and exits its
-  quantum cleanly — resume on restart, per the satellite.
+  the worker releases EVERY held lease — the running one with its
+  checkpoint — and exits its quantum cleanly.
 - **corrupt checkpoint** (torn file from a crashed writer): the
   hardened loader (engine/checkpoint.py) raises ``CheckpointError``;
   the worker deletes the file and re-runs the range fresh — losing only
@@ -29,7 +50,7 @@ Failure handling per the ISSUE contract:
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -49,24 +70,27 @@ class WorkerKilled(BaseException):
 
 
 class LeasePreempted(Exception):
-    """SIGTERM-style preemption: stop at the next heartbeat, release the
-    lease with the checkpoint, survive."""
+    """SIGTERM-style preemption: stop at the next heartbeat, release
+    every held lease (the running one with its checkpoint), survive."""
 
 
 class LeaseLost(Exception):
-    """The coordinator declared this lease expired/superseded: abandon
+    """The coordinator declared a lease expired/superseded: abandon
     the range (someone else owns it now; determinism makes any late
     completion of ours a harmless crosschecked duplicate)."""
 
 
 class Worker:
     """One fleet worker. ``run_once()`` is the scheduling quantum the
-    fabric drives: acquire one lease, sweep it, report it.
+    fabric drives: acquire ``1 + prefetch`` leases, sweep them (grouped
+    when the session can), report them.
 
     ``sweep_kwargs`` are the uniform per-lease sweep knobs
     (chunk_steps, superstep_max, recycle/batch_worlds, ...);
     ``checkpoint_dir`` enables per-lease checkpointing (preemption
-    survival + crash recovery); ``checkpoint_every_chunks`` its cadence.
+    survival + crash recovery); ``checkpoint_every_chunks`` its cadence;
+    ``prefetch`` the acquire-ahead depth (0 = one lease per quantum,
+    the pre-session fabric behavior).
     """
 
     def __init__(self, worker_id: str, engine, seeds, transport, clock,
@@ -75,7 +99,8 @@ class Worker:
                  chaos=None, emit=None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every_chunks: int = 4,
-                 sweep_kwargs: Optional[Dict[str, Any]] = None):
+                 sweep_kwargs: Optional[Dict[str, Any]] = None,
+                 prefetch: int = 0):
         self.worker_id = worker_id
         self.engine = engine
         self.seeds = np.asarray(seeds, np.uint64)
@@ -89,11 +114,15 @@ class Worker:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_chunks = checkpoint_every_chunks
         self.sweep_kwargs = dict(sweep_kwargs or {})
+        self.prefetch = max(0, int(prefetch))
         self.dead = False
         self.died_at: float = 0.0
         self.preempted = False
         self._preempt_requested = False
         self._lease: Optional[Dict[str, Any]] = None
+        self._held: List[Dict[str, Any]] = []
+        self._group_mode = False
+        self._session = None
         self._delayed_progress: Optional[Dict[str, Any]] = None
         self._hb_count = 0
         self.stats = {"leases_run": 0, "completions": 0, "kills": 0,
@@ -103,12 +132,21 @@ class Worker:
                       "checkpoints_recovered": 0,
                       "checkpoints_discarded": 0,
                       "corpus_published": 0, "corpus_resent": 0,
-                      "corpus_seeded": 0}
+                      "corpus_seeded": 0,
+                      "leases_prefetched": 0, "grouped_leases": 0,
+                      "acquire_s": 0.0, "sweep_s": 0.0}
+
+    @staticmethod
+    def _wall() -> float:
+        # Phase-timing telemetry only (bench.py fleet_sweep breakdown);
+        # never feeds a lease or sim decision.
+        from time import perf_counter
+        return perf_counter()  # detlint: allow[DET001]
 
     # -- preemption ------------------------------------------------------
     def request_preemption(self) -> None:
         """Ask the worker to stop at the next heartbeat, checkpoint, and
-        release its lease (the SIGTERM handler's body; also callable
+        release its leases (the SIGTERM handler's body; also callable
         directly, which is how the inline chaos harness models
         preemption)."""
         self._preempt_requested = True
@@ -127,11 +165,14 @@ class Worker:
         All lease state was lost with the 'process'; the engine and its
         jit caches survive because inline workers share the host
         process — a real restart would recompile, changing nothing
-        about results."""
+        about results. The sweep session's standing batch was already
+        invalidated when the dying sweep unwound."""
         self.dead = False
         self.preempted = False
         self._preempt_requested = False
         self._lease = None
+        self._held = []
+        self._group_mode = False
         self._delayed_progress = None
 
     # -- telemetry -------------------------------------------------------
@@ -155,73 +196,211 @@ class Worker:
             self.retry, self.clock, tag=f"{self.worker_id}:{method}",
             on_retry=on_retry)
 
+    # -- the persistent sweep session ------------------------------------
+    def session(self):
+        """The worker's persistent :class:`SweepSession` (created on
+        first use, held across leases — the point of the thing)."""
+        if self._session is None:
+            from ..parallel.sweep import SweepSession
+
+            kw = {k: self.sweep_kwargs[k]
+                  for k in SweepSession.GROUPABLE_KW
+                  if k in self.sweep_kwargs}
+            self._session = SweepSession(engine=self.engine,
+                                         mesh=self.mesh, **kw)
+        return self._session
+
+    def _groupable(self, leases: List[Dict[str, Any]]) -> bool:
+        """May these leases advance as ONE grouped device batch?
+        Checkpointing, corpus exchange, and any sweep mode outside the
+        session's grouped whitelist keep their per-lease machinery —
+        those leases run solo, sequentially, within the quantum."""
+        from ..parallel.sweep import SweepSession
+
+        if len(leases) < 2 or self.checkpoint_dir is not None:
+            return False
+        if any(l.get("exchange_epoch") is not None for l in leases):
+            return False
+        return all(k in SweepSession.GROUPABLE_KW
+                   for k in self.sweep_kwargs)
+
     # -- the scheduling quantum ------------------------------------------
     def run_once(self) -> bool:
-        """Acquire + run + report ONE lease. Returns True if any work
-        happened (False: idle — nothing pending, or acquire failed and
-        will be retried next round)."""
+        """Acquire + run + report up to ``1 + prefetch`` leases. Returns
+        True if any work happened (False: idle — nothing pending, or
+        acquire failed and will be retried next round)."""
         if self.dead:
             return False
+        want = 1 + self.prefetch
+        t0 = self._wall()
         try:
-            lease = self._call("acquire")
+            if want == 1:
+                lease = self._call("acquire")
+                leases = [] if lease is None else [lease]
+            else:
+                resp = self._call("acquire", count=want)
+                leases = list(resp.get("leases") or [])
         except RetryExhausted as exc:
             self.emit("acquire_abandoned", error=str(exc))
             return False
-        if lease is None:
+        finally:
+            self.stats["acquire_s"] += self._wall() - t0
+        if not leases:
             return False
-        self.stats["leases_run"] += 1
-        self._lease = lease
+        self.stats["leases_run"] += len(leases)
+        self.stats["leases_prefetched"] += len(leases) - 1
+        self._held = list(leases)
         try:
-            result = self._run_lease(lease)
+            if self._groupable(leases):
+                self._run_group_quantum(leases)
+            else:
+                self._run_solo_quantum(leases)
         except WorkerKilled:
             self.dead = True
             self.died_at = self.clock.now()
             self.stats["kills"] += 1
-            self.emit("worker_killed", lease_id=lease["lease_id"],
-                      range_id=lease["range_id"])
-            self._maybe_tear_checkpoint(lease)
+            for lease in self._held:
+                self.emit("worker_killed", lease_id=lease["lease_id"],
+                          range_id=lease["range_id"])
+                self._maybe_tear_checkpoint(lease)
             return True
         except LeasePreempted:
-            ck = self._lease_checkpoint(lease)
-            ck = ck if ck and os.path.exists(ck) else None
-            try:
-                self._call("release", lease_id=lease["lease_id"],
-                           checkpoint=ck)
-            except RetryExhausted:
-                pass  # expiry will re-queue the range; ck rides the table
+            for lease in self._held:
+                ck = None
+                if self._lease is not None and \
+                        lease["lease_id"] == self._lease["lease_id"]:
+                    ck = self._lease_checkpoint(lease)
+                    ck = ck if ck and os.path.exists(ck) else None
+                try:
+                    self._call("release", lease_id=lease["lease_id"],
+                               checkpoint=ck)
+                except RetryExhausted:
+                    pass  # expiry re-queues the range; ck rides the table
+                self.emit("worker_preempted", lease_id=lease["lease_id"],
+                          range_id=lease["range_id"], checkpoint=ck)
             self.dead = True
             self.preempted = True
             self.died_at = self.clock.now()
             self.stats["preemptions"] += 1
-            self.emit("worker_preempted", lease_id=lease["lease_id"],
-                      range_id=lease["range_id"], checkpoint=ck)
-            return True
-        except LeaseLost:
-            self.stats["leases_lost"] += 1
-            self.emit("lease_lost", lease_id=lease["lease_id"],
-                      range_id=lease["range_id"])
             return True
         finally:
             self._lease = None
+            self._held = []
+            self._group_mode = False
+        return True
+
+    def _run_group_quantum(self, leases: List[Dict[str, Any]]) -> None:
+        """All held leases through ONE SweepSession.run_group batch,
+        then one batched completion turn."""
+        parts = []
+        for lease in leases:
+            lo, hi = lease["lo"], lease["hi"]
+            faults = self.faults
+            if faults is not None and np.asarray(faults).ndim == 3:
+                faults = np.asarray(faults)[lo:hi]
+            parts.append({"seeds": self.seeds[lo:hi], "faults": faults})
+        self._group_mode = True
+        self._lease = leases[0]
+        self._hb_count = 0
+        self.stats["grouped_leases"] += len(leases)
+        t0 = self._wall()
+        try:
+            results = self.session().run_group(parts,
+                                               observe=self._heartbeat)
+        except LeaseLost:
+            # Every lease in the group was declared lost mid-flight
+            # (each already accounted by the heartbeat path): abandon
+            # the batch; re-execution elsewhere reproduces the results.
+            return
+        finally:
+            self.stats["sweep_s"] += self._wall() - t0
+        self._lease = None
+        # Complete EVERY range we computed — including any lease lost
+        # mid-group: determinism makes a late completion a harmless
+        # first-or-crosschecked duplicate, and it may beat the re-issue.
+        msgs = [{"method": "complete", "lease_id": l["lease_id"],
+                 "range_id": l["range_id"], "result": r}
+                for l, r in zip(leases, results)]
+        try:
+            resps = self._call("batch", msgs=msgs)
+            self.stats["completions"] += len(resps)
+        except RetryExhausted as exc:
+            for lease in leases:
+                self.emit("complete_abandoned",
+                          lease_id=lease["lease_id"],
+                          range_id=lease["range_id"], error=str(exc))
+
+    def _run_solo_quantum(self, leases: List[Dict[str, Any]]) -> None:
+        """Each held lease through the full per-lease sweep (checkpoint
+        / exchange / search machinery intact), sequentially."""
+        for lease in leases:
+            if not any(l["lease_id"] == lease["lease_id"]
+                       for l in self._held):
+                continue  # declared lost by an earlier heartbeat
+            self._lease = lease
+            t0 = self._wall()
+            try:
+                result = self._run_lease(lease)
+            except LeaseLost:
+                self.stats["leases_lost"] += 1
+                self.emit("lease_lost", lease_id=lease["lease_id"],
+                          range_id=lease["range_id"])
+                self._drop_held(lease["lease_id"])
+                self._lease = None
+                continue
+            finally:
+                # NB: self._lease stays set on kill/preempt unwind —
+                # run_once's handlers need to know WHICH lease was
+                # running (its checkpoint rides the release).
+                self.stats["sweep_s"] += self._wall() - t0
+            self._lease = None
+            self._report_lease(lease, result)
+            self._drop_held(lease["lease_id"])
+
+    def _drop_held(self, lease_id: int) -> None:
+        self._held = [l for l in self._held
+                      if l["lease_id"] != lease_id]
+
+    # -- reporting (publish + complete, one coalesced turn) --------------
+    def _report_lease(self, lease, result) -> None:
+        """Report one solo lease: the corpus publish (exchange leases)
+        and the completion ride ONE batched RPC turn — ordered publish
+        first so the exchange barrier lifts with the quantum, with the
+        coordinator's complete-time backstop unchanged behind it. A
+        torn publish falls back to the solo re-send loop."""
+        corpus = None
+        msgs = []
         if lease.get("exchange_epoch") is not None and \
                 getattr(result, "search", None) is not None:
-            # Publish the range's final corpus BEFORE the completion so
-            # the exchange barrier can lift as soon as the epoch's last
-            # quantum finishes; a lost publish is backstopped by the
-            # coordinator at complete (same dedupe path), so neither RPC
-            # alone is load-bearing.
-            self._publish_corpus(lease, result)
+            from .exchange import corpus_payload
+
+            corpus = self._result_corpus(result)
+            msgs.append({"method": "publish",
+                         "range_id": lease["range_id"],
+                         "snapshot": corpus_payload(corpus)})
+        msgs.append({"method": "complete", "lease_id": lease["lease_id"],
+                     "range_id": lease["range_id"], "result": result})
         try:
-            self._call("complete", lease_id=lease["lease_id"],
-                       range_id=lease["range_id"], result=result)
-            self.stats["completions"] += 1
+            resps = self._call("batch", msgs=msgs)
         except RetryExhausted as exc:
             # Abandon: the lease expires, the range re-issues, and the
             # re-execution (or our own retry on a later lease of the
             # same range) reproduces the identical result.
             self.emit("complete_abandoned", lease_id=lease["lease_id"],
                       range_id=lease["range_id"], error=str(exc))
-        return True
+            return
+        if corpus is not None:
+            presp = resps[0]
+            if presp.get("torn"):
+                self.stats["corpus_resent"] += 1
+                self._publish_corpus(lease, corpus, first_attempt=1)
+            else:
+                self.stats["corpus_published"] += 1
+                self.emit("corpus_published", range_id=lease["range_id"],
+                          epoch=lease.get("exchange_epoch"),
+                          duplicate=bool(presp.get("duplicate")),
+                          resent=0)
+        self.stats["completions"] += 1
 
     # -- lease execution -------------------------------------------------
     def _lease_checkpoint(self, lease) -> Optional[str]:
@@ -246,24 +425,27 @@ class Worker:
             self.emit("checkpoint_torn", range_id=lease["range_id"],
                       path=ck)
 
-    def _publish_corpus(self, lease, result) -> None:
-        """Send the finished range's corpus snapshot to the coordinator.
-
-        Retries ride the normal RPC backoff; a TORN response (payload
-        failed the coordinator's checksum — chaos, or a real transport
-        tearing bytes) re-sends a fresh serialization: the snapshot is
-        deterministic host data, so a re-send is bitwise identical and
-        the dedupe layer absorbs any accidental double delivery."""
+    def _result_corpus(self, result):
+        """The finished range's corpus snapshot (deterministic host
+        data — every serialization of it is bitwise identical)."""
         from ..search.corpus import HostCorpus
-        from .exchange import corpus_payload
 
         rep = result.search
-        corpus = HostCorpus(sched=rep.corpus_sched, sig=rep.corpus_sig,
-                            score=rep.corpus_score,
-                            filled=rep.corpus_filled,
-                            entry=rep.corpus_entry,
-                            depth=rep.corpus_depth)
-        for attempt in range(4):
+        return HostCorpus(sched=rep.corpus_sched, sig=rep.corpus_sig,
+                          score=rep.corpus_score,
+                          filled=rep.corpus_filled,
+                          entry=rep.corpus_entry,
+                          depth=rep.corpus_depth)
+
+    def _publish_corpus(self, lease, corpus, first_attempt: int = 0) -> None:
+        """Solo re-send loop for a corpus publish whose coalesced first
+        attempt came back TORN (payload failed the coordinator's
+        checksum — chaos, or a real transport tearing bytes): re-send a
+        fresh serialization; the dedupe layer absorbs any accidental
+        double delivery."""
+        from .exchange import corpus_payload
+
+        for attempt in range(first_attempt, 4):
             try:
                 resp = self._call("publish", range_id=lease["range_id"],
                                   snapshot=corpus_payload(corpus))
@@ -285,8 +467,6 @@ class Worker:
                   error="torn on every attempt")
 
     def _run_lease(self, lease) -> Any:
-        from ..parallel.sweep import sweep
-
         lo, hi = lease["lo"], lease["hi"]
         seeds = self.seeds[lo:hi]
         faults = self.faults
@@ -329,9 +509,8 @@ class Worker:
                 self.emit("lease_resumed", range_id=lease["range_id"],
                           checkpoint=lease["checkpoint"])
         self._hb_count = 0
-        run = lambda: sweep(  # noqa: E731
-            None, self.engine.cfg, seeds, faults=faults, engine=self.engine,
-            mesh=self.mesh, observe=self._heartbeat, **kwargs)
+        run = lambda: self.session().run(  # noqa: E731
+            seeds, faults=faults, observe=self._heartbeat, **kwargs)
         try:
             return run()
         except CheckpointError as exc:
@@ -352,7 +531,10 @@ class Worker:
         """sweep(observe=...) callback: one call per host scalar read.
         This is the fabric's preemption point — chaos and SIGTERM land
         here, between supersteps, where the sweep's exception path
-        flushes the checkpoint writer before unwinding."""
+        flushes the checkpoint writer before unwinding. One beat covers
+        EVERY held lease (the running one and any prefetched behind it):
+        liveness is a worker property, so the coalesced extension is the
+        semantics, not an approximation."""
         if record.get("event") == "summary":
             return  # final sweep record, not a liveness beat
         if record.get("schema") not in (None, "madsim.sweep.telemetry/1"):
@@ -388,14 +570,39 @@ class Worker:
         self._send_heartbeat(progress)
 
     def _send_heartbeat(self, progress: Dict[str, Any]) -> None:
+        held = self._held if self._held else (
+            [self._lease] if self._lease is not None else [])
+        if not held:
+            return
+        ids = [l["lease_id"] for l in held]
+        kw = ({"lease_id": ids[0]} if len(ids) == 1
+              else {"lease_ids": ids})
         try:
-            resp = self._call("heartbeat",
-                              lease_id=self._lease["lease_id"],
-                              progress=progress)
+            resp = self._call("heartbeat", progress=progress, **kw)
         except RetryExhausted:
             # Transport down: keep sweeping — the lease may expire, in
             # which case a later beat (or the completion) learns it.
             return
         self.stats["heartbeats_sent"] += 1
-        if not resp.get("ok"):
-            raise LeaseLost(self._lease["lease_id"])
+        lost = resp.get("lost")
+        if lost is None:
+            lost = [] if resp.get("ok") else ids
+        if not lost:
+            return
+        lost = set(lost)
+        running_id = (self._lease["lease_id"]
+                      if self._lease is not None else None)
+        for lease in list(self._held):
+            if lease["lease_id"] not in lost:
+                continue
+            if lease["lease_id"] == running_id and not self._group_mode:
+                continue  # raised below — the solo queue accounts it
+            self.stats["leases_lost"] += 1
+            self.emit("lease_lost", lease_id=lease["lease_id"],
+                      range_id=lease["range_id"])
+            self._drop_held(lease["lease_id"])
+        if running_id in lost and not self._group_mode:
+            raise LeaseLost(running_id)
+        if self._group_mode and not self._held:
+            # Every lease of the group is gone: abandon the batch.
+            raise LeaseLost(tuple(sorted(lost)))

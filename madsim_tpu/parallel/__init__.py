@@ -11,7 +11,8 @@ same mesh over DCN — the sharded world axis simply spans processes.
 """
 from .mesh import (multihost_mesh, seed_mesh, shard_worlds, world_sharding,
                    world_spec)
-from .sweep import SweepResult, sharded_engine, sweep
+from .sweep import SweepResult, SweepSession, sharded_engine, sweep
 
 __all__ = ["seed_mesh", "multihost_mesh", "shard_worlds", "world_spec",
-           "world_sharding", "sharded_engine", "sweep", "SweepResult"]
+           "world_sharding", "sharded_engine", "sweep", "SweepResult",
+           "SweepSession"]

@@ -2111,3 +2111,372 @@ def _observer(eng: DeviceEngine):
         fn = jax.jit(lambda s, i: (eng.observe_device(s), i))
         eng.__dict__["_observer_fn"] = fn
     return fn
+
+
+class SweepSession:
+    """A persistent sweep session: the fleet's answer to O(fresh-sweep)
+    lease turnaround (docs/fleet.md "Fabric cost model").
+
+    ``sweep()`` pays a per-call host tax — seed/fault padding and
+    hashing, batch ``init``, compile-cache lookups, telemetry plumbing —
+    that a fleet worker used to repeat for EVERY leased range. A session
+    pins the (engine, mesh, chunk/superstep geometry) once and streams
+    successive seed ranges through it:
+
+    * :meth:`run` is a drop-in ``sweep()`` with the session's engine,
+      mesh, and loop geometry pre-bound — checkpoint/resume, ``search=``
+      corpus seeding, and every other sweep mode stay per-lease.
+    * :meth:`run_group` takes SEVERAL ranges at once and advances them
+      as ONE standing device batch (the widths the engine is actually
+      efficient at), then splits per-range ``SweepResult``s that are
+      bit-identical to one fresh ``sweep()`` per range. Worlds are
+      position-independent and every range installs at chunk 0, so a
+      grouped world's trajectory equals its solo counterpart's bit for
+      bit; chunks past a range's retirement are on-device pass-throughs
+      on inactive worlds. The standing slots are RECYCLED between
+      groups: the next group's worlds enter through ``DeviceEngine.
+      refill`` (all-slots mask, donating the dead batch in place)
+      rather than a fresh double-buffered ``init``.
+
+    Sync discipline matches the solo pipelined loop exactly: dispatch-
+    ahead supersteps, ONE ``_fetch`` per superstep, and (coverage on)
+    one final ledger pull covering every range — counted by the tier-1
+    seam tests (tests/test_fleet.py) against the non-session path.
+
+    NOT thread-safe; one session per worker.
+    """
+
+    #: sweep() kwargs run_group understands. A lease whose sweep kwargs
+    #: leave this set (checkpointing, search, recycle, ...) must run
+    #: solo through :meth:`run` — the worker enforces this split.
+    GROUPABLE_KW = frozenset(
+        {"chunk_steps", "max_steps", "superstep_max", "coverage_buckets"})
+
+    def __init__(self, actor: Any = None, cfg: Optional[EngineConfig] = None,
+                 *, engine: Optional[DeviceEngine] = None,
+                 mesh: Optional[Mesh] = None, chunk_steps: int = 512,
+                 max_steps: int = 1_000_000, superstep_max: int = 16,
+                 coverage_buckets: Optional[int] = None):
+        if engine is None:
+            if cfg is None:
+                raise ValueError(
+                    "SweepSession needs engine=DeviceEngine(...) or "
+                    "(actor, cfg) to build one")
+            engine = DeviceEngine(actor, cfg)
+        if superstep_max < 1:
+            raise ValueError("superstep_max must be >= 1")
+        self.engine = engine
+        self.mesh = mesh if mesh is not None else seed_mesh()
+        self.chunk_steps = int(chunk_steps)
+        self.max_steps = int(max_steps)
+        self.superstep_max = int(superstep_max)
+        self.coverage_buckets = coverage_buckets
+        #: Ranges served without paying a fresh per-lease sweep setup
+        #: (bench.py fleet_sweep reports the fleet-wide sum).
+        self.reuse_hits = 0
+        self._runs = 0
+        self._k_warm = 1          # adaptive-K carry across groups
+        self._slot_state = None   # standing batch between groups
+        self._slot_w = 0
+
+    # -- solo path --------------------------------------------------------
+
+    def run(self, seeds, faults: Optional[np.ndarray] = None,
+            **kw) -> SweepResult:
+        """One leased range through the full ``sweep()`` — session
+        engine/mesh/geometry pre-bound, every per-lease mode
+        (checkpoint/resume, ``search=``, recycling) available."""
+        kw.setdefault("chunk_steps", self.chunk_steps)
+        kw.setdefault("max_steps", self.max_steps)
+        kw.setdefault("superstep_max", self.superstep_max)
+        if self.coverage_buckets is not None:
+            kw.setdefault("coverage_buckets", self.coverage_buckets)
+        # A solo run does not leave the standing batch in a known state.
+        self._slot_state = None
+        first = self._runs == 0
+        self._runs += 1
+        if not first:
+            self.reuse_hits += 1
+        return sweep(None, self.engine.cfg, seeds, faults=faults,
+                     engine=self.engine, mesh=self.mesh, **kw)
+
+    # -- grouped path -----------------------------------------------------
+
+    def _part_sha256(self, faults: Optional[np.ndarray]) -> Optional[str]:
+        """Replicate the solo sweep's ``faults_sha256`` for one range:
+        sha256 over the PADDED int32 rows (3-D schedules pad to the
+        mesh-rounded id space with repeats of row 0, exactly as
+        ``sweep()`` pads), so a grouped result's fingerprint equals its
+        solo counterpart's byte for byte."""
+        import hashlib
+        if faults is None:
+            return None
+        fp = np.asarray(faults, np.int32)
+        if fp.ndim == 3:
+            n_i = fp.shape[0]
+            pad = (-n_i) % self.mesh.devices.size
+            if pad:
+                fp = np.concatenate([fp, fp[:1].repeat(pad, axis=0)], axis=0)
+        return hashlib.sha256(
+            np.ascontiguousarray(fp).tobytes()).hexdigest()
+
+    def run_group(self, parts: List[Dict[str, Any]],
+                  observe: Any = None) -> List[SweepResult]:
+        """Advance several seed ranges as one standing device batch;
+        return one ``SweepResult`` per range, bit-identical to a fresh
+        per-range ``sweep()`` (tier-1 contract, tests/test_fleet.py).
+
+        ``parts``: ``[{"seeds": (n_i,) uint64, "faults": None | (F, 4)
+        shared template | (n_i, F, 4) per-world}, ...]``. All parts must
+        agree on the faults *form* (the worker groups only leases that
+        slice one fleet-level schedule). ``observe``: the solo sweep's
+        live-telemetry sink — one record per superstep scalar read,
+        schema ``madsim.sweep.telemetry/1`` — which is what lets the
+        fleet worker's heartbeat (and therefore every chaos preemption
+        point) ride the grouped loop at the same cadence.
+        """
+        from time import perf_counter
+
+        from ..obs import observatory as _obsy
+        from ..obs.coverage import (
+            DEFAULT_BUCKETS,
+            coverage_from_device,
+            ledger_zeros,
+        )
+
+        def _clk() -> float:
+            # Loop wall telemetry only; never feeds a sim decision.
+            return perf_counter()  # detlint: allow[DET001]
+
+        if not parts:
+            raise ValueError("run_group needs at least one range")
+        eng, mesh = self.engine, self.mesh
+        n_dev = mesh.devices.size
+        chunk_steps, superstep_max = self.chunk_steps, self.superstep_max
+        cov_on = bool(eng.cfg.metrics)
+        cov_k = (int(self.coverage_buckets) if self.coverage_buckets
+                 else DEFAULT_BUCKETS)
+
+        # -- combine ranges into one batch --------------------------------
+        seeds_list: List[np.ndarray] = []
+        faults_list: List[Optional[np.ndarray]] = []
+        for p in parts:
+            s = np.asarray(p["seeds"], np.uint64)
+            if s.shape[0] == 0:
+                raise ValueError("run_group ranges must be non-empty")
+            f = p.get("faults")
+            if f is not None:
+                f = np.asarray(f, np.int32)
+                if f.ndim not in (2, 3) or f.shape[-1] != 4:
+                    raise ValueError(
+                        f"range fault schedules must be (F, 4) or "
+                        f"(n_i, F, 4); got shape {f.shape}")
+                if f.ndim == 3 and f.shape[0] != s.shape[0]:
+                    raise ValueError(
+                        f"per-world schedules carry one (F, 4) block per "
+                        f"seed: got leading dim {f.shape[0]} for "
+                        f"{s.shape[0]} seeds")
+            seeds_list.append(s)
+            faults_list.append(f)
+        forms = {(None if f is None else f.ndim) for f in faults_list}
+        if len(forms) > 1:
+            raise ValueError(
+                "run_group ranges must agree on the faults form "
+                "(all None, all shared (F, 4), or all per-world)")
+        form = forms.pop()
+
+        n_list = [int(s.shape[0]) for s in seeds_list]
+        offs = np.concatenate([[0], np.cumsum(n_list)]).astype(int)
+        n_tot = int(offs[-1])
+        w = n_tot + ((-n_tot) % n_dev)
+        seeds_c = np.concatenate(seeds_list)
+        if w > n_tot:  # mesh padding: dummy worlds, sliced off below
+            seeds_c = np.concatenate([seeds_c, seeds_c[:1].repeat(w - n_tot)])
+        if form is None:
+            faults_init = None
+        elif form == 2:
+            faults_init = faults_list[0]
+            for f in faults_list[1:]:
+                if not np.array_equal(f, faults_init):
+                    raise ValueError(
+                        "shared (F, 4) templates must be identical "
+                        "across grouped ranges")
+        else:
+            faults_init = np.concatenate(faults_list, axis=0)
+            if w > n_tot:
+                faults_init = np.concatenate(
+                    [faults_init, faults_init[:1].repeat(w - n_tot, axis=0)],
+                    axis=0)
+
+        # -- install: recycle the standing slots, else fresh init ---------
+        reused = self._slot_state is not None and self._slot_w == w
+        if reused:
+            prev_state, self._slot_state = self._slot_state, None
+            state = shard_worlds(
+                eng.refill(prev_state, np.ones(w, bool), seeds_c,
+                           faults=faults_init), mesh)
+        else:
+            self._slot_state = None
+            state = shard_worlds(eng.init(seeds_c, faults=faults_init), mesh)
+        first = self._runs == 0
+        self._runs += 1
+        self.reuse_hits += len(parts) - (1 if first else 0)
+
+        emit_telemetry, close_telemetry = _obsy.make_observer(observe)
+        t_loop0 = _clk()
+        perf = {"dispatches": 0, "scalar_fetches": 0, "device_wait_s": 0.0,
+                "dispatch_s": 0.0, "dispatch_depth": 0}
+
+        # -- pipelined dispatch-ahead loop (the solo loop, minus the
+        # refill/shrink/search edges grouped mode never takes) ------------
+        c_max = -(-self.max_steps // chunk_steps)
+        chunks = 0
+        k_cur = max(1, min(self._k_warm, superstep_max))
+        epoch_fresh = True
+        inflight: Optional[_Flight] = None
+        stop = False
+        n_act = n_tot
+
+        def dispatch(reserve: int = 0) -> None:
+            nonlocal state, inflight, epoch_fresh
+            k = max(1, min(k_cur, c_max - chunks - reserve, superstep_max))
+            if epoch_fresh:
+                k = 1
+            runner = sharded_superstep(
+                eng, mesh, chunk_steps, superstep_max, donate=True,
+                min_one=epoch_fresh, coverage=None)
+            epoch_fresh = False
+            t0 = _clk()
+            state, any_bug, n_active, k_done, hist = runner(
+                state, jnp.int32(0), jnp.asarray(False), jnp.int32(k))
+            perf["dispatch_s"] += _clk() - t0
+            perf["dispatches"] += 1
+            inflight = _Flight(any_bug, n_active, k_done, hist, k, w, 0, None)
+
+        try:
+            if c_max > 0:
+                dispatch()
+            while inflight is not None:
+                prev, inflight = inflight, None
+                if not stop and chunks + prev.planned < c_max:
+                    dispatch(reserve=prev.planned)
+                t0 = _clk()
+                bug_h, n_act_h, k_done_h, _hist_h = _fetch(
+                    (prev.any_bug, prev.n_active, prev.k_done, prev.hist))
+                perf["device_wait_s"] += _clk() - t0
+                perf["scalar_fetches"] += 1
+                perf["dispatch_depth"] = max(
+                    perf["dispatch_depth"], 1 if inflight is not None else 0)
+                k_done = int(k_done_h)
+                n_act = int(n_act_h)
+                chunks += k_done
+                if k_done == prev.planned:
+                    k_cur = min(k_cur * 2, superstep_max)
+                else:
+                    k_cur = max(k_done, 1)
+                if not stop and n_act == 0:
+                    stop = True
+                if emit_telemetry is not None:
+                    elapsed = _clk() - t_loop0
+                    done = max(n_tot - n_act, 0)
+                    emit_telemetry({
+                        "schema": "madsim.sweep.telemetry/1",
+                        "elapsed_s": round(elapsed, 6),
+                        "chunks": int(chunks),
+                        "steps": int(chunks * chunk_steps),
+                        "batch_worlds": int(w),
+                        "n_active": int(n_act),
+                        "occupancy": round(n_act / w, 4) if w else 0.0,
+                        "seeds_total": int(n_tot),
+                        "seeds_done": int(done),
+                        "bug_seen": bool(bug_h),
+                        "session_group": len(parts),
+                        "dispatch_depth": 1 if inflight is not None else 0,
+                    })
+                if stop:
+                    break
+                if inflight is None and chunks < c_max:
+                    dispatch()
+        except BaseException:
+            # A kill/preemption mid-group leaves donated buffers in an
+            # unknown state: drop the standing batch, never resume it.
+            self._slot_state = None
+            self._slot_w = 0
+            if close_telemetry is not None:
+                close_telemetry()
+            raise
+
+        # -- per-range extraction -----------------------------------------
+        # One eng.observe pull (its own single device_get, exactly the
+        # solo end-of-sweep read) + (coverage on) ONE _fetch batching
+        # every range's end-folded ledger.
+        ledgers_h = None
+        if cov_on:
+            folder = _cov_endfolder(eng, mesh)
+            sharding = NamedSharding(mesh, scalar_spec())
+            ledgers = []
+            for i, n_i in enumerate(n_list):
+                idx_np = np.full(w, -1, np.int32)
+                idx_np[offs[i]:offs[i + 1]] = np.arange(n_i, dtype=np.int32)
+                idx_r = shard_worlds(jnp.asarray(idx_np), mesh)
+                hits, first = jax.device_put(ledger_zeros(cov_k), sharding)
+                n_real = jnp.int32(n_i)
+                # Two boundary folds per range: worlds that retired
+                # during the group (frozen histograms — the resume
+                # pre-pass precedent), then worlds still live at exit.
+                # hits/first_seen are fold-order invariant, so the pair
+                # equals the solo sweep's mid-loop + end folds exactly.
+                hits, first = folder(state, hits, first, idx_r, n_real,
+                                     jnp.asarray(False))
+                hits, first = folder(state, hits, first, idx_r, n_real,
+                                     jnp.asarray(True))
+                ledgers.append((hits, first))
+            ledgers_h = _fetch(ledgers)
+        obs_all = eng.observe(state)
+
+        self._slot_state = state
+        self._slot_w = w
+        self._k_warm = k_cur
+
+        steps = chunks * chunk_steps
+        issued = w * chunk_steps * chunks
+        live_steps = int(np.asarray(obs_all["steps"])[:n_tot].sum())
+        util = live_steps / issued if issued else 0.0
+        loop_stats_base = {
+            "pipelined": True,
+            "session": True,
+            "session_group": len(parts),
+            "session_reused_slots": bool(reused),
+            "superstep_max": int(superstep_max),
+            "chunk_steps": int(chunk_steps),
+            "chunks": int(chunks),
+            "dispatches": int(perf["dispatches"]),
+            "chunks_per_dispatch": round(
+                chunks / max(perf["dispatches"], 1), 3),
+            "dispatch_depth": int(perf["dispatch_depth"]),
+            "device_wait_s": round(perf["device_wait_s"], 6),
+            "dispatch_s": round(perf["dispatch_s"], 6),
+            "scalar_fetches": int(perf["scalar_fetches"]),
+            "loop_wall_s": round(_clk() - t_loop0, 6),
+        }
+
+        results: List[SweepResult] = []
+        for i, (s, f) in enumerate(zip(seeds_list, faults_list)):
+            lo, hi = int(offs[i]), int(offs[i + 1])
+            obs = {k: np.asarray(v)[lo:hi] for k, v in obs_all.items()}
+            coverage = None
+            if cov_on:
+                hits_h, first_h = ledgers_h[i]
+                coverage = coverage_from_device(
+                    cov_k, np.asarray(hits_h), np.asarray(first_h), [])
+            results.append(SweepResult(
+                seeds=s, bug=obs["bug"], observations=obs,
+                steps_run=steps, n_devices=n_dev,
+                world_utilization=util,
+                loop_stats=dict(loop_stats_base),
+                faults_sha256=self._part_sha256(f),
+                coverage=coverage,
+                triage_ctx=TriageContext(engine=eng, faults=f, mesh=mesh)))
+        if close_telemetry is not None:
+            close_telemetry()
+        return results

@@ -376,7 +376,11 @@ def test_fleet_stalls_loudly_when_unrecoverable(raft_eng):
     """All workers dead + restarts disabled must raise FleetStalledError
     — and its message must name each stuck range with its holding
     worker, lease generation, and last-heartbeat bookkeeping (the PR 12
-    satellite: diagnostics, not a bare range count)."""
+    satellite: diagnostics, not a bare range count). Under the default
+    lease prefetch BOTH of the dead worker's leases are outstanding —
+    the report must name the running one AND the prefetched one, with
+    the prefetched lease annotated as queued behind the running lease
+    (a prefetched lease must not read as a hung sweep)."""
     from madsim_tpu.fleet import FleetStalledError
 
     with pytest.raises(FleetStalledError, match="dead") as exc:
@@ -389,7 +393,124 @@ def test_fleet_stalls_loudly_when_unrecoverable(raft_eng):
     assert "range 0: held by w0" in msg
     assert "last heartbeat" in msg and "heartbeats" in msg
     assert "expires t=" in msg
+    # The prefetched lease: held by the same worker, explicitly marked.
+    assert "range 1: held by w0" in msg
+    assert "prefetched behind lease 0" in msg
+
+
+def test_fleet_stall_report_without_prefetch(raft_eng):
+    """prefetch=0 restores the one-lease-per-quantum fabric: a stalled
+    single-worker fleet holds only its running range; the other range
+    is reported pending for re-issue."""
+    from madsim_tpu.fleet import FleetStalledError
+
+    with pytest.raises(FleetStalledError, match="dead") as exc:
+        fleet_sweep(None, raft_eng.cfg, np.arange(16), engine=raft_eng,
+                    n_workers=1, range_size=8, prefetch=0,
+                    chaos=ChaosConfig(seed=1, kill_at=(("w0", 1),),
+                                      restart_after=-1),
+                    **SWEEP_KW)
+    msg = str(exc.value)
+    assert "range 0: held by w0" in msg
+    assert "prefetched" not in msg
     assert "range 1: pending" in msg
+
+
+# ---------------------------------------------------------------------------
+# Fabric cost disciplines (ISSUE 17): persistent sessions, prefetch,
+# coalesced control plane — counted, not vibes
+# ---------------------------------------------------------------------------
+
+def test_session_run_group_bitwise_equals_solo_sweeps(raft_eng):
+    """The tentpole's correctness gate: every per-range result a
+    SweepSession.run_group emits is bitwise interchangeable (contract
+    fields) with a fresh solo ``sweep()`` of that range — including the
+    SECOND group, which rides the session's recycled standing slots
+    (``refill`` path) instead of a fresh device init."""
+    from madsim_tpu.fleet.merge import contract_mismatches
+    from madsim_tpu.parallel import SweepSession
+
+    sess = SweepSession(engine=raft_eng, mesh=None, **SWEEP_KW)
+    groups = [np.arange(48, dtype=np.uint64),
+              np.arange(100, 148, dtype=np.uint64)]
+    for gi, seeds in enumerate(groups):
+        parts = [{"seeds": seeds[lo:lo + 16], "faults": None}
+                 for lo in range(0, 48, 16)]
+        results = sess.run_group(parts)
+        assert len(results) == 3
+        for part, res in zip(parts, results):
+            solo = sweep(None, raft_eng.cfg, part["seeds"],
+                         engine=raft_eng, **SWEEP_KW)
+            assert contract_mismatches(solo, res) == []
+            assert res.loop_stats["session_group"] == 3
+            assert res.loop_stats["session_reused_slots"] == (gi > 0)
+    # 6 leases rode the session; only the very first paid an install.
+    assert sess.reuse_hits == 5
+
+
+def test_session_grouped_adds_no_device_fetches(raft_eng, monkeypatch):
+    """Counted discipline: a grouped session quantum performs NO more
+    host pulls through the sanctioned ``_fetch`` hook than the same
+    ranges swept solo (the grouped pipelined loop still pays ONE scalar
+    fetch per superstep and one ledger pull — for the whole group
+    instead of per range)."""
+    import importlib
+
+    from madsim_tpu.parallel import SweepSession
+
+    # The package re-exports the sweep FUNCTION under the module's
+    # name, so fetch the module object explicitly.
+    sweep_mod = importlib.import_module("madsim_tpu.parallel.sweep")
+
+    seeds = np.arange(200, 248, dtype=np.uint64)
+    counter = {"n": 0}
+    real_fetch = sweep_mod._fetch
+
+    def counting_fetch(x):
+        counter["n"] += 1
+        return real_fetch(x)
+
+    monkeypatch.setattr(sweep_mod, "_fetch", counting_fetch)
+    solo_fetches = 0
+    for lo in range(0, 48, 16):
+        counter["n"] = 0
+        sweep_mod.sweep(None, raft_eng.cfg, seeds[lo:lo + 16],
+                        engine=raft_eng, **SWEEP_KW)
+        solo_fetches += counter["n"]
+    sess = SweepSession(engine=raft_eng, mesh=None, **SWEEP_KW)
+    counter["n"] = 0
+    sess.run_group([{"seeds": seeds[lo:lo + 16], "faults": None}
+                    for lo in range(0, 48, 16)])
+    grouped_fetches = counter["n"]
+    assert grouped_fetches <= solo_fetches, \
+        (f"grouped quantum pulled {grouped_fetches} times vs "
+         f"{solo_fetches} solo — the session must not add device syncs")
+
+
+def test_fleet_control_rpcs_bounded_per_lease(raft_eng, raft_single):
+    """The coalesced control plane's gate, measured: a clean fleet's
+    non-heartbeat transport turns per issued lease stay within the
+    named constant (fleet.MAX_CONTROL_RPCS_PER_LEASE) — one acquire
+    turn covers a worker's whole prefetched quantum and one batched
+    turn reports it."""
+    from madsim_tpu.fleet import MAX_CONTROL_RPCS_PER_LEASE
+
+    fleet = fleet_sweep(None, raft_eng.cfg, RAFT_SEEDS, engine=raft_eng,
+                        n_workers=2, range_size=16, **SWEEP_KW)
+    assert_contract_equal(raft_single, fleet)
+    stats = fleet.loop_stats["fleet"]
+    assert stats["leases_prefetched"] >= 1
+    assert stats["grouped_leases"] >= 2
+    assert stats["session_reuse_hits"] >= 1
+    assert stats["control_rpcs_per_lease"] <= MAX_CONTROL_RPCS_PER_LEASE
+    turns = stats["rpc_turns"]
+    # 4 ranges over 2 workers: one acquire turn per worker quantum plus
+    # at most a few idle polls; completions ride batched turns.
+    assert turns["acquire"] <= 2 * MAX_CONTROL_RPCS_PER_LEASE
+    assert turns.get("batch", 0) >= 2
+    assert turns.get("complete", 0) == 0  # completions only ride batches
+    assert stats["acquire_s"] >= 0.0 and stats["sweep_s"] > 0.0
+    assert "merge_s" in stats
 
 
 # ---------------------------------------------------------------------------

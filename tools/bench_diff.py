@@ -96,6 +96,16 @@ METRICS: List[Tuple[str, str, bool]] = [
     ("fleet seeds/s", "configs.fleet_sweep.fleet_seeds_per_sec", True),
     ("fleet overhead frac",
      "configs.fleet_sweep.fabric_overhead_frac", False),
+    # Fabric cost model breakdown (ISSUE 17; docs/fleet.md "Fabric
+    # cost model"): per-lease phase timings and the coalesced control
+    # plane's counted discipline — tracked so the O(1) lease turnaround
+    # can't silently regress toward O(fresh sweep).
+    ("fleet acquire ms/lease", "configs.fleet_sweep.acquire_ms", False),
+    ("fleet sweep ms/lease", "configs.fleet_sweep.sweep_ms", False),
+    ("fleet merge ms", "configs.fleet_sweep.merge_ms", False),
+    ("fleet rpcs/lease", "configs.fleet_sweep.rpcs_per_lease", False),
+    ("fleet session reuse hits",
+     "configs.fleet_sweep.session_reuse_hits", True),
     # Failure-triage economy (docs/triage.md; bench_minimize_bug): how
     # cheaply a hunt's failure turns into a 1-minimal repro — rounds ==
     # candidate sweeps, so both the search's round count and its wall

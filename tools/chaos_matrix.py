@@ -177,6 +177,11 @@ def run_exchange_leg(n_seeds: int = 320) -> int:
     injected = {k: stats[k] for k in
                 ("kills", "leases_reissued", "publishes_torn",
                  "duplicates_crosschecked", "rpc_retries")}
+    # Torn-publish-under-coalescing (ISSUE 17): the publish rides the
+    # batched publish+complete turn now, so a torn first attempt must
+    # surface through the batch response and re-send solo.
+    injected["corpus_resent"] = sum(
+        w["corpus_resent"] for w in stats["workers"].values())
     missing = [k for k in injected if not injected[k]]
     found = bool(clean.failing_seeds)
     ok = not bad and not missing and found
@@ -187,6 +192,63 @@ def run_exchange_leg(n_seeds: int = 320) -> int:
         "chaos_not_exercised": missing,
         "exchange_found_bug": found,
         "epochs_merged": stats["epochs_merged"],
+        "injected": injected,
+    }))
+    return 0 if ok else 1
+
+
+def run_session_prefetch_leg(n_seeds: int = 64) -> int:
+    """Fabric cost-model disciplines under chaos (ISSUE 17): grouped
+    persistent-session quanta + default lease prefetch, with NO
+    checkpoint dir so the grouped path is live — w0 is preempted at its
+    FIRST heartbeat (mid-prefetch: its prefetched leases are still
+    held and must all release cleanly), w1 is killed mid-group (held
+    leases recover via TTL expiry), completions are duplicated and RPCs
+    dropped. Gate: chaotic == clean == single-host bitwise, with the
+    disciplines demonstrably active (prefetched + grouped leases,
+    session reuse) and the chaos demonstrably landed on them."""
+    from madsim_tpu.engine import (
+        DeviceEngine, EngineConfig, RaftActor, RaftDeviceConfig,
+    )
+    from madsim_tpu.fleet import ChaosConfig, fleet_sweep
+    from madsim_tpu.parallel.sweep import sweep
+
+    eng = DeviceEngine(
+        RaftActor(RaftDeviceConfig(n=3, buggy_double_vote=True)),
+        EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                     t_limit_us=1_500_000, stop_on_bug=True,
+                     metrics=True))
+    seeds = np.arange(n_seeds)
+    kw = dict(chunk_steps=64, max_steps=20_000)
+    single = sweep(None, eng.cfg, seeds, engine=eng, **kw)
+    clean = fleet_sweep(None, eng.cfg, seeds, engine=eng, n_workers=2,
+                        range_size=n_seeds // 8, **kw)
+    chaotic = fleet_sweep(
+        None, eng.cfg, seeds, engine=eng, n_workers=2,
+        range_size=n_seeds // 8,
+        chaos=ChaosConfig(seed=23, preempt_at=(("w0", 1),),
+                          kill_at=(("w1", 3),),
+                          duplicate_all_completions=True,
+                          drop_rpc_rate=0.2, restart_after=2), **kw)
+    bad = (_contract_equal(single, clean)
+           + _contract_equal(single, chaotic))
+    cstats = clean.loop_stats["fleet"]
+    stats = chaotic.loop_stats["fleet"]
+    active = {k: (cstats[k], stats[k]) for k in
+              ("leases_prefetched", "grouped_leases",
+               "session_reuse_hits")}
+    injected = {k: stats[k] for k in
+                ("preemptions", "kills", "leases_expired",
+                 "leases_reissued", "duplicates_crosschecked")}
+    missing = ([k for k, v in active.items() if not v[0]]
+               + [k for k, v in injected.items() if not v])
+    ok = not bad and not missing
+    print(json.dumps({
+        "family": "raft(session+prefetch)", "ok": ok,
+        "n_seeds": n_seeds,
+        "contract_mismatches": bad,
+        "disciplines_inactive_or_chaos_missed": missing,
+        "disciplines": active,
         "injected": injected,
     }))
     return 0 if ok else 1
@@ -228,6 +290,7 @@ def main() -> int:
                     help="also run the multiprocess (spawn) leg")
     args = ap.parse_args()
     failures = run_matrix(args.seeds)
+    failures += run_session_prefetch_leg()
     failures += run_guided_leg()
     failures += run_exchange_leg()
     if args.process:
