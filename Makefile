@@ -7,6 +7,13 @@ PY ?= python
 # pass anywhere (tests/conftest.py pins this too; exporting here covers the
 # non-pytest entry points).
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# Persistent XLA compilation cache (madsim_tpu/parallel/compile_cache.py),
+# honored by every entry point at package import and inherited by spawned
+# fleet workers: each distinct program compiles ONCE across all of
+# `make check`'s legs, and CI re-runs start warm. The tracelint budget
+# leg is exempt by construction (analysis/budgets.py compiles fresh —
+# the cache strips cost/alias stats).
+export MADSIM_COMPILE_CACHE ?= $(CURDIR)/.jax_cache
 
 .PHONY: check lint detlint tracelint speclint speclint-demo test smoke \
         dryrun determinism dualmode native clean replay-demo bench-diff \
@@ -77,8 +84,8 @@ smoke:
 	sl=[d['configs'][k].get('sweep_loop') for k in \
 	    ('time_to_first_bug','madraft_5node')]; \
 	sneed={'device_wait_s','host_decision_s','dispatch_depth', \
-	       'dispatches_per_seed','chunks','dispatches', \
-	       'chunks_per_dispatch','loop_wall_s'}; \
+	       'dispatches_per_seed','seeds_per_dispatch','epochs_on_device', \
+	       'chunks','dispatches','chunks_per_dispatch','loop_wall_s'}; \
 	assert all(isinstance(x,dict) and sneed<=set(x) for x in sl), \
 	    f'sweep_loop records missing/incomplete: {sl}'; \
 	sm=[d['configs'][k].get('sim_metrics') for k in \
@@ -116,6 +123,12 @@ smoke:
 	assert isinstance(bp,dict) and {'j1_w64','j2_w64'}<=set(bp) and \
 	    all(bneed<=set(v) for v in bp.values()), \
 	    f'bridge pool record missing/incomplete: {bp}'; \
+	dsp={'seeds_per_dispatch','epochs_on_device'}; \
+	assert dsp<=set(p.get('sweep_loop',{})), \
+	    f'guided_hunt pair sweep_loop missing {dsp}: {p.get(\"sweep_loop\")}'; \
+	slf=d['configs']['time_to_first_bug'].get('sweep_loop_fused'); \
+	assert isinstance(slf,dict) and slf.get('fused') and \
+	    dsp<=set(slf), f'fused sweep_loop record missing/incomplete: {slf}'; \
 	ls=p.get('guided_operator_stats'); \
 	assert isinstance(ls,dict) and {'splice','node_rotate'}<=set(ls) \
 	    and all({'produced','novel','survived','bug'}<=set(v) \

@@ -776,6 +776,11 @@ def bench_guided_hunt(budget: int) -> dict:
             out[f"{mode}_lineage_depth"] = int(res.search.lineage_depth())
             out[f"{mode}_operator_stats"] = res.search.operator_stats
             out[f"{mode}_wall_s"] = round(dt, 3)
+            if guided:
+                # Dispatch economics of the guided leg (docs/perf.md
+                # "Whole-hunt residency"; make smoke asserts the
+                # seeds_per_dispatch / epochs_on_device keys).
+                out["sweep_loop"] = res.loop_stats
         g, r = out["guided_seeds_to_bug"], out["random_seeds_to_bug"]
         # seeds-to-bug ratio; an un-found random leg counts as budget+1
         # (a lower bound on the true gap).
@@ -1095,6 +1100,25 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         "found_bug": bool(res.bug.any()),
         "wall_s_incl_compile": round(recycled_dt, 3),
     }
+    # Whole-hunt residency (docs/perf.md): the SAME pinned hunt with the
+    # occupancy loop fused into one device program — refill, compaction,
+    # and the seed cursor run in-loop, so the host issues O(1)
+    # mega-dispatches instead of one dispatch per epoch. Bitwise
+    # equality with the pipelined run is tier-1 (tests/test_fused.py);
+    # here the dispatch economics land in bench_results.json so
+    # tools/bench_diff.py can hold the >=4x reduction round over round.
+    t0 = walltime.perf_counter()
+    res_f = device_sweep(None, cfg_s, np.arange(device_worlds),
+                         engine=eng_s, chunk_steps=64, max_steps=4_000,
+                         stop_on_first_bug=True, recycle=True,
+                         batch_worlds=batch_w, fused=True)
+    fused_dt = walltime.perf_counter() - t0
+    assert res_f.failing_seeds == res.failing_seeds, \
+        "fused hunt diverged from the pipelined hunt on the bench config"
+    recycled["fused_wall_s_incl_compile"] = round(fused_dt, 3)
+    recycled["fused_dispatch_reduction"] = round(
+        res.loop_stats["dispatches_per_seed"]
+        / max(res_f.loop_stats["dispatches_per_seed"], 1e-9), 2)
     # Observability record (docs/observability.md): the hunt config swept
     # metrics-on at a capped batch, with per-seed frames aggregated over
     # the fleet. Separate engine — metrics is a static knob; every timed
@@ -1146,6 +1170,12 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         # are host_decision_s vs loop_wall_s (stall fraction) and
         # chunks_per_dispatch (superstep fan-in).
         "sweep_loop": res.loop_stats,
+        # The same hunt under whole-hunt residency (docs/perf.md
+        # "Whole-hunt residency"): the acceptance axes are
+        # seeds_per_dispatch / dispatches_per_seed (>=4x fewer than the
+        # pipelined row above) and epochs_on_device (every refill epoch
+        # the host no longer orchestrates).
+        "sweep_loop_fused": res_f.loop_stats,
         # Statistical gate (docs/perf.md): Wilson-CI overlap, with a
         # bounded model-difference allowance (the two engines share the
         # bug mechanism, not the timing model) — replaces the toothless

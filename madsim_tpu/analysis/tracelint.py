@@ -423,6 +423,57 @@ def _build_search_generate() -> Built:
                                   op_tab, jnp.int32(0)))
 
 
+def _build_fused_hunt() -> Built:
+    """The whole-hunt fused program (parallel/sweep.py _fused_hunt) at
+    its widest shape — guided + lineage + coverage — so the ledger
+    budgets the full in-loop epoch body: chunk loop, stable compaction,
+    retiring-tail scatter, coverage fold, harvest+generate, refill, and
+    the device seed cursor, all inside ONE dispatch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..obs.coverage import ledger_zeros
+    from ..obs.lineage import lanes_buffer
+    from ..parallel.mesh import scalar_spec
+    from ..parallel.sweep import _fused_hunt
+
+    eng, mesh, scfg, w, state, sched, idx, corpus = _search_fixture()
+    lin, op_tab, _fill = _search_lineage_args(mesh, w)
+    del _fill
+    rep = NamedSharding(mesh, scalar_spec())
+    cov_k = 64
+    runner = _fused_hunt(eng, mesh, scfg, w=w, n_ids_b=w,
+                         f_rows=SEARCH_ROWS,
+                         chunk_steps=SWEEP_CHUNK_STEPS,
+                         k_bucket=SWEEP_K_MAX, cov_k=cov_k,
+                         lineage_on=True, fault_mode="search",
+                         recycle=True)
+    hits, first = jax.device_put(ledger_zeros(cov_k), rep)
+    obs_shapes = jax.eval_shape(eng.observe_device, state)
+    bufs = jax.device_put(
+        {k: jnp.zeros((w + 1,) + tuple(s.shape[1:]), s.dtype)
+         for k, s in obs_shapes.items()}, rep)
+    sb = np.full((w + 1, SEARCH_ROWS, 4), -1, np.int32)
+    sb[:, :, 1:] = 0
+    sched_buf = jax.device_put(jnp.asarray(sb), rep)
+    lin_buf = jax.device_put(lanes_buffer(w), rep)
+    seeds = np.arange(w, dtype=np.uint64)
+    tabs = jax.device_put(
+        {"lo": jnp.asarray((seeds & np.uint64(0xFFFFFFFF))
+                           .astype(np.uint32)),
+         "hi": jnp.asarray((seeds >> np.uint64(32)).astype(np.uint32))},
+        rep)
+    cursor = jax.device_put(jnp.int32(w), rep)
+    epochs = jax.device_put(jnp.int32(0), rep)
+    return Built(fn=runner, args=(
+        state, idx, cursor, epochs, bufs, (hits, first),
+        (sched, corpus, sched_buf, lin, op_tab, lin_buf), tabs,
+        jnp.int32(w), jnp.int32(w), jnp.int32(0), jnp.asarray(False),
+        jnp.int32(SWEEP_K_MAX)))
+
+
 def _build_compactor_sched() -> Built:
     """The guided with_sched compactor: state + slot index + per-slot
     schedules + lineage lanes permuted in ONE dispatch (the widened
@@ -647,6 +698,16 @@ def registry() -> Dict[str, TraceProgram]:
             "dispatch (undonated like sweep.compactor — gathers cannot "
             "alias)", _build_compactor_sched, budget=True,
             donates=False),
+        TraceProgram(
+            "sweep.fused_hunt", "whole-hunt fused program: the "
+            "occupancy loop — compaction, retiring-tail harvest, "
+            "coverage fold, guided generate, refill, seed cursor — in "
+            f"ONE dispatch (W={SEARCH_WORLDS}, "
+            f"chunk_steps={SWEEP_CHUNK_STEPS}, k={SWEEP_K_MAX}, guided "
+            "pair family, lineage on; undonated v1 — per-seed buffers "
+            "and loop state round-trip by value, docs/perf.md "
+            "Whole-hunt residency)", _build_fused_hunt, budget=True,
+            donates=False, packed=True),
         TraceProgram(
             "actorc.tpc_run", "compiled two-phase-commit run loop "
             f"(actorc spec, W={ACTORC_WORLDS}; TRC005 holds the "

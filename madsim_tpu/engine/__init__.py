@@ -21,6 +21,7 @@ from .core import (
     Event,
     Outbox,
     WorldState,
+    tree_select_worlds,
     FAULT_KILL,
     FAULT_RESTART,
     FAULT_CLOG_NODE,
@@ -59,6 +60,7 @@ def __getattr__(name):
 
 __all__ = [
     "DeviceEngine", "EngineConfig", "Event", "Outbox", "WorldState",
+    "tree_select_worlds",
     "RaftActor", "RaftDeviceConfig", "PBActor", "PBDeviceConfig",
     "TPCActor", "TPCDeviceConfig",
     "check_actor", "ConformanceError",

@@ -990,6 +990,99 @@ class DeviceEngine:
             return state, any_bug, n_active, k_done, hist, cov, cov_hist
         return state, any_bug, n_active, k_done, hist
 
+    def _fused_superstep_impl(self, state: WorldState, extras, stop_on_bug,
+                              k_chunks, *, chunk_steps: int, k_max: int,
+                              post_chunk, entry_stop):
+        """:meth:`_superstep_impl` with an in-loop epoch body: the
+        whole-hunt device loop.
+
+        Where the plain superstep EXITS when occupancy crosses a
+        threshold (so the host can refill/compact between dispatches),
+        this variant hands each chunk boundary to ``post_chunk`` — a
+        traced callback that owns the epoch machinery the serial sweep
+        loop ran on host: compaction, retiring-tail harvest, coverage/
+        lineage folds, guided child generation, the refill select and
+        the seed-cursor advance (parallel/sweep.py builds it). The loop
+        itself never stops for occupancy; it stops only when the
+        callback says the *hunt* is over (cursor dry and no world
+        active, or a bug under ``stop_on_bug``) or the chunk budget
+        ``k_chunks`` is spent.
+
+        ``extras`` is an opaque pytree carried through the loop — the
+        sweep threads the slot→seed index, the device seed cursor, the
+        per-seed observation buffers, the coverage ledger and the search
+        corpus through it. ``post_chunk(s, extras, act0, any_bug,
+        n_active, i)`` returns ``(s, extras, stop)``; ``entry_stop(
+        extras, any_bug0, n_active0)`` evaluates the same stop predicate
+        BEFORE the first chunk, preserving the plain superstep's
+        pass-through property (a dispatch against a finished hunt runs
+        zero chunks bitwise).
+
+        Reductions are full-array ``jnp`` ops, not ``psum``: the fused
+        program is a plain ``jit`` partitioned by GSPMD (the
+        ``_compactor`` precedent — its global stable argsort cannot run
+        under ``shard_map``), so a dtype-pinned integer sum over the
+        whole world axis is already the global count.
+        """
+        def measure(s):
+            any_bug = jnp.any(s.bug)
+            # dtype-pinned: jnp.sum(i32) widens to i64 under x64 (TRC003).
+            n_active = jnp.sum(s.active, dtype=jnp.int32)
+            return any_bug, n_active
+
+        stop_on_bug = jnp.asarray(stop_on_bug, bool)
+        k_chunks = jnp.minimum(jnp.asarray(k_chunks, jnp.int32), k_max)
+        any_bug0, n_active0 = measure(state)
+        hist0 = jnp.full((k_max,), -1, jnp.int32)
+        stop0 = entry_stop(extras, any_bug0, n_active0)
+
+        def cond(carry):
+            _s, i, stop, _ab, _na, _hist, _extras = carry
+            return (i < k_chunks) & ~stop
+
+        def body(carry):
+            s, i, _stop, _ab, _na, hist, extras = carry
+            act0 = s.active
+            s = self._run_steps_impl(s, chunk_steps)
+            any_bug, n_active = measure(s)
+            hist = jax.lax.dynamic_update_index_in_dim(hist, n_active, i, 0)
+            s, extras, stop = post_chunk(s, extras, act0, any_bug,
+                                         n_active, i)
+            return s, i + 1, stop, any_bug, n_active, hist, extras
+
+        state, k_done, _stop, any_bug, n_active, hist, extras = \
+            jax.lax.while_loop(
+                cond, body,
+                (state, jnp.int32(0), stop0, any_bug0, n_active0, hist0,
+                 extras))
+        return state, extras, any_bug, n_active, k_done, hist
+
+    def refill_traced(self, state: WorldState, slot_mask, seeds_lo,
+                      seeds_hi, faults) -> WorldState:
+        """:meth:`refill` as a pure traced program — the in-loop form.
+
+        Built for the fused superstep's epoch body: no host validation,
+        no ``device_put`` (everything already rides the enclosing
+        program), no donation bookkeeping — just the same
+        ``_init_one``-per-world init the jitted batched init runs,
+        followed by the masked world select. ``seeds_lo``/``seeds_hi``
+        are the split uint32 halves of the uint64 seeds (one row per
+        batch slot; rows outside the mask initialize placeholder worlds
+        the select discards, exactly like :meth:`refill`), ``faults`` is
+        a per-slot ``(W, F, 4)`` int32 schedule block. Latency/loss
+        configs come from the engine config — the only form the sweep's
+        refill path ever uses. Bitwise contract: equal inputs produce
+        worlds bit-identical to :meth:`refill`'s, because both run the
+        same ``vmap``'d ``_init_one`` (jit does not change values).
+        """
+        w = state.active.shape[0]
+        lat_min = jnp.full((w,), int(self.cfg.latency_min_us), jnp.int32)
+        lat_max = jnp.full((w,), int(self.cfg.latency_max_us), jnp.int32)
+        loss = jnp.full((w,), float(self.cfg.loss_rate), jnp.float32)
+        fresh = jax.vmap(self._init_one)(seeds_lo, seeds_hi, faults,
+                                         lat_min, lat_max, loss)
+        return tree_select_worlds(slot_mask, fresh, state)
+
     def _run_impl(self, state: WorldState, max_steps: int) -> WorldState:
         batched = self._batched_step
 

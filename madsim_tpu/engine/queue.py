@@ -121,8 +121,10 @@ def valid_mask(q: EventQueue) -> jnp.ndarray:
 
 
 def depth(q: EventQueue) -> jnp.ndarray:
-    """Number of pending events."""
-    return jnp.sum(valid_mask(q).astype(jnp.int32))
+    """Number of pending events. dtype pinned: under jax_enable_x64 an
+    unpinned integer sum accumulates as int64, which would fork the
+    metrics lane dtype between init-built and refill-built worlds."""
+    return jnp.sum(valid_mask(q), dtype=jnp.int32)
 
 
 def push(q: EventQueue, ev: Event, enable=True) -> Tuple[EventQueue, jnp.ndarray]:

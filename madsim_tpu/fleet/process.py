@@ -73,6 +73,13 @@ def _worker_main(conn, worker_id: str, actor, cfg, seeds, faults,
     # Spawned fresh: the parent's test/CI environment (JAX_PLATFORMS,
     # XLA device-count flags) rides the inherited env vars; the engine
     # and all jit caches are rebuilt here, as on any real fleet host.
+    # The persistent compilation cache (MADSIM_COMPILE_CACHE, set by the
+    # parent when a checkpoint dir exists) turns that rebuild into a
+    # disk load after the first worker compiles — without it, N workers
+    # compile the identical sweep program N times.
+    from ..parallel.compile_cache import enable_from_env
+
+    enable_from_env()
     from ..engine.core import DeviceEngine
     from .worker import Worker
 
@@ -142,6 +149,14 @@ def process_fleet_sweep(actor, cfg, seeds, *, n_workers: int,
                               n_devices=1)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
+        # Workers inherit env on spawn: point their persistent XLA
+        # cache at the durable workdir so respawns (and workers 2..N)
+        # load executables instead of recompiling them. An explicit
+        # MADSIM_COMPILE_CACHE in the environment wins.
+        from ..parallel.compile_cache import ENV_VAR
+
+        os.environ.setdefault(
+            ENV_VAR, os.path.join(checkpoint_dir, "xla_cache"))
     del retry  # worker-side policy is fixed in _worker_main
 
     ctx = mp.get_context("spawn")

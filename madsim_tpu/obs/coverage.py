@@ -137,6 +137,21 @@ def fold_retired(hits, first_seen, mb, fold_mask, idx,
     return hits + add, first_seen
 
 
+def fold_retired_local(hits, first_seen, mb, fold_mask, idx):
+    """:func:`fold_retired` for programs that see the FULL world axis.
+
+    The in-loop variant the fused whole-hunt superstep uses
+    (parallel/sweep.py): that program is a plain ``jit`` partitioned by
+    GSPMD rather than a ``shard_map`` body, so its scatters already
+    cover every world and the mesh reducers collapse to identity.
+    Integer adds and minima are reduction-order invariant, so the
+    resulting ledger is bitwise equal to the shard_mapped fold's.
+    """
+    ident = lambda x: x
+    return fold_retired(hits, first_seen, mb, fold_mask, idx,
+                        reduce_sum=ident, reduce_min=ident)
+
+
 def distinct_count(hits: jnp.ndarray) -> jnp.ndarray:
     """Number of non-empty buckets — the ``distinct_behaviors`` scalar.
     (dtype-pinned sum: a bare jnp.sum widens to i64 under the x64 flag,

@@ -112,6 +112,19 @@ def lanes_origin(w: int) -> LineageLanes:
     )
 
 
+def lanes_buffer(n_ids: int) -> LineageLanes:
+    """Device-resident PER-SEED lane buffer for the fused sweep.
+
+    One row per seed id plus one trailing dump row (index ``n_ids``)
+    that masked in-loop scatters target — the same dump-row idiom as
+    the coverage fold. Defaults equal :func:`lanes_origin`'s, so a seed
+    the hunt never admitted (or whose slot died on a dry cursor) reads
+    back exactly like a generation-0 template world — the value the
+    host-side merge in parallel/sweep.py assigns in the unfused paths.
+    """
+    return lanes_origin(n_ids + 1)
+
+
 def pack_ops(bits) -> jnp.ndarray:
     """Fold per-operator bool masks ``bits[i]`` (each ``(W,)``) into the
     packed i8 bitmask lane, through the sanctioned saturating

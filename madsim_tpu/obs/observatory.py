@@ -41,6 +41,13 @@ from typing import Any, Callable, List, Optional, Tuple
 # *virtual* timelines, and host clocks must never leak into them.
 _SCHEMA = "madsim.sweep.telemetry/1"
 
+# The summary record alone is versioned /2 since the whole-hunt fused
+# sweep: it carries ``seeds_per_dispatch`` and ``epochs_on_device`` as
+# TOP-LEVEL numerics (the Prometheus renderer exports only top-level
+# fields). Additive — every /1 consumer reads a /2 summary unchanged;
+# progress records stay /1 (docs/observability.md "Schema history").
+_SCHEMA_V2 = "madsim.sweep.telemetry/2"
+
 # The fleet fabric (madsim_tpu.fleet, docs/fleet.md) emits its protocol
 # events — lease_issued/expired/released, heartbeats, rpc_retry,
 # completions (with duplicate-crosscheck flags), worker
@@ -68,6 +75,7 @@ _SEARCH_SCHEMA = "madsim.search.telemetry/1"
 # snapshot's namespacing.
 _SCHEMA_KEYS = {
     _SCHEMA: "sweep",
+    _SCHEMA_V2: "sweep",
     _FLEET_SCHEMA: "fleet",
     _EXCHANGE_SCHEMA: "exchange",
     _SEARCH_SCHEMA: "search",
@@ -484,6 +492,13 @@ def render_summary(records: List[dict]) -> str:
             f"(utilization {summary.get('world_utilization', '?')}, "
             f"{ls.get('chunks', '?')} chunks / "
             f"{ls.get('dispatches', '?')} dispatches)")
+        if "seeds_per_dispatch" in summary:
+            # /2 summaries: the dispatch-economics gauges, top-level.
+            fused = " (fused hunt)" if ls.get("fused") else ""
+            lines.append(
+                f"dispatch economics: {summary['seeds_per_dispatch']} "
+                f"seeds/dispatch, {summary.get('epochs_on_device', 0)} "
+                f"refill epochs on device{fused}")
         cov = summary.get("coverage")
         if cov:
             lines.append(

@@ -1,0 +1,64 @@
+"""Persistent XLA compilation cache for cold processes.
+
+Every spawned fleet worker (``fleet/process.py``) and every CI
+invocation pays the full program-set compile from scratch: jit caches
+are per-process, and a fleet of N workers compiles the SAME sweep
+runner N times. JAX's persistent compilation cache
+(``jax_compilation_cache_dir``) is the fix — executables are stored on
+disk keyed by HLO + compile flags, so the first process populates and
+every later cold process (worker respawn after SIGKILL, the next CI
+shard, the next ``make check``) loads instead of compiling.
+
+Correctness-neutral by construction: the cache key covers the program
+and the backend configuration, and result determinism is separately
+pinned by the crosscheck/determinism suites — ``tests/
+test_compile_cache.py`` additionally asserts cached-vs-fresh bitwise
+equality end to end.
+
+Opt-in surfaces:
+
+- ``enable_compilation_cache(path)`` — call before tracing; idempotent.
+- ``MADSIM_COMPILE_CACHE`` env var — honored by spawned fleet workers
+  (set automatically by ``process_fleet_sweep`` when the fleet has a
+  checkpoint dir: the cache lives beside the checkpoints, the one
+  durable workdir a deployment already has) and by ``make check``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "MADSIM_COMPILE_CACHE"
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compilation_cache(cache_dir: str) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Safe to call more than once (last path wins, matching
+    ``jax.config`` semantics) and before OR after jax is first
+    imported — but must run before the programs you want cached are
+    compiled. Thresholds are zeroed so every program is eligible: this
+    codebase's programs are few, large, and identical across processes,
+    the exact shape the cache exists for.
+    """
+    global _enabled_dir
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled_dir = cache_dir
+    return cache_dir
+
+
+def enable_from_env() -> Optional[str]:
+    """Enable the cache iff ``MADSIM_COMPILE_CACHE`` is set (worker-
+    process entry hook). Returns the cache dir, or None if unset."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    return enable_compilation_cache(path)

@@ -454,16 +454,23 @@ class SweepResult:
     # the entry measured at some chunk < d — up to one superstep behind.
     # The measurement sequence itself is per-chunk and identical to the
     # serial loop's; entries are strictly increasing (tier-1-tested).
+    # The fused loop records the chunk index INSIDE the device program
+    # (a lane of the mega-dispatch history alongside the occupancy
+    # counts), so a K-chunk dispatch lands K correctly-indexed entries
+    # — no skew relative to the serial sequence even though the host
+    # only reads once per mega-dispatch (docs/perf.md "Whole-hunt
+    # residency", measurement-skew caveat).
     n_active_chunks: np.ndarray = dataclasses.field(
         default_factory=lambda: np.zeros(0, np.int64))
-    # Orchestration telemetry (docs/perf.md "Pipelined orchestration"):
-    # dispatch counts, superstep fan-in, and the host/device wall split
-    # of the chunk loop. Recorded into bench_results.json under
-    # configs.*.sweep_loop. Keys: pipelined, chunks, dispatches,
-    # chunks_per_dispatch, dispatches_per_seed, dispatch_depth,
-    # device_wait_s, host_decision_s, dispatch_s, retire_wait_s,
-    # scalar_fetches, retire_fetches, loop_wall_s, superstep_max,
-    # chunk_steps.
+    # Orchestration telemetry (docs/perf.md "Pipelined orchestration" /
+    # "Whole-hunt residency"): dispatch counts, superstep fan-in, and
+    # the host/device wall split of the chunk loop. Recorded into
+    # bench_results.json under configs.*.sweep_loop. Keys: pipelined,
+    # fused, chunks, dispatches, chunks_per_dispatch,
+    # dispatches_per_seed, seeds_per_dispatch, epochs_on_device,
+    # dispatch_depth, device_wait_s, host_decision_s, dispatch_s,
+    # retire_wait_s, scalar_fetches, retire_fetches, loop_wall_s,
+    # superstep_max, chunk_steps.
     loop_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Fault-schedule fingerprint (sha256 over the padded rows, or of
     # b"none"): rides the result so repro banners and bundles can assert
@@ -619,6 +626,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
           batch_worlds: Optional[int] = None,
           pipeline: bool = True,
           superstep_max: int = 16,
+          fused: bool = False,
           observe: Any = None,
           profile_dir: Optional[str] = None,
           profile_window: Tuple[int, int] = (0, 4),
@@ -658,6 +666,24 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     from supersteps dispatched before it are ignored (they ran zero
     chunks), so a stale trigger can never re-fire on the slots it just
     refilled.
+
+    ``fused`` (opt-in; docs/perf.md "Whole-hunt residency"): the
+    whole-hunt fused program. The occupancy loop itself — compaction,
+    retiring-tail harvest into per-seed device buffers, the coverage
+    fold, guided generation, refill and the seed cursor — moves inside
+    ONE ``lax.while_loop`` dispatch (:func:`_fused_hunt`), so the host
+    issues O(1) mega-dispatches per batch instead of one dispatch per
+    refill epoch. Mid-hunt host reads stay the sanctioned ``_fetch``
+    scalar batch (one per mega-dispatch); the retired observations are
+    pulled ONCE at the end. Results are bitwise identical to the
+    serial/pipelined loops (ids, observations, ``m_*`` metrics,
+    coverage ledger, lineage lanes, SearchReport — tier-1,
+    tests/test_fused.py); only ``world_utilization`` may differ, since
+    the fused tail skips the dry-cursor shrink (contract surfaces are
+    shrink-invariant — the shrink exists to save flops, which the fused
+    loop saves by not leaving the device instead). ``fused=True``
+    refuses ``checkpoint_path`` and ``compact`` (see the ValueErrors
+    below for the reasoning) and subsumes ``pipeline``.
 
     Preemption survival: with ``checkpoint_path`` set, the (padded) world
     state is written every ``checkpoint_every_chunks`` chunks (and at the
@@ -819,6 +845,22 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
 
     if superstep_max < 1:
         raise ValueError("superstep_max must be >= 1")
+
+    if fused and checkpoint_path is not None:
+        raise ValueError(
+            "fused=True cannot checkpoint: the whole-hunt program "
+            "retires and refills worlds inside one device dispatch, so "
+            "no host-visible boundary exists mid-hunt where state, "
+            "cursor, and retired observations are simultaneously "
+            "consistent for a snapshot — run the pipelined path "
+            "(fused=False) when checkpoint_path is set")
+    if fused and compact:
+        raise ValueError(
+            "fused=True has no shrink path: compact=True saves flops "
+            "by narrowing a mostly-frozen batch, but the fused loop "
+            "already avoids the host round trips that made the "
+            "straggler tail expensive, and every result surface is "
+            "shrink-invariant — drop compact (or run fused=False)")
 
     # Behavior-coverage ledger (obs/coverage.py): on exactly when the
     # engine carries the MetricsBlock — signatures are hashes of it.
@@ -1523,8 +1565,168 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                                                     for t in retired_lin]
         return aux
 
+    fused_epochs = 0                   # device refill epochs (fused path)
+    fused_k_bucket = 0                 # chunk window per mega-dispatch
+    fused_bufs = fused_sched_buf = fused_lin_buf = None
     try:
-        if pipeline:
+        if fused:
+            # -- whole-hunt fused orchestration (docs/perf.md
+            # "Whole-hunt residency"): the occupancy loop lives inside
+            # ONE device program; the host's job shrinks to issuing
+            # mega-dispatches and mirroring telemetry scalars. ---------
+            from ..obs.lineage import lanes_buffer
+
+            rep_sh = NamedSharding(mesh, scalar_spec())
+            n_ids_b = _pow2_at_least(n_ids)
+            fused_k_bucket = _pow2_at_least(max(min(c_max, _FUSED_K_CAP),
+                                                1))
+            # Replicated seed/fault tables the in-loop refill gathers
+            # from, bucketed to a power of two: every seed count in a
+            # bucket reuses ONE compiled program (the PR 3 zero-
+            # recompile contract extended to fused). Rows past n_ids
+            # are never gathered (the traced cursor clamps at the real
+            # count), so zero/repeat padding is inert.
+            lo = (seeds_p & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            hi = (seeds_p >> np.uint64(32)).astype(np.uint32)
+            if n_ids_b > n_ids:
+                pad = n_ids_b - n_ids
+                lo = np.concatenate([lo, np.zeros(pad, np.uint32)])
+                hi = np.concatenate([hi, np.zeros(pad, np.uint32)])
+            tabs = {"lo": jnp.asarray(lo), "hi": jnp.asarray(hi)}
+            if search_on:
+                fault_mode = "search"
+            elif faults_p is None:
+                fault_mode = "none"
+            elif per_world_faults:
+                fault_mode = "per_world"
+                ftab = faults_p
+                if n_ids_b > n_ids:
+                    ftab = np.concatenate(
+                        [ftab, ftab[:1].repeat(n_ids_b - n_ids, axis=0)],
+                        axis=0)
+                tabs["faults"] = jnp.asarray(ftab, jnp.int32)
+            else:
+                fault_mode = "shared"
+                tabs["faults"] = jnp.asarray(faults_p, jnp.int32)
+            tabs = jax.device_put(tabs, rep_sh)
+            # Per-seed observation buffers (+ one dump row for masked
+            # scatters): retiring rows land at retire time INSIDE the
+            # loop, live rows at each mega-dispatch boundary, and the
+            # host pulls the whole thing ONCE at the end. eval_shape
+            # keeps buffer setup compile-free.
+            obs_shapes = jax.eval_shape(eng.observe_device, state)
+            fused_bufs = jax.device_put(
+                {k: jnp.zeros((n_ids_b + 1,) + tuple(sh.shape[1:]),
+                              sh.dtype)
+                 for k, sh in obs_shapes.items()}, rep_sh)
+            if search_on:
+                sb = np.full((n_ids_b + 1, f_rows, 4), -1, np.int32)
+                sb[:, :, 1:] = 0       # canonical disabled-row padding
+                fused_sched_buf = jax.device_put(jnp.asarray(sb), rep_sh)
+            if lineage_on:
+                fused_lin_buf = jax.device_put(
+                    lanes_buffer(n_ids_b), rep_sh)
+            cursor_dev = jax.device_put(jnp.int32(cursor), rep_sh)
+            epochs_dev = jax.device_put(jnp.int32(0), rep_sh)
+            runner = _fused_hunt(
+                eng, mesh, search, w=w_cur, n_ids_b=n_ids_b,
+                f_rows=(f_rows if search_on else 0),
+                chunk_steps=chunk_steps, k_bucket=fused_k_bucket,
+                cov_k=(cov_k if cov_on else None),
+                lineage_on=lineage_on, fault_mode=fault_mode,
+                recycle=recycle)
+            stop = False
+            first = True
+            # "first" forces one dispatch even when max_steps <= 0: a
+            # zero-chunk pass still parks the live (init) observations
+            # in the buffers, mirroring the serial loop's final
+            # observe() of an unstepped batch.
+            while first or (chunks < c_max and not stop):
+                first = False
+                k = max(0, min(fused_k_bucket, c_max - chunks))
+                t0 = _clk()
+                prof.before_dispatch()
+                srch_in = ()
+                if search_on:
+                    srch_in = (slot_sched, corpus, fused_sched_buf)
+                    if lineage_on:
+                        srch_in += (slot_lin, op_tab, fused_lin_buf)
+                with prof.annotate("madsim:fused_hunt"):
+                    (state, idx, cursor_dev, epochs_dev, fused_bufs,
+                     cov_pair, srch_out, any_bug, n_active, k_done,
+                     hist, cov_h, stats_t) = runner(
+                        state, idx, cursor_dev, epochs_dev, fused_bufs,
+                        ((cov_hits, cov_first) if cov_on else ()),
+                        srch_in, tabs, jnp.int32(n_ids), jnp.int32(n),
+                        jnp.int32(search_lin_base),
+                        jnp.asarray(bool(stop_on_first_bug)),
+                        jnp.int32(k))
+                perf["dispatch_s"] += _clk() - t0
+                perf["dispatches"] += 1
+                if cov_on:
+                    cov_hits, cov_first = cov_pair
+                if search_on:
+                    slot_sched, corpus, fused_sched_buf = srch_out[:3]
+                    if lineage_on:
+                        slot_lin, op_tab, fused_lin_buf = srch_out[3:]
+                t0 = _clk()
+                # ONE scalar batch per mega-dispatch — the sanctioned
+                # mid-hunt read (occupancy telemetry, novelty lane,
+                # cursor/epoch mirrors, stop_on_first_bug).
+                (bug_h, n_act_h, k_done_h, hist_h, cur_h, ep_h, cov_np,
+                 stats_h) = _fetch(
+                    (any_bug, n_active, k_done, hist, cursor_dev,
+                     epochs_dev, cov_h if cov_on else None,
+                     stats_t if search_on else None))
+                perf["device_wait_s"] += _clk() - t0
+                perf["scalar_fetches"] += 1
+                prof.after_read()
+                t0 = _clk()
+                k_done = int(k_done_h)
+                n_act = int(n_act_h)
+                hist_np = np.asarray(hist_h)
+                cov_arr = np.asarray(cov_np) if cov_on else None
+                for j in range(k_done):
+                    n_active_hist.append(int(hist_np[j]))
+                    n_active_chunk.append(chunks + j)
+                    if cov_on:
+                        novelty_hist.append(int(cov_arr[j]))
+                chunks += k_done
+                steps = chunks * chunk_steps
+                issued_slot_steps += w_cur * chunk_steps * k_done
+                cursor = int(cur_h)
+                if search_on and int(ep_h) > fused_epochs:
+                    # Host mirrors of the corpus telemetry, refreshed
+                    # from the LAST device refill's stats — once per
+                    # mega-dispatch rather than once per refill (the
+                    # per-refill cadence lives on device now; see
+                    # docs/observability.md). The operator table is NOT
+                    # pulled mid-hunt — its record rows fold at the end.
+                    search_host["corpus_size"] = int(stats_h[0])
+                    search_host["inserted"] = int(stats_h[1])
+                    if lineage_on:
+                        search_host["gen"] = int(stats_h[2])
+                        search_host["refill_novel"] = int(stats_h[3])
+                        search_host["refill_inserted"] = int(stats_h[4])
+                    emit_search_point(None)
+                if int(ep_h) > 0:
+                    reordered = True
+                fused_epochs = int(ep_h)
+                more_seeds = cursor < n_ids
+                if (n_act == 0 and not more_seeds) or \
+                        (stop_on_first_bug and bool(bug_h)):
+                    stop = True
+                elif k_done < k:
+                    # The device loop exits early only on its stop
+                    # predicate; a short count means the predicate
+                    # fired on-device — mirror it (the scalars above
+                    # necessarily agree, but int rounding of a pulled
+                    # bool keeps this branch as the belt to their
+                    # suspenders).
+                    stop = True
+                perf["host_decision_s"] += _clk() - t0
+                emit_point(n_act, bool(bug_h), 0)
+        elif pipeline:
             # -- pipelined, superstepped orchestration ---------------------
             k_cur = 1                  # adaptive superstep size (chunks)
             epoch = 0                  # bumps on every refill/shrink
@@ -1788,72 +1990,100 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
         cov_hits, cov_first = _cov_endfolder(eng, mesh)(
             state, cov_hits, cov_first, idx, n_real_dev, jnp.asarray(True))
 
-    obs_live = eng.observe(state)
     sched_live_h = corpus_h = lin_live_h = op_tab_h = None
-    if cov_on and search_on:
-        # Search state rides the final ledger pull — still ONE _fetch.
-        (idx_h, cov_hits_h, cov_first_h, sched_live_h, corpus_h,
-         lin_live_h, op_tab_h) = _fetch(
-            (idx, cov_hits, cov_first, slot_sched, corpus, slot_lin,
-             op_tab))
-        idx_h, cov_hits_h, cov_first_h = (
-            np.asarray(x) for x in (idx_h, cov_hits_h, cov_first_h))
-        sched_live_h = np.asarray(sched_live_h, np.int32)
-        if lin_live_h is not None:
-            lin_live_h = tuple(np.asarray(a) for a in lin_live_h)
-    elif cov_on:
-        # The ledger rides the final slot-index pull — still ONE _fetch.
-        idx_h, cov_hits_h, cov_first_h = (
-            np.asarray(x) for x in _fetch((idx, cov_hits, cov_first)))
-    else:
-        idx_h = np.asarray(_fetch(idx))
-    live_keep = idx_h >= 0
-    live_world_steps += int(np.asarray(obs_live["steps"])[live_keep].sum())
-    # Scatter whenever the live batch does not cover the full id space in
-    # seed order — after any reorder/retirement, OR when a recycled sweep
-    # exited (stop_on_first_bug / max_steps) before its first refill, so
-    # only the first w0 < n_ids seeds were ever admitted.
     sched_per_seed = lin_per_seed = None
-    if reordered or retired_rows or w0 < n_ids:
-        rows = np.concatenate(retired_rows + [idx_h[live_keep]])
-        obs = {}
-        for k, v_live in obs_live.items():
-            v_live = np.asarray(v_live)[live_keep]
-            merged = np.concatenate(retired.get(k, []) + [v_live], axis=0)
-            # Zeros, not empty: an early stop (stop_on_first_bug) can
-            # leave streamed seeds never admitted — they report zeroed
-            # observations (bug=False) rather than garbage.
-            out = np.zeros((n_ids,) + merged.shape[1:], merged.dtype)
-            out[rows] = merged
-            obs[k] = out
+    if fused:
+        # Fused final read: retired AND live observations already sit in
+        # the per-seed device buffers (retiring rows landed inside the
+        # loop, live rows at the last mega-dispatch boundary), so the
+        # whole result crosses in ONE pull — the "pulled once at the
+        # end" half of the fused contract. Everything below is host
+        # slicing of bucket padding.
+        t0 = _clk()
+        (bufs_h, cov_pack_h, sched_b_h, corpus_h, lin_b_h,
+         op_tab_h) = _fetch(
+            (fused_bufs, (cov_hits, cov_first) if cov_on else None,
+             fused_sched_buf, corpus, fused_lin_buf, op_tab))
+        perf["retire_wait_s"] += _clk() - t0
+        perf["retire_fetches"] += 1
+        if cov_on:
+            cov_hits_h, cov_first_h = (np.asarray(x) for x in cov_pack_h)
+        obs = {k: np.asarray(v)[:n_ids] for k, v in bufs_h.items()}
+        live_world_steps += int(np.asarray(obs["steps"]).sum())
         if search_on:
-            merged_s = np.concatenate(
-                retired_sched + [sched_live_h[live_keep]], axis=0)
-            sched_out = np.full((n_ids,) + merged_s.shape[1:], -1,
-                                np.int32)
-            sched_out[:, :, 1:] = 0  # canonical DISABLED_ROW padding
-            sched_out[rows] = merged_s
-            sched_per_seed = sched_out
-        if lin_live_h is not None:
-            # Per-seed lineage lanes scatter exactly like the
-            # schedules; never-admitted seeds read as generation 0
-            # (-1 parents, no operators, depth 0).
-            lanes_out = []
-            for i, dflt in enumerate((-1, -1, 0, 0)):
-                merged_l = np.concatenate(
-                    [t[i] for t in retired_lin]
-                    + [lin_live_h[i][live_keep]], axis=0)
-                out = np.full((n_ids,), dflt, np.int32)
-                out[rows] = np.asarray(merged_l, np.int32)
-                lanes_out.append(out)
-            lin_per_seed = tuple(lanes_out)
+            sched_per_seed = np.asarray(sched_b_h, np.int32)[:n_ids]
+        if lineage_on:
+            lin_per_seed = tuple(np.asarray(a, np.int32)[:n_ids]
+                                 for a in lin_b_h)
     else:
-        obs = obs_live
-        if search_on:
-            sched_per_seed = sched_live_h
-        if lin_live_h is not None:
-            lin_per_seed = tuple(np.asarray(a, np.int32)
-                                 for a in lin_live_h)
+        obs_live = eng.observe(state)
+        if cov_on and search_on:
+            # Search state rides the final ledger pull — still ONE _fetch.
+            (idx_h, cov_hits_h, cov_first_h, sched_live_h, corpus_h,
+             lin_live_h, op_tab_h) = _fetch(
+                (idx, cov_hits, cov_first, slot_sched, corpus, slot_lin,
+                 op_tab))
+            idx_h, cov_hits_h, cov_first_h = (
+                np.asarray(x) for x in (idx_h, cov_hits_h, cov_first_h))
+            sched_live_h = np.asarray(sched_live_h, np.int32)
+            if lin_live_h is not None:
+                lin_live_h = tuple(np.asarray(a) for a in lin_live_h)
+        elif cov_on:
+            # The ledger rides the final slot-index pull — still ONE
+            # _fetch.
+            idx_h, cov_hits_h, cov_first_h = (
+                np.asarray(x) for x in _fetch((idx, cov_hits, cov_first)))
+        else:
+            idx_h = np.asarray(_fetch(idx))
+        live_keep = idx_h >= 0
+        live_world_steps += int(
+            np.asarray(obs_live["steps"])[live_keep].sum())
+        # Scatter whenever the live batch does not cover the full id
+        # space in seed order — after any reorder/retirement, OR when a
+        # recycled sweep exited (stop_on_first_bug / max_steps) before
+        # its first refill, so only the first w0 < n_ids seeds were
+        # ever admitted.
+        if reordered or retired_rows or w0 < n_ids:
+            rows = np.concatenate(retired_rows + [idx_h[live_keep]])
+            obs = {}
+            for k, v_live in obs_live.items():
+                v_live = np.asarray(v_live)[live_keep]
+                merged = np.concatenate(retired.get(k, []) + [v_live],
+                                        axis=0)
+                # Zeros, not empty: an early stop (stop_on_first_bug)
+                # can leave streamed seeds never admitted — they report
+                # zeroed observations (bug=False) rather than garbage.
+                out = np.zeros((n_ids,) + merged.shape[1:], merged.dtype)
+                out[rows] = merged
+                obs[k] = out
+            if search_on:
+                merged_s = np.concatenate(
+                    retired_sched + [sched_live_h[live_keep]], axis=0)
+                sched_out = np.full((n_ids,) + merged_s.shape[1:], -1,
+                                    np.int32)
+                sched_out[:, :, 1:] = 0  # canonical DISABLED_ROW padding
+                sched_out[rows] = merged_s
+                sched_per_seed = sched_out
+            if lin_live_h is not None:
+                # Per-seed lineage lanes scatter exactly like the
+                # schedules; never-admitted seeds read as generation 0
+                # (-1 parents, no operators, depth 0).
+                lanes_out = []
+                for i, dflt in enumerate((-1, -1, 0, 0)):
+                    merged_l = np.concatenate(
+                        [t[i] for t in retired_lin]
+                        + [lin_live_h[i][live_keep]], axis=0)
+                    out = np.full((n_ids,), dflt, np.int32)
+                    out[rows] = np.asarray(merged_l, np.int32)
+                    lanes_out.append(out)
+                lin_per_seed = tuple(lanes_out)
+        else:
+            obs = obs_live
+            if search_on:
+                sched_per_seed = sched_live_h
+            if lin_live_h is not None:
+                lin_per_seed = tuple(np.asarray(a, np.int32)
+                                     for a in lin_live_h)
     obs = {k: v[:n] for k, v in obs.items()}
     if sched_per_seed is not None:
         sched_per_seed = sched_per_seed[:n]
@@ -1862,8 +2092,10 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
     util = (live_world_steps / issued_slot_steps if issued_slot_steps
             else 0.0)
     loop_stats = {
-        "pipelined": bool(pipeline),
-        "superstep_max": int(superstep_max) if pipeline else 1,
+        "pipelined": bool(pipeline) and not fused,
+        "fused": bool(fused),
+        "superstep_max": (int(fused_k_bucket) if fused
+                          else int(superstep_max) if pipeline else 1),
         "chunk_steps": int(chunk_steps),
         "chunks": int(chunks),
         "dispatches": int(perf["dispatches"]),
@@ -1871,6 +2103,14 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             chunks / max(perf["dispatches"], 1), 3),
         "dispatches_per_seed": round(
             perf["dispatches"] / max(n, 1), 6),
+        # The fused headline (and its reciprocal): how many seeds one
+        # host dispatch retires end to end. epochs_on_device counts the
+        # refill epochs that ran INSIDE fused mega-dispatches (0 on the
+        # host-orchestrated paths, where every epoch is its own
+        # dispatch).
+        "seeds_per_dispatch": round(
+            n / max(perf["dispatches"], 1), 3),
+        "epochs_on_device": int(fused_epochs),
         "dispatch_depth": int(perf["dispatch_depth"]),
         "device_wait_s": round(perf["device_wait_s"], 6),
         "host_decision_s": round(perf["host_decision_s"], 6),
@@ -1947,12 +2187,21 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                                                   mesh=mesh))
     if emit_telemetry is not None:
         final = {
-            "schema": "madsim.sweep.telemetry/1",
+            # /2: seeds_per_dispatch + epochs_on_device surfaced top-
+            # level (additive — docs/observability.md "Schema history").
+            "schema": "madsim.sweep.telemetry/2",
             "event": "summary",
             "elapsed_s": loop_stats["loop_wall_s"],
             "seeds_total": int(n),
             "failing_seeds": len(result.failing_seeds),
             "world_utilization": round(util, 4),
+            # Dispatch economics, surfaced TOP-LEVEL (schema /2 —
+            # docs/observability.md): the Prometheus renderer exports
+            # only top-level numerics, and these two are the fused
+            # path's headline gauges. Duplicated from loop_stats, where
+            # the full breakdown still lives.
+            "seeds_per_dispatch": loop_stats["seeds_per_dispatch"],
+            "epochs_on_device": loop_stats["epochs_on_device"],
             "loop_stats": loop_stats,
         }
         if coverage is not None:
@@ -2110,6 +2359,245 @@ def _observer(eng: DeviceEngine):
     if fn is None:
         fn = jax.jit(lambda s, i: (eng.observe_device(s), i))
         eng.__dict__["_observer_fn"] = fn
+    return fn
+
+
+# Ceiling on the fused program's static per-dispatch chunk window (the
+# hist-buffer width): every realistic hunt fits one mega-dispatch, and a
+# ludicrous max_steps re-dispatches instead of compiling a huge history
+# buffer. 4096 i32 entries = 16 KiB per lane — noise next to the state.
+_FUSED_K_CAP = 4096
+
+
+def _fused_hunt(eng: DeviceEngine, mesh: Mesh, scfg, *, w: int,
+                n_ids_b: int, f_rows: int, chunk_steps: int,
+                k_bucket: int, cov_k: Optional[int], lineage_on: bool,
+                fault_mode: str, recycle: bool):
+    """Compile (and cache per engine) the whole-hunt fused program.
+
+    One plain-``jit`` dispatch runs the ENTIRE occupancy loop the serial
+    sweep ran on host: chunk bodies under
+    ``DeviceEngine._fused_superstep_impl``, and — inside the same
+    ``lax.while_loop``, behind a ``lax.cond`` epoch trigger — the stable
+    active-first compaction (the ``_compactor`` permutation), the
+    retiring-tail scatter into per-seed observation buffers, the
+    coverage fold, the guided harvest+generate
+    (``search.generate_body``, the SAME callable the ``searcher``
+    program jits), the in-loop refill (``DeviceEngine.refill_traced``)
+    and the device-resident seed-cursor advance. Like ``_compactor``
+    this is a plain ``jax.jit`` with mesh-pinned ``out_shardings`` (the
+    global stable argsort cannot live under ``shard_map``); GSPMD
+    partitions the loop body, and integer full-axis reductions equal
+    the shard_mapped psums bitwise.
+
+    Bit-exactness contract (tier-1: tests/test_fused.py): chunk bodies,
+    the permutation, the harvest mask/order, the mutation streams and
+    the refill init are all the exact programs/callables of the serial
+    path evaluated on equal values, so ids, observations, m_* metrics,
+    the coverage ledger, lineage lanes and the SearchReport are bitwise
+    identical to ``fused=False``. The ONLY deliberate divergence is the
+    dry-cursor shrink: contract surfaces are shrink-invariant, so the
+    fused tail just runs at full width (``world_utilization`` is
+    telemetry and may differ — docs/perf.md "Whole-hunt residency").
+
+    Static geometry: ``w`` slots, ``n_ids_b`` power-of-two-bucketed
+    seed-id space (+1 dump row on every per-seed buffer), ``k_bucket``
+    history width per mega-dispatch. The real ``n_ids``/``n`` ride as
+    traced scalars, so every seed count in a bucket reuses ONE compiled
+    program (the PR 3 zero-recompile contract extended to fused).
+    ``fault_mode``: ``search`` (children), ``per_world`` (gather the
+    replicated table), ``shared`` (broadcast the template) or ``none``.
+    """
+    cache = eng.__dict__.setdefault("_fused_hunt_cache", {})
+    key = (mesh, w, n_ids_b, f_rows, chunk_steps, k_bucket, cov_k,
+           scfg, lineage_on, fault_mode, recycle)
+    if key in cache:
+        return cache[key]
+
+    from ..obs.coverage import distinct_count, fold_retired_local
+
+    search_on = scfg is not None
+    cov_on = cov_k is not None
+    if search_on:
+        from ..search.generate import generate_body, generate_body_lineage
+
+        gen_fn = (generate_body_lineage(eng.cfg, scfg, w) if lineage_on
+                  else generate_body(eng.cfg, scfg, w))
+
+    rep = NamedSharding(mesh, scalar_spec())
+    ws = world_sharding(mesh)
+    dump = jnp.int32(n_ids_b)         # trailing dump row of every buffer
+    rows_r = jnp.arange(w, dtype=jnp.int32)
+
+    def refill_epoch(s, ex, n_act, tabs, n_ids_real, lin_base):
+        # (1) Stable active-first compaction — the _compactor program's
+        # exact permutation, applied to the state, the slot→seed index
+        # and (guided) the schedule/lane arrays in lockstep.
+        order = jnp.argsort((~s.active).astype(jnp.int32), stable=True)
+        perm = (s, ex["idx"])
+        if search_on:
+            perm = perm + (ex["sched"],)
+        if lineage_on:
+            perm = perm + (ex["lin"],)
+        perm = jax.tree.map(lambda x: x[order], perm)
+        s, idx = perm[0], perm[1]
+        sched = perm[2] if search_on else None
+        lin = perm[3] if lineage_on else None
+        # (2) Retiring-tail harvest: scatter the frozen rows' final
+        # observations by slot→seed idx into the per-seed buffers (the
+        # serial loop's retire() attribution, kept on device). Dead
+        # slots (idx < 0, dry-cursor leftovers already harvested) land
+        # on the dump row.
+        tail = (rows_r >= n_act) & (idx >= 0)
+        tgt = jnp.where(tail, idx, dump)
+        obs = eng.observe_device(s)
+        ex = dict(ex, idx=idx)
+        ex["bufs"] = {k: ex["bufs"][k].at[tgt].set(obs[k])
+                      for k in ex["bufs"]}
+        # (3) Admit the next seeds from the device-resident cursor —
+        # the same take/repl/mask arithmetic do_refill ran on host.
+        take = jnp.minimum(jnp.int32(w) - n_act,
+                           n_ids_real - ex["cursor"])
+        fill = (rows_r >= n_act) & (rows_r < n_act + take)
+        repl = jnp.where(fill, ex["cursor"] + rows_r - n_act,
+                         jnp.int32(-1))
+        fill_ids = jnp.maximum(repl, 0)
+        if search_on:
+            # Park the retiring schedules (and provenance lanes) BEFORE
+            # the children overwrite them — the pre-refill read order of
+            # the serial _sched_tail gather.
+            ex["sched_buf"] = ex["sched_buf"].at[tgt].set(sched)
+            if lineage_on:
+                ex["lin_buf"] = jax.tree.map(
+                    lambda b, v: b.at[tgt].set(v), ex["lin_buf"], lin)
+                (children, child_lin, ex["corpus"], ex["op_tab"],
+                 ex["stats"]) = gen_fn(
+                    s, sched, idx, ex["corpus"], n_act, fill_ids, fill,
+                    lin, ex["op_tab"], lin_base)
+                ex["lin"] = jax.tree.map(
+                    lambda c, o: jnp.where(fill, c, o), child_lin, lin)
+            else:
+                children, ex["corpus"], ex["stats"] = gen_fn(
+                    s, sched, idx, ex["corpus"], n_act, fill_ids)
+            f_new = children
+            ex["sched"] = jnp.where(fill[:, None, None], children, sched)
+        elif fault_mode == "per_world":
+            f_new = tabs["faults"][fill_ids]
+        elif fault_mode == "shared":
+            f_new = jnp.broadcast_to(tabs["faults"],
+                                     (w,) + tabs["faults"].shape)
+        else:
+            f_new = jnp.zeros((w, 0, 4), jnp.int32)
+        # (4) Re-key the refilled slots: the traced twin of
+        # DeviceEngine.refill (same vmapped _init_one, same select).
+        s = eng.refill_traced(s, fill, tabs["lo"][fill_ids],
+                              tabs["hi"][fill_ids], f_new)
+        ex["idx"] = jnp.where(rows_r >= n_act, repl, idx)
+        ex["cursor"] = ex["cursor"] + take
+        ex["epochs"] = ex["epochs"] + jnp.int32(1)
+        return s, ex
+
+    def run(state, idx, cursor, epochs, bufs, cov, srch, tabs,
+            n_ids_real, n_real, lin_base, stop_on_bug, k_chunks):
+        n_ids_real = jnp.asarray(n_ids_real, jnp.int32)
+        n_real = jnp.asarray(n_real, jnp.int32)
+        lin_base = jnp.asarray(lin_base, jnp.int32)
+        stop_on_bug = jnp.asarray(stop_on_bug, bool)
+
+        ex = {"idx": idx, "cursor": jnp.asarray(cursor, jnp.int32),
+              "epochs": jnp.asarray(epochs, jnp.int32), "bufs": bufs}
+        if cov_on:
+            ex["cov"] = cov
+            ex["cov_hist"] = jnp.full((k_bucket,), -1, jnp.int32)
+        if search_on:
+            ex["sched"], ex["corpus"], ex["sched_buf"] = srch[:3]
+            if lineage_on:
+                ex["lin"], ex["op_tab"], ex["lin_buf"] = srch[3:]
+            ex["stats"] = tuple(jnp.int32(0)
+                                for _ in range(5 if lineage_on else 2))
+
+        def more_seeds(cursor):
+            if not recycle:
+                return jnp.asarray(False)
+            return cursor < n_ids_real
+
+        def entry_stop(ex, any_bug0, n_active0):
+            # The pass-through property: a dispatch against a finished
+            # hunt runs zero chunks, like the plain superstep's.
+            return ((stop_on_bug & any_bug0)
+                    | ((n_active0 == 0) & ~more_seeds(ex["cursor"])))
+
+        def post_chunk(s, ex, act0, any_bug, n_active, i):
+            if cov_on:
+                hits, first = ex["cov"]
+                fmask = (act0 & ~s.active & (ex["idx"] >= 0)
+                         & (ex["idx"] < n_real))
+                hits, first = fold_retired_local(hits, first, s.metrics,
+                                                 fmask, ex["idx"])
+                ex = dict(ex, cov=(hits, first))
+                ex["cov_hist"] = jax.lax.dynamic_update_index_in_dim(
+                    ex["cov_hist"], distinct_count(hits), i, 0)
+            # The serial loop's exact decision order: hunt-over checks
+            # first (a bug under stop_on_bug, or nothing active with a
+            # dry cursor), THEN the refill trigger — a stop never
+            # refills, a refill always runs one chunk before the next
+            # evaluation (the body re-enters through the chunk).
+            more = more_seeds(ex["cursor"])
+            stop = ((n_active == 0) & ~more) | (stop_on_bug & any_bug)
+            if recycle:
+                trigger = ((~stop) & more
+                           & (n_active <= jnp.int32(w // 2)))
+                s, ex = jax.lax.cond(
+                    trigger,
+                    lambda op: refill_epoch(op[0], op[1], n_active, tabs,
+                                            n_ids_real, lin_base),
+                    lambda op: op,
+                    (s, ex))
+            return s, ex, stop
+
+        state, ex, any_bug, n_active, k_done, hist = \
+            eng._fused_superstep_impl(
+                state, ex, stop_on_bug, k_chunks,
+                chunk_steps=chunk_steps, k_max=k_bucket,
+                post_chunk=post_chunk, entry_stop=entry_stop)
+
+        # End-of-dispatch: park the LIVE slots' rows (never-retired and
+        # dry-tail worlds alike) so the host's single end-of-hunt pull
+        # is one buffer slice. Later dispatches overwrite with newer
+        # values; retire-time scatters of refilled slots already moved
+        # their idx, so no double attribution is possible.
+        live_tgt = jnp.where(ex["idx"] >= 0, ex["idx"], dump)
+        obs = eng.observe_device(state)
+        bufs = {k: ex["bufs"][k].at[live_tgt].set(obs[k])
+                for k in ex["bufs"]}
+        cov_out = ex["cov"] if cov_on else ()
+        ch_out = ex["cov_hist"] if cov_on else ()
+        srch_out = ()
+        stats_out = ex["stats"] if search_on else ()
+        if search_on:
+            sched_buf = ex["sched_buf"].at[live_tgt].set(ex["sched"])
+            srch_out = (ex["sched"], ex["corpus"], sched_buf)
+            if lineage_on:
+                lin_buf = jax.tree.map(
+                    lambda b, v: b.at[live_tgt].set(v), ex["lin_buf"],
+                    ex["lin"])
+                srch_out = srch_out + (ex["lin"], ex["op_tab"], lin_buf)
+        return (state, ex["idx"], ex["cursor"], ex["epochs"], bufs,
+                cov_out, srch_out, any_bug, n_active, k_done, hist,
+                ch_out, stats_out)
+
+    cov_sh = (rep, rep) if cov_on else ()
+    srch_sh = ()
+    stats_sh = ()
+    if search_on:
+        srch_sh = (ws, rep, rep)
+        stats_sh = (rep,) * (5 if lineage_on else 2)
+        if lineage_on:
+            srch_sh = srch_sh + (ws, rep, rep)
+    out_sh = (ws, ws, rep, rep, rep, cov_sh, srch_sh,
+              rep, rep, rep, rep, (rep if cov_on else ()), stats_sh)
+    fn = jax.jit(run, out_shardings=out_sh)
+    cache[key] = fn
     return fn
 
 

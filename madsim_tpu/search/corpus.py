@@ -111,6 +111,23 @@ def novelty(sig: jnp.ndarray, corpus: CorpusState) -> jnp.ndarray:
     return jnp.min(d)
 
 
+def retiring_mask(w: int, n_act, idx: jnp.ndarray,
+                  active: jnp.ndarray) -> jnp.ndarray:
+    """The harvest-fold mask over a post-compaction batch: True on the
+    retiring tail — rows past the live count ``n_act`` that still map to
+    a real seed (``idx >= 0``; dead padding slots from a dry cursor stay
+    excluded) and whose world actually finished (``~active``; frozen
+    tails a shrink parked are not re-harvested).
+
+    One definition shared by the jitted :func:`~madsim_tpu.search.generate.searcher`
+    program and the fused whole-hunt superstep's in-loop epoch branch
+    (parallel/sweep.py) — the fold *population* is half the bitwise
+    contract between the two paths, so it must not be duplicated.
+    """
+    rows_r = jnp.arange(w, dtype=jnp.int32)
+    return (rows_r >= n_act) & (idx >= 0) & ~active
+
+
 def harvest_fold(corpus: CorpusState, sched: jnp.ndarray,
                  sigs: jnp.ndarray, fold_mask: jnp.ndarray,
                  min_novelty: int, entries: jnp.ndarray = None,

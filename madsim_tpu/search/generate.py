@@ -39,8 +39,77 @@ from ..obs.coverage import behavior_signature
 from ..obs.lineage import LineageLanes, OperatorTable, credit, ops_bits
 from ..parallel.mesh import scalar_spec, world_sharding
 from .config import SearchConfig
-from .corpus import CorpusState, harvest_fold
+from .corpus import CorpusState, harvest_fold, retiring_mask
 from .mutate import make_children
+
+
+def generate_body(eng_cfg, scfg: SearchConfig, w: int):
+    """The harvest+generate fold as a plain traced callable.
+
+    This is the body :func:`searcher` jits — exposed un-jitted so the
+    fused whole-hunt superstep (parallel/sweep.py) can inline the exact
+    same fold inside its ``lax.while_loop`` epoch branch: one
+    definition, two call sites, bitwise-identical corpus decisions and
+    children by construction. Signature matches the ``scfg.lineage=
+    False`` searcher: ``(state, sched, idx, corpus, n_act, new_ids) ->
+    (children, corpus', (n_filled, n_inserted))``.
+    """
+    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids):
+        if scfg.guided:
+            sigs = behavior_signature(state.metrics)          # (W,) u32
+            hmask = retiring_mask(w, n_act, idx, state.active)
+            corpus, _ = harvest_fold(corpus, sched, sigs, hmask,
+                                     scfg.min_novelty)
+        gen1 = corpus.gen + jnp.int32(1)
+        children = make_children(scfg, eng_cfg, corpus, new_ids, gen1)
+        corpus = corpus._replace(gen=gen1)
+        n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
+        return children, corpus, (n_filled, corpus.inserted)
+
+    return run
+
+
+def generate_body_lineage(eng_cfg, scfg: SearchConfig, w: int):
+    """:func:`generate_body` with provenance lanes (``scfg.lineage``).
+
+    The un-jitted twin of the lineage-on searcher program, shared with
+    the fused superstep's epoch branch. Signature: ``(state, sched,
+    idx, corpus, n_act, new_ids, fill_mask, lin, op_tab, lin_base) ->
+    (children, child_lin, corpus', op_tab', stats)``.
+    """
+    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids,
+            fill_mask, lin: LineageLanes, op_tab: OperatorTable,
+            lin_base):
+        n_ins = jnp.int32(0)
+        nov_m = jnp.zeros((w,), bool)
+        if scfg.guided:
+            sigs = behavior_signature(state.metrics)          # (W,) u32
+            hmask = retiring_mask(w, n_act, idx, state.active)
+            obits = ops_bits(lin.ops)            # (W, N_OPS) bool
+            # Lineage entry id of a retiring world: its (base-offset)
+            # seed position + 1 — globally unique across fleet ranges
+            # by construction (obs/lineage.py).
+            entries = jnp.where(idx >= 0, lin_base + idx + jnp.int32(1),
+                                jnp.int32(-1))
+            corpus, n_ins, nov_m, ins_m = harvest_fold(
+                corpus, sched, sigs, hmask, scfg.min_novelty,
+                entries=entries, depths=lin.depth, with_masks=True)
+            op_tab = op_tab._replace(
+                novel=credit(op_tab.novel, obits, nov_m),
+                survived=credit(op_tab.survived, obits, ins_m))
+        gen1 = corpus.gen + jnp.int32(1)
+        children, child_lin = make_children(scfg, eng_cfg, corpus,
+                                            new_ids, gen1, lineage=True)
+        op_tab = op_tab._replace(
+            produced=credit(op_tab.produced, ops_bits(child_lin.ops),
+                            fill_mask))
+        corpus = corpus._replace(gen=gen1)
+        n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
+        stats = (n_filled, corpus.inserted, corpus.gen,
+                 jnp.sum(nov_m, dtype=jnp.int32), n_ins)
+        return children, child_lin, corpus, op_tab, stats
+
+    return run
 
 
 def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
@@ -84,61 +153,16 @@ def searcher(eng, mesh, scfg: SearchConfig, w: int, f_rows: int):
                             gen=rep, inserted=rep, entry=rep, depth=rep)
 
     if not scfg.lineage:
-        def run(state, sched, idx, corpus: CorpusState, n_act, new_ids):
-            if scfg.guided:
-                sigs = behavior_signature(state.metrics)      # (W,) u32
-                rows_r = jnp.arange(w, dtype=jnp.int32)
-                hmask = (rows_r >= n_act) & (idx >= 0) & ~state.active
-                corpus, _ = harvest_fold(corpus, sched, sigs, hmask,
-                                         scfg.min_novelty)
-            gen1 = corpus.gen + jnp.int32(1)
-            children = make_children(scfg, eng.cfg, corpus, new_ids, gen1)
-            corpus = corpus._replace(gen=gen1)
-            n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
-            return children, corpus, (n_filled, corpus.inserted)
-
         out_sh = (ws, corpus_sh, (rep, rep))
-        fn = jax.jit(run, out_shardings=out_sh)
+        fn = jax.jit(generate_body(eng.cfg, scfg, w), out_shardings=out_sh)
         cache[key] = fn
         return fn
-
-    def run(state, sched, idx, corpus: CorpusState, n_act, new_ids,
-            fill_mask, lin: LineageLanes, op_tab: OperatorTable,
-            lin_base):
-        n_ins = jnp.int32(0)
-        nov_m = jnp.zeros((w,), bool)
-        if scfg.guided:
-            sigs = behavior_signature(state.metrics)          # (W,) u32
-            rows_r = jnp.arange(w, dtype=jnp.int32)
-            hmask = (rows_r >= n_act) & (idx >= 0) & ~state.active
-            obits = ops_bits(lin.ops)            # (W, N_OPS) bool
-            # Lineage entry id of a retiring world: its (base-offset)
-            # seed position + 1 — globally unique across fleet ranges
-            # by construction (obs/lineage.py).
-            entries = jnp.where(idx >= 0, lin_base + idx + jnp.int32(1),
-                                jnp.int32(-1))
-            corpus, n_ins, nov_m, ins_m = harvest_fold(
-                corpus, sched, sigs, hmask, scfg.min_novelty,
-                entries=entries, depths=lin.depth, with_masks=True)
-            op_tab = op_tab._replace(
-                novel=credit(op_tab.novel, obits, nov_m),
-                survived=credit(op_tab.survived, obits, ins_m))
-        gen1 = corpus.gen + jnp.int32(1)
-        children, child_lin = make_children(scfg, eng.cfg, corpus,
-                                            new_ids, gen1, lineage=True)
-        op_tab = op_tab._replace(
-            produced=credit(op_tab.produced, ops_bits(child_lin.ops),
-                            fill_mask))
-        corpus = corpus._replace(gen=gen1)
-        n_filled = jnp.sum(corpus.filled, dtype=jnp.int32)
-        stats = (n_filled, corpus.inserted, corpus.gen,
-                 jnp.sum(nov_m, dtype=jnp.int32), n_ins)
-        return children, child_lin, corpus, op_tab, stats
 
     out_sh = (ws, LineageLanes(p1=ws, p2=ws, ops=ws, depth=ws),
               corpus_sh,
               OperatorTable(produced=rep, novel=rep, survived=rep),
               (rep, rep, rep, rep, rep))
-    fn = jax.jit(run, out_shardings=out_sh)
+    fn = jax.jit(generate_body_lineage(eng.cfg, scfg, w),
+                 out_shardings=out_sh)
     cache[key] = fn
     return fn

@@ -1,0 +1,111 @@
+"""Persistent compilation cache across cold processes.
+
+A fleet of spawned workers (fleet/process.py) builds N identical
+engines in N fresh JAX runtimes; without the on-disk cache each pays
+the full XLA compile of the same sweep program. The contract under
+test: with ``MADSIM_COMPILE_CACHE`` set, the FIRST cold process
+populates the cache, a SECOND cold process loads instead of compiling
+(counted via the persistent-cache hit log line), and the cached run's
+results are bitwise identical to the fresh run's.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_CHILD = r"""
+import json, logging, os, sys
+import numpy as np
+
+records = []
+
+class _Cap(logging.Handler):
+    def emit(self, record):
+        records.append(record.getMessage())
+
+from madsim_tpu.parallel.compile_cache import enable_from_env
+
+assert enable_from_env() == os.environ["MADSIM_COMPILE_CACHE"]
+
+# The persistent-cache layer logs hits/misses under jax's logger tree.
+h = _Cap(level=logging.DEBUG)
+for name in ("jax", "jax._src.compiler",
+             "jax._src.compilation_cache"):
+    lg = logging.getLogger(name)
+    lg.setLevel(logging.DEBUG)
+    lg.addHandler(h)
+
+from madsim_tpu.engine import (DeviceEngine, EngineConfig, RaftActor,
+                               RaftDeviceConfig)
+from madsim_tpu.parallel.sweep import sweep
+
+cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                   t_limit_us=1_500_000, stop_on_bug=True)
+eng = DeviceEngine(RaftActor(RaftDeviceConfig(n=3, buggy_double_vote=True)),
+                   cfg)
+res = sweep(None, cfg, np.arange(32), engine=eng, chunk_steps=64,
+            max_steps=4_000)
+hits = sum("persistent compilation cache hit" in m.lower()
+           for m in records)
+json.dump({"hits": hits,
+           "failing": sorted(res.failing_seeds),
+           "steps": {k: np.asarray(v).tolist()
+                     for k, v in res.observations.items()
+                     if k in ("steps", "bug_found", "t_us")}},
+          sys.stdout)
+"""
+
+
+def _run_child(cache_dir):
+    env = dict(os.environ,
+               MADSIM_COMPILE_CACHE=str(cache_dir),
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=600,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-4000:]
+    return json.loads(out.stdout)
+
+
+def test_second_cold_process_reuses_cache(tmp_path):
+    cache = tmp_path / "xla_cache"
+    fresh = _run_child(cache)
+    entries = {p.name for p in cache.iterdir()}
+    assert entries, "first process wrote nothing to the cache"
+    cached = _run_child(cache)
+    # The second cold runtime LOADED the sweep programs it would
+    # otherwise compile...
+    assert cached["hits"] >= 1, (fresh["hits"], cached["hits"])
+    # ...and added no new entries: the program set was fully covered.
+    assert {p.name for p in cache.iterdir()} == entries
+    # Cached-vs-fresh bitwise: a cache hit must be the SAME executable.
+    assert cached["failing"] == fresh["failing"]
+    for k in fresh["steps"]:
+        np.testing.assert_array_equal(fresh["steps"][k],
+                                      cached["steps"][k], err_msg=k)
+
+
+def test_env_hook_points_jax_at_the_dir(tmp_path, monkeypatch):
+    """The worker-entry hook (fleet/process.py calls this before
+    building the engine): no-op when the var is unset, creates + wires
+    the directory when set."""
+    import jax
+
+    from madsim_tpu.parallel import compile_cache as cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    monkeypatch.delenv(cc.ENV_VAR, raising=False)
+    try:
+        assert cc.enable_from_env() is None
+        assert jax.config.jax_compilation_cache_dir == prev
+        target = tmp_path / "xla_cache"
+        monkeypatch.setenv(cc.ENV_VAR, str(target))
+        assert cc.enable_from_env() == str(target)
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        assert target.is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -353,3 +353,52 @@ def test_recycled_sweep_zero_recompiles_after_warmup():
     for key in first.observations:
         np.testing.assert_array_equal(first.observations[key],
                                       second.observations[key], err_msg=key)
+
+
+def test_fused_sweep_zero_recompiles_across_seed_counts():
+    """The PR 3 zero-recompile contract extended to the fused whole-hunt
+    program: seed count, cursor, stop flag, and chunk budget are all
+    traced scalars and the observation buffers are bucketed to
+    _pow2_at_least(n_ids), so DIFFERENT seed counts in the same power-
+    of-two bucket reuse one compiled mega-dispatch — a hunt that refills
+    from a stream of varying batch sizes compiles exactly once."""
+    import logging
+
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=1_500_000, stop_on_bug=True)
+    eng = DeviceEngine(RaftActor(rcfg), cfg)
+
+    def run(n_seeds):
+        return sweep(None, cfg, np.arange(n_seeds), engine=eng,
+                     chunk_steps=64, max_steps=10_000, fused=True,
+                     recycle=True, batch_worlds=32)
+
+    first = run(96)  # warmup: (64, 128] seed bucket, width bucket 32
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture(level=logging.WARNING)
+    jax_logger = logging.getLogger("jax")
+    jax_logger.addHandler(handler)
+    try:
+        with jax.log_compiles():
+            second = run(96)   # identical
+            third = run(112)   # same bucket, different seed count
+    finally:
+        jax_logger.removeHandler(handler)
+
+    compiles = [m for m in records if "Finished XLA compilation" in m]
+    assert not compiles, (
+        f"{len(compiles)} new compilations in a warmed fused hunt:\n"
+        + "\n".join(compiles[:5]))
+    for key in first.observations:
+        np.testing.assert_array_equal(first.observations[key],
+                                      second.observations[key], err_msg=key)
+    # The third run is a real hunt over more seeds, not a cache artifact.
+    assert third.observations["steps"].shape[0] == 112
+    assert third.loop_stats["fused"]

@@ -148,18 +148,26 @@ def test_pipelined_checkpoint_interplay(raft_eng, tmp_path):
 def test_n_active_chunk_index_contract(raft_eng):
     """``n_active_chunks`` records the executed-chunk index each history
     entry was measured at: entrywise aligned, strictly increasing, and
-    identical between the serial and pipelined loops (the measurement
-    sequence is per-chunk in both — pipelining only delays when the host
-    READS it, never what was measured)."""
+    identical between the serial, pipelined, AND fused loops (the
+    measurement sequence is per-chunk in all three — pipelining only
+    delays when the host READS it, and the fused loop records the chunk
+    index inside the device program, so a mega-dispatch of K chunks
+    lands K correctly-indexed entries, not one skewed batch)."""
     seeds = np.arange(200)
-    ser, pip = both_loops(raft_eng, seeds, chunk_steps=64, max_steps=10_000,
-                          recycle=True, batch_worlds=48)
-    for res in (ser, pip):
+    kw = dict(chunk_steps=64, max_steps=10_000, recycle=True,
+              batch_worlds=48)
+    ser, pip = both_loops(raft_eng, seeds, **kw)
+    fus = sweep(None, raft_eng.cfg, seeds, engine=raft_eng, fused=True,
+                **kw)
+    for res in (ser, pip, fus):
         assert res.n_active_chunks.shape == res.n_active_history.shape
         assert (np.diff(res.n_active_chunks) > 0).all()
         assert res.n_active_chunks[0] == 0
         assert res.n_active_chunks[-1] == res.loop_stats["chunks"] - 1
     np.testing.assert_array_equal(ser.n_active_chunks, pip.n_active_chunks)
+    np.testing.assert_array_equal(ser.n_active_chunks, fus.n_active_chunks)
+    np.testing.assert_array_equal(ser.n_active_history,
+                                  fus.n_active_history)
 
 
 def test_sync_discipline_counted_fetches(raft_eng, monkeypatch):
@@ -287,11 +295,16 @@ def test_loop_stats_schema_both_paths(raft_eng, pipeline):
     ls = res.loop_stats
     documented = {"device_wait_s", "host_decision_s", "scalar_fetches",
                   "retire_fetches", "dispatch_depth", "dispatches_per_seed",
+                  "seeds_per_dispatch", "epochs_on_device", "fused",
                   "pipelined", "superstep_max", "chunk_steps", "chunks",
                   "dispatches", "chunks_per_dispatch", "dispatch_s",
                   "retire_wait_s", "loop_wall_s"}
     assert documented <= set(ls), sorted(ls)
     assert ls["pipelined"] is pipeline
+    assert ls["fused"] is False
+    assert ls["epochs_on_device"] == 0   # host loops never refill on device
+    assert ls["seeds_per_dispatch"] == pytest.approx(
+        48 / ls["dispatches"], abs=1e-3)
     for key in ("device_wait_s", "host_decision_s", "dispatch_s",
                 "retire_wait_s", "loop_wall_s"):
         assert isinstance(ls[key], float) and ls[key] >= 0.0, key
@@ -316,11 +329,12 @@ def test_superstep_telemetry_fields(raft_eng):
     (bench_results.json configs.*.sweep_loop, asserted by make smoke)."""
     res = sweep(None, raft_eng.cfg, np.arange(48), engine=raft_eng,
                 chunk_steps=64, max_steps=512)
-    need = {"pipelined", "chunks", "dispatches", "chunks_per_dispatch",
-            "dispatches_per_seed", "dispatch_depth", "device_wait_s",
-            "host_decision_s", "dispatch_s", "retire_wait_s",
-            "scalar_fetches", "retire_fetches", "loop_wall_s",
-            "superstep_max", "chunk_steps"}
+    need = {"pipelined", "fused", "chunks", "dispatches",
+            "chunks_per_dispatch", "dispatches_per_seed",
+            "seeds_per_dispatch", "epochs_on_device", "dispatch_depth",
+            "device_wait_s", "host_decision_s", "dispatch_s",
+            "retire_wait_s", "scalar_fetches", "retire_fetches",
+            "loop_wall_s", "superstep_max", "chunk_steps"}
     assert need <= set(res.loop_stats), res.loop_stats
     assert res.loop_stats["device_wait_s"] >= 0.0
     assert res.loop_stats["dispatches_per_seed"] == pytest.approx(

@@ -74,6 +74,23 @@ METRICS: List[Tuple[str, str, bool]] = [
      "configs.time_to_first_bug.recycled_hunt.world_utilization", True),
     ("ttfb chunks/dispatch",
      "configs.time_to_first_bug.sweep_loop.chunks_per_dispatch", True),
+    # Whole-hunt residency (docs/perf.md): dispatch economics of the
+    # pinned recycled hunt, pipelined vs fused — the fused row must hold
+    # the >=4x seeds-per-dispatch advantage, and epochs_on_device counts
+    # the refill epochs the host no longer orchestrates.
+    ("ttfb seeds/dispatch",
+     "configs.time_to_first_bug.sweep_loop.seeds_per_dispatch", True),
+    ("ttfb fused seeds/dispatch",
+     "configs.time_to_first_bug.sweep_loop_fused.seeds_per_dispatch",
+     True),
+    ("ttfb fused epochs on device",
+     "configs.time_to_first_bug.sweep_loop_fused.epochs_on_device",
+     True),
+    ("ttfb fused dispatch reduction",
+     "configs.time_to_first_bug.recycled_hunt.fused_dispatch_reduction",
+     True),
+    ("5node seeds/dispatch",
+     "configs.madraft_5node.sweep_loop.seeds_per_dispatch", True),
     ("ttfb distinct behaviors",
      "configs.time_to_first_bug.coverage.distinct_behaviors", True),
     ("bridge seeds/s", "configs.bridge_sweep.bridge_seeds_per_sec", True),
@@ -121,6 +138,8 @@ METRICS: List[Tuple[str, str, bool]] = [
     # and bugs-at-budget on the seeded raft double-vote.
     ("guided pair seeds-to-bug",
      "configs.guided_hunt.pair.guided_seeds_to_bug", False),
+    ("guided pair seeds/dispatch",
+     "configs.guided_hunt.pair.sweep_loop.seeds_per_dispatch", True),
     ("guided pair speedup>=",
      "configs.guided_hunt.pair.speedup_lower_bound", True),
     ("guided raft bugs",
