@@ -98,6 +98,13 @@ smoke:
 	    ('time_to_first_bug','madraft_5node')]; \
 	assert all(isinstance(x,dict) and x.get('distinct_behaviors',0)>1 \
 	           for x in cv), f'coverage records missing/flat: {cv}'; \
+	bb=d['configs']['time_to_first_bug'].get('blackbox'); \
+	bneed={'k','seeds_per_sec','seeds_per_sec_off','seeds_per_sec_ratio', \
+	       'state_bytes_per_world','state_bytes_per_world_off', \
+	       'state_bytes_per_world_delta','flops_per_world_step', \
+	       'flops_per_world_step_off','flops_per_world_step_delta'}; \
+	assert isinstance(bb,dict) and bneed<=set(bb), \
+	    f'blackbox record missing/incomplete: {bb}'; \
 	gh=d['configs'].get('guided_hunt'); \
 	assert isinstance(gh,dict) and {'pair','raft'}<=set(gh), \
 	    f'guided_hunt record missing/incomplete: {gh}'; \

@@ -1133,6 +1133,54 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
     coverage = res_m.coverage.to_json()
     del eng_m, res_m
 
+    # Flight-recorder pricing (docs/observability.md "The flight
+    # recorder"): the SAME monolithic batch with the K=64 per-world
+    # event ring aboard (EngineConfig(blackbox=64)), timed against the
+    # blackbox-off run above. The off run IS the baseline — bitwise
+    # invisibility keeps it the exact pre-blackbox program — so the
+    # deltas here price the opt-in: ring state per world, ring-write
+    # flops, and the seeds/s tax. tools/bench_diff.py tracks all three
+    # round over round; `make smoke` asserts the keys.
+    bb_k = 64
+    eng_b = DeviceEngine(RaftActor(rcfg), _dc.replace(cfg, blackbox=bb_k))
+    warm_b = eng_b.run(eng_b.init(np.arange(device_worlds)),
+                       max_steps=4_000)
+    jax.block_until_ready(warm_b)
+    del warm_b
+    state_b = eng_b.init(np.arange(device_worlds))
+    jax.block_until_ready(state_b)
+    t0 = walltime.perf_counter()
+    state_b = eng_b.run(state_b, max_steps=4_000)
+    jax.block_until_ready(state_b)
+    bb_run_dt = walltime.perf_counter() - t0
+    xla_cost_b = xla_cost_record(eng_b, state_b, 4_000)
+    obs_b = eng_b.observe(state_b)
+    assert bool(np.array_equal(np.asarray(obs_b["bug"]),
+                               np.asarray(obs["bug"]))), \
+        "blackbox-on run diverged from blackbox-off on the bug vector"
+
+    def _bb_delta(on, off, nd=2):
+        return (round(on - off, nd)
+                if on is not None and off is not None else None)
+
+    blackbox = {
+        "k": bb_k,
+        "seeds_per_sec": round(device_worlds / bb_run_dt, 1),
+        "seeds_per_sec_off": round(device_worlds / run_dt, 1),
+        "seeds_per_sec_ratio": round(run_dt / bb_run_dt, 4),
+        "state_bytes_per_world": xla_cost_b["state_bytes_per_world"],
+        "state_bytes_per_world_off": xla_cost["state_bytes_per_world"],
+        "state_bytes_per_world_delta": _bb_delta(
+            xla_cost_b["state_bytes_per_world"],
+            xla_cost["state_bytes_per_world"]),
+        "flops_per_world_step": xla_cost_b["flops_per_world_step"],
+        "flops_per_world_step_off": xla_cost["flops_per_world_step"],
+        "flops_per_world_step_delta": _bb_delta(
+            xla_cost_b["flops_per_world_step"],
+            xla_cost["flops_per_world_step"]),
+    }
+    del eng_b, state_b, obs_b
+
     # Expected seeds to first bug = 1/rate; the device explores
     # device_worlds/dev_dt seeds per second.
     dev_expected = (1.0 / dev_rate) / (device_worlds / dev_dt)
@@ -1164,6 +1212,9 @@ def bench_time_to_first_bug(host_seeds_n: int, device_worlds: int) -> dict:
         # Behavior-coverage ledger rollup of the same probe sweep
         # (docs/observability.md "reading the novelty curve").
         "coverage": coverage,
+        # Flight-recorder on-vs-off pricing at K=64
+        # (docs/observability.md "The flight recorder").
+        "blackbox": blackbox,
         "recycled_hunt": recycled,
         # Orchestration breakdown of the recycled hunt's chunk loop
         # (docs/perf.md "Pipelined orchestration"): the acceptance axes

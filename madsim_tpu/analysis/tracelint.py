@@ -163,17 +163,17 @@ class TraceProgram:
 _ENGINE_CACHE: Dict[str, Any] = {}
 
 
-def _bug_engine(metrics: bool = False):
+def _bug_engine(metrics: bool = False, blackbox: int = 0):
     """The canonical raft bug config every budget in the repo is pinned
     to (tests/test_queue_insert.py, bench time_to_first_bug)."""
-    key = f"eng_m{int(metrics)}"
+    key = f"eng_m{int(metrics)}_b{blackbox}"
     if key not in _ENGINE_CACHE:
         from ..engine import (DeviceEngine, EngineConfig, RaftActor,
                               RaftDeviceConfig)
 
         cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
                            t_limit_us=2_000_000, stop_on_bug=False,
-                           metrics=metrics)
+                           metrics=metrics, blackbox=blackbox)
         _ENGINE_CACHE[key] = DeviceEngine(
             RaftActor(RaftDeviceConfig(n=3, buggy_double_vote=True)), cfg)
     return _ENGINE_CACHE[key]
@@ -200,6 +200,21 @@ def _build_engine_run() -> Built:
     import numpy as np
 
     eng = _bug_engine()
+    state = eng.init(np.arange(RUN_WORLDS))
+    return Built(fn=eng._run, args=(state, RUN_MAX_STEPS),
+                 trace_fn=lambda s: eng._run_impl(s, RUN_MAX_STEPS),
+                 trace_args=(state,))
+
+
+# Flight-recorder ring depth the budget is pinned at — the depth the
+# docs recommend (docs/observability.md "The flight recorder").
+BLACKBOX_K = 64
+
+
+def _build_engine_run_blackbox() -> Built:
+    import numpy as np
+
+    eng = _bug_engine(blackbox=BLACKBOX_K)
     state = eng.init(np.arange(RUN_WORLDS))
     return Built(fn=eng._run, args=(state, RUN_MAX_STEPS),
                  trace_fn=lambda s: eng._run_impl(s, RUN_MAX_STEPS),
@@ -647,6 +662,14 @@ def registry() -> Dict[str, TraceProgram]:
             "engine.run", "DeviceEngine.run while-loop (donated step "
             f"path, raft bug config, W={RUN_WORLDS})",
             _build_engine_run, budget=True, donates=True,
+            unit_div=RUN_WORLDS, packed=True),
+        TraceProgram(
+            "engine.run_blackbox", "DeviceEngine.run with the flight "
+            f"recorder aboard (EngineConfig(blackbox={BLACKBOX_K}), "
+            f"raft bug config, W={RUN_WORLDS}) — the per-step ring "
+            "writes must hold the packed narrow-lane discipline and "
+            "the K=64 state_bytes_per_world ceiling",
+            _build_engine_run_blackbox, budget=True, donates=True,
             unit_div=RUN_WORLDS, packed=True),
         TraceProgram(
             "engine.pallas_step", "fused Pallas step kernel "

@@ -30,6 +30,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.blackbox import (
+    BB_DROP_DEAD,
+    BB_DROP_STALE,
+    BB_FAULT,
+    BB_MARKER,
+    BB_RAISE,
+    BB_TIMER,
+    FAULT_NAMES,
+    BlackboxRing,
+)
 from ..obs.metrics import NUM_FAULT_KINDS, MetricsBlock
 from .lanes import (
     PACKED,
@@ -133,6 +143,14 @@ class EngineConfig:
     # the field is None and the compiled step is the exact pre-metrics
     # program — the op budget in tests/test_queue_insert.py is untouched.
     metrics: bool = False
+    # Flight recorder (obs/blackbox.py): carry a per-world ring buffer
+    # of the last K recorded step events in WorldState.blackbox and
+    # write one packed record per processed step. Same contract as
+    # ``metrics``: a separate write-only pytree leaf, so blackbox-on
+    # trajectories are bit-identical to blackbox-off (tier-1,
+    # tests/test_obs.py) and 0 (default) leaves the field None — the
+    # compiled step is the exact pre-recorder program.
+    blackbox: int = 0
     # Packed lane dtypes (engine/lanes.py Lanes registry, docs/perf.md
     # "Roofline round 2"): node ids, role/decision codes, queue slot
     # indices and payload words ride i8/i16 at rest instead of i32 —
@@ -175,6 +193,9 @@ class EngineConfig:
                     f"Use packed=False for deeper queues.")
         if self.pallas_block is not None and self.pallas_block <= 0:
             raise ValueError("pallas_block must be a positive world count")
+        if self.blackbox < 0:
+            raise ValueError("blackbox must be 0 (off) or a positive ring "
+                             "depth K (events/world)")
 
     @property
     def lanes(self) -> Lanes:
@@ -252,6 +273,10 @@ class WorldState(NamedTuple):
     # step: nothing below ever reads it — the bitwise-invisibility
     # contract.
     metrics: Any = None
+    # Flight-recorder ring (obs/blackbox.py BlackboxRing) when
+    # EngineConfig.blackbox > 0, else None — the same empty-subtree
+    # trick as ``metrics``, with the same write-only contract.
+    blackbox: Any = None
 
 
 def tree_select(pred, a, b):
@@ -499,6 +524,8 @@ class DeviceEngine:
         # seed events and the fault rows count as enqueued.
         mb = (MetricsBlock.zeros(self.actor.num_kinds)._replace(enqueued=qd32)
               if cfg.metrics else None)
+        bb = BlackboxRing.zeros(cfg.blackbox, cfg.lanes) \
+            if cfg.blackbox else None
         return WorldState(
             now=jnp.int32(0),
             queue=q,
@@ -524,6 +551,7 @@ class DeviceEngine:
             lat_max=lat_max,
             loss=loss,
             metrics=mb,
+            blackbox=bb,
         )
 
     def refill(self, state: WorldState, slot_mask, new_seeds,
@@ -851,6 +879,54 @@ class DeviceEngine:
                     + (onehot(ev.kind, num_kinds) & deliver).astype(i32),
                 )
                 ws4 = ws4._replace(metrics=mb)
+            if cfg.blackbox:
+                # Flight recorder (obs/blackbox.py): one packed record
+                # per step trace() would record — a valid processed
+                # event (found & in_time; ``found`` is already gated on
+                # ws.active by the pop) or the ``invariant`` marker for
+                # a raise on a step that processed no event. A frozen
+                # world records nothing (found is False and its bug flag
+                # cannot rise on unchanged state), so — like metrics —
+                # the ring needs no restore in the tail below.
+                # Write-only: the trajectory never reads these lanes.
+                i32 = jnp.int32
+                k = cfg.blackbox
+                rb = ws3.blackbox
+                valid = found & in_time
+                raised = bug & ~ws3.bug
+                marker = raised & ~valid
+                rec = valid | marker
+                # Record r lands at slot r % K; a disabled write aims at
+                # slot K, which onehot's drop semantics turn into a
+                # no-op (the upd-out-of-range idiom).
+                cur = jnp.where(rec, jnp.remainder(rb.pos, k), i32(k))
+                # Valid entries record the event's own time (trace's
+                # t_us); the marker records the post-step clock.
+                t_lo, t_hi = split_wide(jnp.where(marker, now, ev.time))
+                fl = ((valid & is_timer).astype(i32) * BB_TIMER
+                      + (valid & is_fault).astype(i32) * BB_FAULT
+                      + (valid & ~is_fault & stale).astype(i32)
+                      * BB_DROP_STALE
+                      + (valid & ~is_fault & ~stale & dead).astype(i32)
+                      * BB_DROP_DEAD
+                      + raised.astype(i32) * BB_RAISE
+                      + marker.astype(i32) * BB_MARKER)
+                rb = rb._replace(
+                    pos=rb.pos + rec.astype(i32),
+                    # Step index wraps mod the slot-lane width by
+                    # contract (decode reconstructs the high bits from
+                    # pos) — pre-wrapped so upd's saturating narrow
+                    # passes it through untouched (the gen-lane idiom).
+                    step_lo=upd(rb.step_lo, cur,
+                                narrow_wrap(ws.steps, rb.step_lo.dtype)),
+                    t_lo=upd(rb.t_lo, cur, t_lo),
+                    t_hi=upd(rb.t_hi, cur, t_hi),
+                    kind=upd(rb.kind, cur, jnp.where(valid, ev.kind, 0)),
+                    src=upd(rb.src, cur, jnp.where(valid, ev.src, -1)),
+                    dst=upd(rb.dst, cur, jnp.where(valid, ev.dst, -1)),
+                    flags=upd(rb.flags, cur, fl),
+                )
+                ws4 = ws4._replace(blackbox=rb)
             # Frozen worlds pass through untouched. Every lane write above
             # is already gated on ws.active (the pop found nothing, the
             # outbox was disabled, faults/delivery/bug flags all require
@@ -1150,14 +1226,9 @@ class DeviceEngine:
         valid, time_us, kind, flags, src, dst, payload, delivered, bug, now_us = \
             (np.asarray(r) for r in recs)
         kind_names = getattr(self.actor, "kind_names", None)
-        fault_names = {FAULT_KILL: "kill", FAULT_RESTART: "restart",
-                       FAULT_CLOG_NODE: "clog_node",
-                       FAULT_UNCLOG_NODE: "unclog_node",
-                       FAULT_CLOG_LINK: "clog_link",
-                       FAULT_UNCLOG_LINK: "unclog_link",
-                       FAULT_SET_LATENCY: "set_latency",
-                       FAULT_SET_LOSS: "set_loss",
-                       FAULT_PAUSE: "pause", FAULT_RESUME: "resume"}
+        # Shared with the blackbox ring decoder (obs/blackbox.py) so the
+        # two decoders cannot drift apart — the --crosscheck contract.
+        fault_names = FAULT_NAMES
         out: List[Dict[str, Any]] = []
         bug_seen = False
         for i in range(max_steps):
@@ -1248,6 +1319,14 @@ class DeviceEngine:
             # gathers), and SweepResult.metrics reassembles the frames.
             out.update({f"m_{name}": val for name, val
                         in state.metrics._asdict().items()})
+        if self.cfg.blackbox and state.blackbox is not None:
+            # One ``bb_<field>`` entry per ring lane: the flight
+            # recorder then rides every existing observation surface —
+            # retirement tail gathers, per-seed scatters, checkpoint
+            # aux arrays, fleet merges — with zero recorder-specific
+            # plumbing (obs/blackbox.py decodes the rows back).
+            out.update({f"bb_{name}": val for name, val
+                        in state.blackbox._asdict().items()})
         out.update(self.actor.observe(self.cfg, state.astate))
         return out
 

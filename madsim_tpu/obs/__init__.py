@@ -40,6 +40,13 @@ CLI: ``python -m madsim_tpu.obs replay --seed N --actor raft ...``,
 ``replay --bundle repro.json``, or ``watch telemetry.jsonl [--follow]``.
 See docs/observability.md.
 """
+from .blackbox import (
+    BlackboxRing,
+    blackbox_block,
+    decode_ring,
+    ring_matches_trace,
+    rings_from_observations,
+)
 from .bundle import load_bundle, write_sweep_bundle, write_test_bundle
 from .coverage import (
     DEFAULT_BUCKETS,
@@ -61,9 +68,12 @@ from .observatory import (
     prometheus_text,
     write_prometheus,
 )
-from .timeline import polls_to_chrome, render_text, trace_to_chrome
+from .timeline import polls_to_chrome, render_text, ring_to_chrome, \
+    trace_to_chrome
 
 __all__ = [
+    "BlackboxRing", "blackbox_block", "decode_ring",
+    "ring_matches_trace", "rings_from_observations", "ring_to_chrome",
     "MetricsBlock", "NUM_FAULT_KINDS", "BLOCK_FIELDS",
     "aggregate_metrics", "metrics_from_observations",
     "SweepCoverage", "DEFAULT_BUCKETS", "behavior_signature",

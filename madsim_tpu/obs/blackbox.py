@@ -1,0 +1,260 @@
+"""The flight recorder: a per-world ring of the last K step events.
+
+A hunt at W=2048 worlds surfaces counters, coverage signatures and fault
+schedules — but the actual event sequence of a failing world was only
+reconstructable by a separate single-world host replay through
+``DeviceEngine.trace()``. ``BlackboxRing`` closes that gap in situ
+(PRISM's point, PAPERS.md): with ``EngineConfig(blackbox=K)`` every
+world carries a ring buffer of its last K *recorded* step events inside
+``WorldState.blackbox``, written by the core step program and riding the
+existing retirement machinery — permuted by the compactor, selected by
+the refill, checkpointed with the state, and pulled ONLY on the sweep's
+existing retirement fetch and final pull (zero new mid-loop syncs,
+counted by the ``_fetch`` seam in tests/test_fused.py).
+
+The ring records exactly the steps ``trace()`` records — valid
+processed events (``found & active & in_time``, including popped-and-
+dropped stale/dead events and fault injections) plus the ``invariant``
+marker for a bug that rises on a step that processed no event. Because
+both live worlds and the trace scan freeze/skip identically, the
+recorded step indices of one world are **consecutive from step 0**, so
+``pos`` (total records written) alone reconstructs every absolute step
+index and the decoded ring is — by determinism — bitwise the suffix of
+a fresh ``trace()`` of the same seed/schedule. ``ring_matches_trace``
+is that crosscheck (the ``obs replay --crosscheck`` CLI leg and the
+fleet-merge-style free cross-execution check).
+
+Packing (engine/lanes.py, the PR 10 discipline): kind/src/dst/flags
+ride the i8 code lane, the wrapped step index rides the i16 slot lane,
+and the full-width virtual time splits across two payload-lane words
+(``lanes.split_wide`` — the net-config precedent), so K=64 costs
+~644 B/world against the packed budget's slack (the ledgered
+``engine.run_blackbox`` row in analysis/budgets.json).
+
+Like obs/metrics.py, this module imports nothing from
+:mod:`madsim_tpu.engine` (the engine imports *it*); the fault-op name
+table lives here and ``trace()`` shares it, so ring decode and trace
+decode cannot drift apart.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# Bundle-block schema id (docs/observability.md bundle schema table).
+SCHEMA = "madsim.blackbox/1"
+
+# Observation-dict prefix for ring fields (DeviceEngine.observe adds one
+# ``bb_<field>`` entry per ring field when the recorder is on).
+OBS_PREFIX = "bb_"
+
+# Flag bits of the per-record ``flags`` lane. TIMER/FAULT mirror the
+# queue's event flags; STALE/DEAD are the two popped-but-dropped causes
+# (mutually exclusive, STALE wins — the step's own precedence); RAISE
+# marks the step the bug flag first rose; MARKER marks the synthetic
+# ``invariant`` entry for a raise on a step that processed no event.
+BB_TIMER = 1
+BB_FAULT = 2
+BB_DROP_STALE = 4
+BB_DROP_DEAD = 8
+BB_RAISE = 16
+BB_MARKER = 32
+
+# Fault-op names, by op code (engine/core.py FAULT_KILL..FAULT_RESUME).
+# Shared by ``DeviceEngine.trace()`` and :func:`decode_ring` so the two
+# decoders name events identically — the crosscheck depends on it.
+FAULT_NAMES = {0: "kill", 1: "restart", 2: "clog_node", 3: "unclog_node",
+               4: "clog_link", 5: "unclog_link", 6: "set_latency",
+               7: "set_loss", 8: "pause", 9: "resume"}
+
+
+class BlackboxRing(NamedTuple):
+    """Per-world event ring (leading world axis when batched).
+
+    ``pos`` is the total records ever written (int32); record ``r``
+    lives at slot ``r % K``, so the ring holds records
+    ``pos - min(pos, K) .. pos - 1`` and — because recorded steps are
+    consecutive from 0 (module docstring) — record ``r`` IS step ``r``.
+    All lanes are write-only within the step: nothing ever reads them
+    for a simulation decision (the metrics bitwise-invisibility
+    contract, tier-1-gated in tests/test_obs.py).
+    """
+
+    pos: jnp.ndarray       # int32 scalar — records written (ring cursor)
+    step_lo: jnp.ndarray   # (K,) slot lane — step index, wrapped
+    t_lo: jnp.ndarray      # (K,) payload lane — event t_us low half
+    t_hi: jnp.ndarray      # (K,) payload lane — event t_us high half
+    kind: jnp.ndarray      # (K,) code lane — event kind / fault op
+    src: jnp.ndarray       # (K,) code lane — source node (-1 marker)
+    dst: jnp.ndarray       # (K,) code lane — destination node (-1 marker)
+    flags: jnp.ndarray     # (K,) code lane — BB_* bits
+
+    @staticmethod
+    def zeros(k: int, lanes) -> "BlackboxRing":
+        """A fresh (single-world) ring of depth ``k`` on the config's
+        lane dtypes (``lanes`` is an engine/lanes.py ``Lanes``)."""
+        return BlackboxRing(
+            pos=jnp.int32(0),
+            step_lo=jnp.zeros((k,), lanes.slot),
+            t_lo=jnp.zeros((k,), lanes.payload),
+            t_hi=jnp.zeros((k,), lanes.payload),
+            kind=jnp.zeros((k,), lanes.code),
+            src=jnp.zeros((k,), lanes.code),
+            dst=jnp.zeros((k,), lanes.code),
+            flags=jnp.zeros((k,), lanes.code),
+        )
+
+
+RING_FIELDS = BlackboxRing._fields
+
+
+def rings_from_observations(obs: Dict[str, np.ndarray]
+                            ) -> Optional[Dict[str, np.ndarray]]:
+    """Extract the per-seed ring arrays from an observation dict (the
+    ``bb_``-prefixed entries ``DeviceEngine.observe`` adds), or ``None``
+    when the sweep ran blackbox-off."""
+    per_seed = {k[len(OBS_PREFIX):]: np.asarray(v)
+                for k, v in obs.items() if k.startswith(OBS_PREFIX)}
+    return per_seed or None
+
+
+def ring_depth(obs: Dict[str, np.ndarray]) -> Optional[int]:
+    """The recorder depth K of a sweep's observations, or ``None`` when
+    it ran blackbox-off (summary/banner self-description)."""
+    v = obs.get(OBS_PREFIX + "step_lo")
+    return None if v is None else int(np.asarray(v).shape[-1])
+
+
+def _join_t(lo: int, hi: int) -> int:
+    """Reassemble the split virtual time (lanes.join_wide, on host)."""
+    return int(np.int32((int(lo) & 0xFFFF) | (int(hi) << 16)))
+
+
+def decode_ring(ring: Dict[str, np.ndarray], *,
+                kind_names: Optional[List[str]] = None
+                ) -> List[Dict[str, Any]]:
+    """Decode ONE world's ring into trace-shaped event records.
+
+    ``ring`` is a single seed's row of :func:`rings_from_observations`
+    (scalar ``pos``, (K,) lanes). Entries mirror ``trace()``'s exactly
+    — ``step``/``t_us``/``kind``/``timer``/``src``/``dst`` plus the
+    optional ``dropped``/``bug_raised`` keys and the synthetic
+    ``invariant`` marker — except ``payload`` (not recorded) and the
+    extra ``drop_cause`` ("stale"/"dead") the trace does not carry;
+    :func:`ring_matches_trace` projects both sides accordingly. Oldest
+    record first. Raises ``ValueError`` when a record's wrapped step
+    index contradicts its reconstructed absolute step — a torn ring,
+    which determinism says cannot happen.
+    """
+    pos = int(np.asarray(ring["pos"]))
+    step_lo = np.asarray(ring["step_lo"])
+    k = int(step_lo.shape[-1])
+    n = min(pos, k)
+    t_lo, t_hi = np.asarray(ring["t_lo"]), np.asarray(ring["t_hi"])
+    kind, flags = np.asarray(ring["kind"]), np.asarray(ring["flags"])
+    src, dst = np.asarray(ring["src"]), np.asarray(ring["dst"])
+    out: List[Dict[str, Any]] = []
+    for j in range(n):
+        step = pos - n + j          # record r IS step r (module docstring)
+        idx = step % k
+        expect = np.asarray(step).astype(step_lo.dtype)
+        if int(step_lo[idx]) != int(expect):
+            raise ValueError(
+                f"blackbox ring is torn: slot {idx} records wrapped step "
+                f"{int(step_lo[idx])} but reconstruction expects step "
+                f"{step} (pos={pos}, k={k})")
+        fl = int(flags[idx])
+        t = _join_t(int(t_lo[idx]), int(t_hi[idx]))
+        if fl & BB_MARKER:
+            out.append({"step": step, "t_us": t, "kind": "invariant",
+                        "timer": False, "src": -1, "dst": -1,
+                        "bug_raised": True})
+            continue
+        kd = int(kind[idx])
+        if fl & BB_FAULT:
+            name = f"fault:{FAULT_NAMES.get(kd, kd)}"
+        elif kind_names is not None and 0 <= kd < len(kind_names):
+            name = kind_names[kd]
+        else:
+            name = str(kd)
+        entry: Dict[str, Any] = {
+            "step": step, "t_us": t, "kind": name,
+            "timer": bool(fl & BB_TIMER),
+            "src": int(src[idx]), "dst": int(dst[idx]),
+        }
+        if fl & (BB_DROP_STALE | BB_DROP_DEAD):
+            entry["dropped"] = True
+            entry["drop_cause"] = "stale" if fl & BB_DROP_STALE else "dead"
+        if fl & BB_RAISE:
+            entry["bug_raised"] = True
+        out.append(entry)
+    return out
+
+
+def _project_trace(trace: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """``trace()`` entries → ring-comparable records: drop the payload
+    (not recorded) and the host-only ``truncated`` end marker."""
+    out = []
+    for e in trace:
+        if e.get("kind") == "truncated":
+            continue
+        out.append({k: v for k, v in e.items() if k != "payload"})
+    return out
+
+
+def ring_matches_trace(entries: List[Dict[str, Any]],
+                       trace: List[Dict[str, Any]], *,
+                       total: Optional[int] = None) -> Optional[str]:
+    """Verify a decoded ring is BITWISE the suffix of a replayed trace.
+
+    ``entries`` from :func:`decode_ring` (or a bundle's ``events``),
+    ``trace`` from ``DeviceEngine.trace()`` of the same seed/schedule
+    with ``max_steps`` covering the recorded run. ``total`` (the ring's
+    ``pos``) additionally pins the replay's total recorded-event count —
+    a ring that wrapped must still agree with the trace about how many
+    events ever happened. Returns ``None`` on an exact match, else a
+    human mismatch description (the crosscheck's failure message).
+    """
+    ref = _project_trace(trace)
+    got = [{k: v for k, v in e.items() if k != "drop_cause"}
+           for e in entries]
+    if total is not None and len(ref) != int(total):
+        return (f"replayed trace recorded {len(ref)} events but the ring "
+                f"wrote {int(total)} in total — schedule/config drift?")
+    if len(got) > len(ref):
+        return (f"ring holds {len(got)} events but the replayed trace "
+                f"has only {len(ref)}")
+    tail = ref[len(ref) - len(got):] if got else []
+    for i, (g, r) in enumerate(zip(got, tail)):
+        if g != r:
+            return (f"ring event {i} (step {g.get('step')}) diverges from "
+                    f"the replayed trace: ring {g!r} != trace {r!r}")
+    return None
+
+
+def blackbox_block(entries: List[Dict[str, Any]], *, seed: int, k: int,
+                   pos: int, steps: int,
+                   faults: Optional[Any] = None) -> Dict[str, Any]:
+    """The ``madsim.blackbox/1`` bundle block for one world's ring.
+
+    Self-contained for the CLI crosscheck: ``faults`` are the rows the
+    ring was RECORDED under (for a triaged class representative, the
+    original hunt schedule — the minimized schedule rides the bundle's
+    top level and replays separately) and ``steps`` is the world's final
+    step counter, so ``trace(seed, max_steps=steps, faults=faults)``
+    re-executes exactly the recorded window.
+    """
+    rows = None if faults is None \
+        else np.asarray(faults, np.int32).tolist()
+    return {
+        "schema": SCHEMA,
+        "seed": int(seed),
+        "k": int(k),
+        "n_records": len(entries),
+        "n_total": int(pos),
+        "steps": int(steps),
+        "faults": rows,
+        "events": entries,
+    }

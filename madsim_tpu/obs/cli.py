@@ -100,6 +100,64 @@ def _replay_device(seed: int, actor_name: str, actor_config: Dict[str, Any],
     return 0
 
 
+def _crosscheck_blackbox(bundle: Dict[str, Any]) -> int:
+    """``replay --crosscheck``: verify the bundle's recorded flight-
+    recorder ring is BITWISE the suffix of a freshly replayed
+    ``trace()`` (obs/blackbox.py ``ring_matches_trace``).
+
+    The ``madsim.blackbox/1`` block is self-contained: it carries the
+    schedule rows the ring was RECORDED under and the world's final
+    step count, so the crosscheck replays exactly the recorded window —
+    independent of the bundle's top-level (possibly minimized) schedule.
+    Determinism makes this a free cross-execution check, the fleet-merge
+    crosscheck's single-world analog. Exit 0 = bitwise match, 1 =
+    ring/replay divergence, 2 = no block / unknown actor.
+    """
+    import numpy as np
+
+    from ..engine import DeviceEngine, EngineConfig
+    from .blackbox import SCHEMA, ring_matches_trace
+
+    block = (bundle.get("extra") or {}).get("blackbox")
+    if not block:
+        print("obs replay: --crosscheck needs a bundle carrying a "
+              f"{SCHEMA} block (written by a blackbox-on sweep/triage "
+              "— EngineConfig(blackbox=K))", file=sys.stderr)
+        return 2
+    if block.get("schema") != SCHEMA:
+        print(f"obs replay: unknown blackbox block schema "
+              f"{block.get('schema')!r} (this build reads {SCHEMA})",
+              file=sys.stderr)
+        return 2
+    registry = _actor_registry()
+    actor_name = bundle.get("actor")
+    if actor_name not in registry:
+        print(f"obs replay: unknown actor {actor_name!r} "
+              f"(known: {sorted(registry)})", file=sys.stderr)
+        return 2
+    actor_cls, acfg_cls = registry[actor_name]
+    actor = actor_cls(acfg_cls(**(bundle.get("actor_config") or {})))
+    acfg_n = getattr(actor, "n", None)
+    cfg = EngineConfig(**(bundle.get("engine_config")
+                          or {"n_nodes": acfg_n}))
+    frows = block.get("faults")
+    frows = None if frows is None else np.asarray(frows, np.int32)
+    eng = DeviceEngine(actor, cfg)
+    steps = int(block.get("steps") or bundle.get("max_steps", 2_000))
+    trace = eng.trace(int(block["seed"]), max_steps=steps, faults=frows)
+    err = ring_matches_trace(block.get("events") or [], trace,
+                             total=block.get("n_total"))
+    if err:
+        print(f"obs replay --crosscheck: RING/REPLAY DIVERGENCE: {err}",
+              file=sys.stderr)
+        return 1
+    print(f"obs replay --crosscheck: seed {block['seed']}: recorded ring "
+          f"({block.get('n_records')} events, K={block.get('k')}) is "
+          f"bitwise the suffix of the replayed trace ({len(trace)} "
+          "events)", file=sys.stderr)
+    return 0
+
+
 def _load_test_module(mod_name: str, test_file: Optional[str]):
     """Import the bundle's test module by name, falling back to loading
     its recorded source file — a test defined in a directly-run script
@@ -241,6 +299,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp.add_argument("--out", default=None, help="output file (default: "
                                                 "stdout)")
     rp.add_argument("--format", choices=("chrome", "text"), default="chrome")
+    rp.add_argument("--crosscheck", action="store_true",
+                    help="after the replay, verify the bundle's recorded "
+                         "flight-recorder ring (madsim.blackbox/1 block) "
+                         "is bitwise the suffix of the replayed trace")
     wp = sub.add_parser("watch", help="tail/summarize a sweep telemetry "
                                       "JSONL stream (sweep(observe=...))")
     wp.add_argument("file", help="telemetry JSONL written by "
@@ -269,8 +331,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.bundle:
         bundle = load_bundle(args.bundle)
         if bundle["kind"] == "host_test":
+            if args.crosscheck:
+                print("obs replay: --crosscheck applies to device_sweep "
+                      "bundles (host tests carry no flight recorder)",
+                      file=sys.stderr)
+                return 2
             return _replay_host_test(bundle)
-        return _replay_device(
+        rc = _replay_device(
             seed=bundle["seed"], actor_name=bundle["actor"],
             actor_config=bundle.get("actor_config") or {},
             engine_config=bundle.get("engine_config") or {},
@@ -278,6 +345,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_steps=args.max_steps or int(bundle.get("max_steps", 2_000)),
             out=args.out, fmt=args.format,
             expect_bug=bundle.get("error") is not None)
+        if rc != 0 or not args.crosscheck:
+            return rc
+        return _crosscheck_blackbox(bundle)
+    if args.crosscheck:
+        ap.error("--crosscheck needs --bundle (the recorded ring rides "
+                 "the bundle's madsim.blackbox/1 block)")
     if args.seed is None or not args.actor:
         ap.error("replay needs --bundle, or --seed and --actor")
     return _replay_device(

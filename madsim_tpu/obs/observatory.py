@@ -405,6 +405,12 @@ def render_search_event(rec: dict) -> str:
         bits.append(f"novel+={rec['refill_novel']}")
     if rec.get("refill_inserted") is not None:
         bits.append(f"ins+={rec['refill_inserted']}")
+    if rec.get("epochs_on_device") is not None:
+        # Fused-hunt cadence: refills run on device, so each record is
+        # a per-MEGA-DISPATCH rollup — render that explicitly so an
+        # operator reading a sparse stream knows the hunt is not stuck.
+        bits.append(f"epochs_on_device={rec['epochs_on_device']} "
+                    "(per-mega-dispatch rollup)")
     surv = [(k[len("op_survived_"):], v) for k, v in rec.items()
             if k.startswith("op_survived_") and v]
     if surv:
@@ -419,10 +425,15 @@ def render_search_summary(search: List[dict]) -> List[str]:
     if not search:
         return []
     last = search[-1]
-    line = (f"search: {len(search)} refill(s), generation "
-            f"{last.get('generation', '?')}, corpus "
+    fused = last.get("epochs_on_device") is not None
+    line = (f"search: {len(search)} "
+            f"{'mega-dispatch rollup(s)' if fused else 'refill(s)'}, "
+            f"generation {last.get('generation', '?')}, corpus "
             f"{last.get('corpus_size', '?')} "
             f"({last.get('corpus_inserted', '?')} inserted)")
+    if fused:
+        line += (f"; fused=true — {last['epochs_on_device']} refill "
+                 "epoch(s) ran on device between pulls")
     surv = [(k[len("op_survived_"):], v) for k, v in last.items()
             if k.startswith("op_survived_")]
     if surv:

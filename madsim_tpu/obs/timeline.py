@@ -67,7 +67,7 @@ def trace_to_chrome(trace: Sequence[Dict[str, Any]], *,
             "ts": float(e.get("t_us", 0)), "pid": pid, "tid": tid,
             "args": {k: v for k, v in e.items()
                      if k in ("step", "src", "dst", "timer", "payload",
-                              "dropped", "bug_seen")},
+                              "dropped", "drop_cause", "bug_seen")},
         }
         events.append(ev)
         if e.get("bug_raised") and kind != "invariant":
@@ -87,6 +87,31 @@ def trace_to_chrome(trace: Sequence[Dict[str, Any]], *,
                       "clock": "virtual_us",
                       **({"seed": int(seed)} if seed is not None else {})},
     }
+
+
+def ring_to_chrome(entries: Sequence[Dict[str, Any]], *,
+                   seed: Optional[int] = None,
+                   label: Optional[str] = None,
+                   k: Optional[int] = None) -> Dict[str, Any]:
+    """Render a decoded flight-recorder ring (obs/blackbox.py
+    ``decode_ring`` / ``SweepResult.blackbox(seed)`` / a bundle's
+    ``madsim.blackbox/1`` ``events``) as a Chrome trace document.
+
+    Ring entries are trace-shaped, so the layout is exactly
+    :func:`trace_to_chrome`'s — one thread lane per destination node,
+    instants at virtual-time microseconds, the ``invariant:raise``
+    instant at the bug — plus the recorder provenance in ``otherData``
+    (``source: "blackbox"`` and the ring depth ``k``), so a timeline
+    reconstructed from K in-situ records is never mistaken for a full
+    replay trace (docs/observability.md "reading a black-box timeline").
+    """
+    name = label or (f"madsim blackbox seed {seed}" if seed is not None
+                     else "madsim blackbox")
+    doc = trace_to_chrome(entries, seed=seed, label=name)
+    doc["otherData"]["source"] = "blackbox"
+    if k is not None:
+        doc["otherData"]["blackbox_k"] = int(k)
+    return doc
 
 
 def polls_to_chrome(polls: Iterable[Tuple[int, int]], *,

@@ -551,6 +551,38 @@ class SweepResult:
             return None
         return {"per_seed": per_seed, "aggregate": aggregate_metrics(per_seed)}
 
+    def blackbox(self, seed: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Decode one seed's flight-recorder ring (obs/blackbox.py) into
+        trace-shaped event records — the last K step events of that
+        world, oldest first, with the ``invariant`` raise in place.
+
+        ``seed`` defaults to the first failing seed. Raises
+        ``ValueError`` on a blackbox-off sweep (run with
+        ``EngineConfig(blackbox=K)``) or an unknown seed. Render with
+        ``obs.timeline.ring_to_chrome`` or crosscheck against a fresh
+        ``trace()`` via ``obs.blackbox.ring_matches_trace`` (the
+        ``obs replay --crosscheck`` CLI leg)."""
+        from ..obs.blackbox import decode_ring, rings_from_observations
+
+        rings = rings_from_observations(self.observations)
+        if rings is None:
+            raise ValueError(
+                "this sweep ran blackbox-off: enable the flight recorder "
+                "with EngineConfig(blackbox=K) (docs/observability.md)")
+        if seed is None:
+            if not self.failing_seeds:
+                raise ValueError("no failing seeds — pass an explicit "
+                                 "seed= to decode a passing world's ring")
+            seed = self.failing_seeds[0]
+        rows = np.flatnonzero(np.asarray(self.seeds) == np.uint64(seed))
+        if rows.size == 0:
+            raise ValueError(f"seed {seed} was not part of this sweep")
+        row = int(rows[0])
+        actor = getattr(getattr(self.triage_ctx, "engine", None),
+                        "actor", None)
+        return decode_ring({k: v[row] for k, v in rings.items()},
+                           kind_names=getattr(actor, "kind_names", None))
+
     def summary(self) -> str:
         """One human paragraph of what the sweep did — seeds, bugs,
         utilization, coverage, top drop causes — so operators read prose
@@ -595,6 +627,11 @@ class SweepResult:
             if drops:
                 parts.append("top drop causes: " + ", ".join(
                     f"{k[5:]}={v}" for k, v in drops[:3]))
+        from ..obs.blackbox import ring_depth
+
+        k_ring = ring_depth(self.observations)
+        parts.append(f"black box: last {k_ring} events/world recorded"
+                     if k_ring is not None else "black box: off")
         return "; ".join(parts) + "."
 
     def repro_banner(self) -> Optional[str]:
@@ -611,6 +648,13 @@ class SweepResult:
             banner += (f"\nnote: fault-schedule sha256: "
                        f"{self.faults_sha256[:16]} (replay must use the "
                        "same schedule)")
+        from ..obs.blackbox import ring_depth
+
+        k_ring = ring_depth(self.observations)
+        banner += ("\nnote: flight recorder "
+                   + (f"K={k_ring} (SweepResult.blackbox(seed) decodes "
+                      "the failing world's last events)" if k_ring
+                      else "off (enable with EngineConfig(blackbox=K))"))
         return banner
 
 
@@ -1363,6 +1407,12 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
             "refill_novel": search_host["refill_novel"],
             "refill_inserted": search_host["refill_inserted"],
         }
+        if "epochs_on_device" in search_host:
+            # Fused hunt: refills run ON DEVICE, so this record is the
+            # per-MEGA-DISPATCH rollup of the last device refill, not a
+            # per-refill sample. The label lets `obs watch` render the
+            # collapsed cadence explicitly (docs/observability.md).
+            rec["epochs_on_device"] = search_host["epochs_on_device"]
         if op_h is not None:
             for row, vals in zip(("produced", "novel", "survived"), op_h):
                 arr = np.asarray(vals)
@@ -1708,6 +1758,7 @@ def sweep(actor: Any, cfg: EngineConfig, seeds, faults: Optional[np.ndarray] = N
                         search_host["gen"] = int(stats_h[2])
                         search_host["refill_novel"] = int(stats_h[3])
                         search_host["refill_inserted"] = int(stats_h[4])
+                    search_host["epochs_on_device"] = int(ep_h)
                     emit_search_point(None)
                 if int(ep_h) > 0:
                     reordered = True

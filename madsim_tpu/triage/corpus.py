@@ -220,6 +220,17 @@ def triage(result, out_dir: Optional[str] = None, *,
             from ..analysis.speclint import protocol_card
 
             extra["protocol_card"] = protocol_card(spec)
+        bb = _class_blackbox(result, fc)
+        if bb is not None:
+            # Blackbox-on sweep: attach the representative's decoded
+            # flight-recorder ring (madsim.blackbox/1). The block
+            # carries its OWN replay recipe — the ORIGINAL schedule
+            # rows the ring was recorded under plus the world's final
+            # step count — because the bundle's top-level rows are the
+            # MINIMIZED schedule, which replays the bug but not the
+            # recorded execution. `obs replay --crosscheck` uses the
+            # block's recipe to verify ring == trace suffix, bitwise.
+            extra["blackbox"] = bb
         bundles[fc.key] = write_sweep_bundle(
             out_dir, seed=fc.representative, actor=info["actor"],
             actor_config=info["actor_config"], engine_config=ecfg,
@@ -251,6 +262,37 @@ def _class_lineage(result, fc: FailureClass) -> Optional[Dict[str, Any]]:
         return None
     return lineage_block(lin, int(rows[0]), seeds=np.asarray(result.seeds),
                          stats=rep.operator_stats)
+
+
+def _class_blackbox(result, fc: FailureClass) -> Optional[Dict[str, Any]]:
+    """The representative's ``madsim.blackbox/1`` block (obs/blackbox.py)
+    for a blackbox-on sweep: the decoded in-situ event ring plus the
+    self-contained replay recipe (RAW original schedule rows — NOT
+    compacted/normalized, which could reorder equal-time pushes and
+    break the bitwise ring == trace-suffix contract — and the world's
+    final step count). None when the sweep ran blackbox-off."""
+    from ..obs.blackbox import blackbox_block, ring_depth
+
+    obs = result.observations
+    k = ring_depth(obs)
+    if k is None:
+        return None
+    rows = np.flatnonzero(
+        np.asarray(result.seeds) == np.uint64(fc.representative))
+    if rows.size == 0:
+        return None
+    row = int(rows[0])
+    ctx = getattr(result, "triage_ctx", None)
+    frows = None
+    if ctx is not None and ctx.faults is not None:
+        frows = np.asarray(ctx.faults, np.int32)
+        if frows.ndim == 3:
+            frows = frows[row]
+    entries = result.blackbox(seed=fc.representative)
+    return blackbox_block(
+        entries, seed=fc.representative, k=k,
+        pos=int(np.asarray(obs["bb_pos"])[row]),
+        steps=int(np.asarray(obs["steps"])[row]), faults=frows)
 
 
 def _class_schedule(result, fc: FailureClass) -> Optional[np.ndarray]:

@@ -88,6 +88,26 @@ def engines():
     return out
 
 
+@pytest.fixture(scope="module")
+def off_sweeps(engines):
+    """Memoized recorder-off reference sweeps, shared by the metrics and
+    blackbox bitwise matrices (both compare against the IDENTICAL
+    off-engine run: same engine instance, seeds 0..39, chunk_steps=64,
+    max_steps=3000, family fault template, same orchestration kwargs)."""
+    cache = {}
+
+    def get(family, mode):
+        if (family, mode) not in cache:
+            eng_off, _on, faults = engines[family]
+            kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+                      **_BB_MODES[mode])
+            cache[(family, mode)] = sweep(None, eng_off.cfg, np.arange(40),
+                                          engine=eng_off, **kw)
+        return cache[(family, mode)]
+
+    return get
+
+
 def test_fault_hist_width_matches_engine_op_range():
     # obs/metrics.py must not import the engine (the engine imports it),
     # so the histogram width is pinned by this assertion instead.
@@ -100,12 +120,13 @@ def test_fault_hist_width_matches_engine_op_range():
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
 @pytest.mark.parametrize("mode", sorted(_MODES))
-def test_metrics_on_sweep_bitwise_identical(engines, family, mode):
+def test_metrics_on_sweep_bitwise_identical(engines, off_sweeps, family,
+                                            mode):
     eng_off, eng_on, faults = engines[family]
     seeds = np.arange(40)
     kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
               **_MODES[mode])
-    res_off = sweep(None, eng_off.cfg, seeds, engine=eng_off, **kw)
+    res_off = off_sweeps(family, mode)
     res_on = sweep(None, eng_on.cfg, seeds, engine=eng_on, **kw)
     # Every non-metrics observation bitwise equal, same occupancy story.
     assert not any(k.startswith("m_") for k in res_off.observations)
@@ -548,3 +569,291 @@ def test_bridge_metrics_block_is_trajectory_invisible():
     import json as _json
 
     _json.dumps(cov)  # plain JSON: the bench sim_metrics sibling record
+
+
+# ---------------------------------------------------------------------------
+# The flight recorder (obs/blackbox.py + EngineConfig(blackbox=K))
+# ---------------------------------------------------------------------------
+
+BB_FIELDS = {"bb_pos", "bb_step_lo", "bb_t_lo", "bb_t_hi",
+             "bb_kind", "bb_src", "bb_dst", "bb_flags"}
+
+# The blackbox matrix adds the whole-hunt fused mode: the ring must ride
+# the fused loop's per-seed retirement buffers and final scatter exactly
+# like the host-orchestrated modes (parallel/sweep.py _fused_hunt).
+_BB_MODES = {**_MODES,
+             "fused": dict(recycle=True, batch_worlds=16, fused=True)}
+
+
+@pytest.fixture(scope="module")
+def bb_engines():
+    """One blackbox-on engine per family (K=8 — small enough that every
+    surviving world wraps the ring inside the 3k-step budget)."""
+    out = {}
+    for name, (make_actor, cfg, faults) in _FAMILIES.items():
+        out[name] = (DeviceEngine(make_actor(),
+                                  dataclasses.replace(cfg, blackbox=8)),
+                     faults)
+    return out
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+@pytest.mark.parametrize("mode", sorted(_BB_MODES))
+def test_blackbox_on_sweep_bitwise_identical(engines, bb_engines,
+                                             off_sweeps, family, mode):
+    """Tier-1, the metrics contract replayed for the flight recorder: a
+    blackbox-on sweep walks bit-identical trajectories to blackbox-off
+    on every result surface, for every family across plain / recycled /
+    pipelined / fused orchestration, and the ONLY additional observation
+    keys are the eight ``bb_*`` ring lanes."""
+    eng_off, _on, faults = engines[family]
+    eng_bb, _ = bb_engines[family]
+    seeds = np.arange(40)
+    kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+              **_BB_MODES[mode])
+    res_off = off_sweeps(family, mode)
+    res_bb = sweep(None, eng_bb.cfg, seeds, engine=eng_bb, **kw)
+    assert set(res_bb.observations) - set(res_off.observations) == BB_FIELDS
+    for k, v in res_off.observations.items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(res_bb.observations[k]),
+                                      err_msg=k)
+    np.testing.assert_array_equal(res_off.n_active_history,
+                                  res_bb.n_active_history)
+    assert res_off.failing_seeds == res_bb.failing_seeds
+    assert res_off.steps_run == res_bb.steps_run
+    # Off surfaces refuse politely; on surfaces decode. A failing
+    # world's ring ends at the invariant raise (stop_on_bug default).
+    with pytest.raises(ValueError, match="blackbox-off"):
+        res_off.blackbox()
+    if res_bb.failing_seeds:
+        ring = res_bb.blackbox()
+        assert ring and ring[-1]["kind"] != "truncated"
+        assert ring[-1].get("bug_raised")
+
+
+def test_blackbox_ring_wraps_and_matches_trace_suffix(engines, bb_engines):
+    """The bitwise ring == trace-suffix contract, past the wrap point:
+    with K=8 every surviving world records more than K events, so the
+    decoded ring must equal exactly the LAST K entries of the replayed
+    ``trace()`` — same dicts, with ``total`` pinning the event count the
+    world really processed (a dropped or phantom event cannot hide)."""
+    from madsim_tpu.obs import ring_matches_trace
+    from madsim_tpu.obs.blackbox import rings_from_observations
+
+    eng_off, _on, faults = engines["raft"]
+    eng_bb, _ = bb_engines["raft"]
+    seeds = np.arange(12)
+    res = sweep(None, eng_bb.cfg, seeds, engine=eng_bb, chunk_steps=64,
+                max_steps=3_000, faults=faults)
+    pos = np.asarray(res.observations["bb_pos"])
+    assert (pos > 8).any(), "no world wrapped the K=8 ring"
+    rows = [int(np.argmax(pos > 8))]
+    if res.failing_seeds:
+        rows.append(int(np.argmax(np.asarray(res.seeds)
+                                  == np.uint64(res.failing_seeds[0]))))
+    for row in rows:
+        seed = int(np.asarray(res.seeds)[row])
+        ring = res.blackbox(seed)
+        assert len(ring) == min(int(pos[row]), 8)
+        trace = eng_off.trace(seed, max_steps=3_000, faults=faults)
+        err = ring_matches_trace(ring, trace, total=int(pos[row]))
+        assert err is None, err
+    # decode_ring validates the step lane against the reconstructed
+    # indices: a torn ring raises instead of rendering a wrong timeline.
+    from madsim_tpu.obs import decode_ring
+
+    rings = rings_from_observations(res.observations)
+    one = {k: np.array(v[rows[0]]) for k, v in rings.items()}
+    one["step_lo"] = np.array(one["step_lo"])
+    one["step_lo"][0] += 1
+    with pytest.raises(ValueError, match="torn"):
+        decode_ring(one)
+
+
+def test_blackbox_survives_checkpoint_resume_and_refuses_mixup(
+        engines, bb_engines, tmp_path):
+    """Rings ride the checkpoint as WorldState leaves: a resumed
+    blackbox-on sweep reproduces the unbroken run's ring lanes bit for
+    bit; resuming a blackbox-on checkpoint with a blackbox-off engine
+    (or vice versa) is a CheckpointError, not a silent shape surprise."""
+    from madsim_tpu.engine.checkpoint import CheckpointError
+
+    eng_bb, faults = bb_engines["raft"]
+    _off, eng_on, _ = engines["raft"]
+    seeds = np.arange(24)
+    full = sweep(None, eng_bb.cfg, seeds, engine=eng_bb, chunk_steps=128,
+                 max_steps=3_000, faults=faults)
+    path = str(tmp_path / "bb.npz")
+    sweep(None, eng_bb.cfg, seeds, engine=eng_bb, chunk_steps=128,
+          max_steps=256, faults=faults, checkpoint_path=path,
+          checkpoint_every_chunks=1)
+    with pytest.raises(CheckpointError, match="different engine config"):
+        sweep(None, eng_on.cfg, seeds, engine=eng_on, chunk_steps=128,
+              max_steps=3_000, faults=faults, checkpoint_path=path,
+              resume=True)
+    resumed = sweep(None, eng_bb.cfg, seeds, engine=eng_bb,
+                    chunk_steps=128, max_steps=3_000, faults=faults,
+                    checkpoint_path=path, resume=True)
+    for k in sorted(BB_FIELDS | set(full.observations)):
+        np.testing.assert_array_equal(full.observations[k],
+                                      resumed.observations[k], err_msg=k)
+
+
+def test_blackbox_adds_zero_fetches(engines, bb_engines, monkeypatch):
+    """Sync discipline: the ring reaches the host entirely through the
+    retirement pull and the final merge — a blackbox-on sweep performs
+    exactly as many ``_fetch`` calls as the blackbox-off twin."""
+    import importlib
+
+    sweep_mod = importlib.import_module("madsim_tpu.parallel.sweep")
+    eng_off, _on, faults = engines["raft"]
+    eng_bb, _ = bb_engines["raft"]
+    counts = []
+    real_fetch = sweep_mod._fetch
+
+    def counting_fetch(tree):
+        counts.append(1)
+        return real_fetch(tree)
+
+    monkeypatch.setattr(sweep_mod, "_fetch", counting_fetch)
+    kw = dict(chunk_steps=64, max_steps=3_000, faults=faults,
+              pipeline=True)
+    res_off = sweep(None, eng_off.cfg, np.arange(40), engine=eng_off, **kw)
+    n_off = len(counts)
+    counts.clear()
+    res_bb = sweep(None, eng_bb.cfg, np.arange(40), engine=eng_bb, **kw)
+    assert len(counts) == n_off
+    assert res_bb.loop_stats["scalar_fetches"] == \
+        res_off.loop_stats["scalar_fetches"]
+    assert res_bb.loop_stats["retire_fetches"] == \
+        res_off.loop_stats["retire_fetches"]
+
+
+def test_blackbox_off_compiles_pre_blackbox_program():
+    """blackbox-off is not merely cheap — it is the SAME program: the
+    off engine's state carries no ring residue (the ``blackbox`` leaf is
+    an empty pytree subtree) and its compiled run reproduces the budget
+    ledger's ``engine.run`` measurement exactly (flops and argument
+    bytes), while the K=64 twin reproduces ``engine.run_blackbox`` —
+    both regenerated by tools/update_budgets.py in the blackbox PR."""
+    from madsim_tpu.analysis import budgets as _budgets
+
+    ledger = _budgets.load_ledger()
+    rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
+    cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
+                       t_limit_us=2_000_000, stop_on_bug=False)
+    measured = {}
+    for name, blackbox in (("engine.run", 0), ("engine.run_blackbox", 64)):
+        eng = DeviceEngine(RaftActor(rcfg),
+                           dataclasses.replace(cfg, blackbox=blackbox))
+        state = eng.init(np.arange(256))
+        if not blackbox:
+            assert state.blackbox is None
+        comp = _budgets.compile_fresh(eng._run.lower(state, 4_000))
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        entry = ledger["programs"][name]
+        assert float(ca["flops"]) == entry["flops"]["measured"], name
+        ma = comp.memory_analysis()
+        assert int(ma.argument_size_in_bytes) == entry["arg_bytes"], name
+        measured[name] = float(ca["flops"])
+    assert measured["engine.run_blackbox"] > measured["engine.run"]
+
+
+@pytest.mark.slow
+def test_triage_bundle_carries_ring_and_cli_crosschecks(engines, bb_engines,
+                                                        tmp_path, capsys):
+    """Triage round trip: a blackbox-on sweep's class bundle carries the
+    ``madsim.blackbox/1`` block whose decoded ring ends at the invariant
+    raise, and ``obs replay --bundle --crosscheck`` verifies ring ==
+    replayed-trace suffix bitwise (exit 0; exit 1 once tampered).
+
+    Marked slow (the CLI replay legs recompile the replay engine): the
+    fresh-process CLI contract runs in CI via ``make replay-demo``, and
+    the tier-1 guided-hunt test keeps the bundle-block + ring-tail +
+    crosscheck coverage."""
+    from madsim_tpu.triage import triage
+
+    make_actor, cfg, faults = _FAMILIES["raft"]
+    # Triage buckets by the MetricsBlock behavior signature, so this
+    # engine runs both recorders: metrics AND the ring.
+    eng_bb = DeviceEngine(make_actor(),
+                          dataclasses.replace(cfg, metrics=True,
+                                              blackbox=8))
+    res = sweep(None, eng_bb.cfg, np.arange(64), engine=eng_bb,
+                chunk_steps=64, max_steps=3_000, faults=faults)
+    assert res.failing_seeds
+    rep = triage(res, out_dir=str(tmp_path), minimize=False,
+                 max_steps=3_000)
+    path = next(iter(rep.bundles.values()))
+    bundle = load_bundle(path)
+    block = bundle["extra"]["blackbox"]
+    assert block["schema"] == "madsim.blackbox/1"
+    assert block["k"] == 8 and block["n_records"] == len(block["events"])
+    assert block["events"][-1].get("bug_raised")
+    # The block replays against the ORIGINAL rows it recorded under,
+    # carried inside the block (the bundle's top-level rows may be
+    # minimized) — here the shared template.
+    np.testing.assert_array_equal(np.asarray(block["faults"], np.int32),
+                                  faults)
+    out = str(tmp_path / "t.json")
+    assert obs_main(["replay", "--bundle", path, "--crosscheck",
+                     "--out", out]) == 0
+    capsys.readouterr()
+    bundle["extra"]["blackbox"]["events"][-1]["t_us"] += 1
+    with open(path, "w") as f:
+        json.dump(bundle, f)
+    assert obs_main(["replay", "--bundle", path, "--crosscheck",
+                     "--out", out]) == 1
+    assert "DIVERGENCE" in capsys.readouterr().err
+
+
+def test_guided_hunt_blackbox_invisible_and_bundle_ring_ends_at_raise(
+        tmp_path):
+    """The acceptance pair: (1) the pinned guided pair hunt is bitwise
+    unchanged by the flight recorder — same finds, same corpus, same
+    schedules; (2) its triage bundle carries a decoded ring whose final
+    event is the invariant raise, replaying against the find's
+    MATERIALIZED schedule (the block's own recipe)."""
+    from madsim_tpu.obs import ring_matches_trace
+    from madsim_tpu.search import (
+        GuidedPairActor, GuidedPairConfig, engine_config, family_schedule,
+    )
+    from madsim_tpu.search.family import (
+        HUNT_NODES, HUNT_ROWS, hunt_search_config,
+    )
+    from madsim_tpu.triage import triage
+
+    acfg = GuidedPairConfig(n=HUNT_NODES)
+    cfg = engine_config(acfg)
+    tmpl = family_schedule(HUNT_ROWS, acfg)
+    kw = dict(faults=tmpl, max_steps=10_000_000, recycle=True,
+              batch_worlds=32, chunk_steps=32, stop_on_first_bug=True,
+              search=hunt_search_config())
+    eng_off = DeviceEngine(GuidedPairActor(acfg), cfg)
+    eng_bb = DeviceEngine(GuidedPairActor(acfg),
+                          dataclasses.replace(cfg, blackbox=8))
+    res_off = sweep(None, eng_off.cfg, np.arange(128), engine=eng_off, **kw)
+    res_bb = sweep(None, eng_bb.cfg, np.arange(128), engine=eng_bb, **kw)
+    assert res_bb.failing_seeds == res_off.failing_seeds
+    assert res_bb.failing_seeds, "guided hunt missed the bug in budget"
+    np.testing.assert_array_equal(res_bb.search.schedules,
+                                  res_off.search.schedules)
+    assert set(res_bb.observations) - set(res_off.observations) == BB_FIELDS
+    for k, v in res_off.observations.items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(res_bb.observations[k]),
+                                      err_msg=k)
+    rep = triage(res_bb, out_dir=str(tmp_path), minimize=False,
+                 max_steps=20_000)
+    bundle = load_bundle(next(iter(rep.bundles.values())))
+    block = bundle["extra"]["blackbox"]
+    assert block["events"][-1].get("bug_raised")
+    # In-process crosscheck on the block's own recipe: the recorded
+    # ring is bitwise the suffix of the re-traced materialized schedule.
+    trace = eng_off.trace(block["seed"], max_steps=block["steps"],
+                          faults=np.asarray(block["faults"], np.int32))
+    err = ring_matches_trace(block["events"], trace, total=block["n_total"])
+    assert err is None, err

@@ -391,3 +391,26 @@ def test_bench_diff_loads_wrapper_shapes(tmp_path):
                                "tail": "…cut} {also-not-json"}))
     with pytest.raises(ValueError, match="no parsable result"):
         bd.load_round(str(bad))
+
+
+def test_watch_renders_fused_search_cadence():
+    """Fused-hunt search telemetry (docs/observability.md "Fused-sweep
+    cadence"): records labeled with ``epochs_on_device`` render as
+    explicit per-mega-dispatch rollups, and the summary rollup notes
+    ``fused=true`` — while unlabeled (host-refill) records keep the
+    per-refill rendering."""
+    fused_rec = {"schema": "madsim.search.telemetry/1", "event": "refill",
+                 "elapsed_s": 1.25, "generation": 3, "corpus_size": 17,
+                 "corpus_inserted": 16, "refill_novel": 2,
+                 "refill_inserted": 2, "epochs_on_device": 5}
+    host_rec = {k: v for k, v in fused_rec.items()
+                if k != "epochs_on_device"}
+    line = observatory.render_search_event(fused_rec)
+    assert "epochs_on_device=5 (per-mega-dispatch rollup)" in line
+    assert "epochs_on_device" not in \
+        observatory.render_search_event(host_rec)
+    rollup = "\n".join(observatory.render_search_summary([fused_rec]))
+    assert "fused=true" in rollup and "mega-dispatch rollup" in rollup
+    assert "5 refill epoch(s) ran on device" in rollup
+    host_rollup = "\n".join(observatory.render_search_summary([host_rec]))
+    assert "fused" not in host_rollup and "refill(s)" in host_rollup

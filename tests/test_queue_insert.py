@@ -66,7 +66,7 @@ def test_push_many_matches_sequential_chain_randomized():
     insert must reproduce the chain's slot assignment, ok flags, and
     inserted count exactly."""
     rng = np.random.default_rng(0)
-    for trial in range(120):
+    for trial in range(60):
         cap = int(rng.integers(2, 70))
         m = int(rng.integers(1, 9))
         p = int(rng.integers(1, 5))

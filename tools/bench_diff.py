@@ -89,6 +89,18 @@ METRICS: List[Tuple[str, str, bool]] = [
     ("ttfb fused dispatch reduction",
      "configs.time_to_first_bug.recycled_hunt.fused_dispatch_reduction",
      True),
+    # Flight-recorder pricing (docs/observability.md "The flight
+    # recorder"): the K=64 ring's on-vs-off deltas — state bytes added
+    # per world, ring-write flops, and the seeds/s tax (ratio, higher is
+    # cheaper). The off legs stay the exact pre-blackbox program.
+    ("ttfb blackbox state B/world +",
+     "configs.time_to_first_bug.blackbox.state_bytes_per_world_delta",
+     False),
+    ("ttfb blackbox flops/world-step +",
+     "configs.time_to_first_bug.blackbox.flops_per_world_step_delta",
+     False),
+    ("ttfb blackbox seeds/s ratio",
+     "configs.time_to_first_bug.blackbox.seeds_per_sec_ratio", True),
     ("5node seeds/dispatch",
      "configs.madraft_5node.sweep_loop.seeds_per_dispatch", True),
     ("ttfb distinct behaviors",

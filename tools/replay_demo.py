@@ -6,13 +6,19 @@ workflow"). Exercises the whole failure-observability loop on a known
 buggy config:
 
 1. sweep the double-vote Raft bug over a small seed batch
-   (metrics-on — the per-seed frames are printed for the failing seed);
+   (metrics-on, flight recorder aboard — the per-seed frames and the
+   failing world's decoded black-box ring are printed);
 2. write a device-sweep repro bundle for the first failing seed
-   (obs/bundle.py);
-3. replay it with ``python -m madsim_tpu.obs replay --bundle`` in a
-   fresh process (the CLI contract, not the in-process library);
+   carrying the ``madsim.blackbox/1`` block (obs/bundle.py,
+   obs/blackbox.py);
+3. replay it with ``python -m madsim_tpu.obs replay --bundle
+   --crosscheck`` in a fresh process (the CLI contract, not the
+   in-process library) — the crosscheck verifies the recorded ring is
+   bitwise the suffix of the replayed trace;
 4. validate the exported Chrome trace-event JSON: parseable, non-empty,
-   and its final event is the invariant raise.
+   and its final event is the invariant raise;
+5. tamper with one recorded ring event and assert the crosscheck now
+   exits nonzero — divergence must be loud, not a warning.
 
 Exits nonzero on any failed expectation.
 """
@@ -24,18 +30,22 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+BLACKBOX_K = 16
+
 
 def main() -> int:
     import numpy as np
 
     from madsim_tpu.engine import (DeviceEngine, EngineConfig, RaftActor,
                                    RaftDeviceConfig)
+    from madsim_tpu.obs.blackbox import blackbox_block
     from madsim_tpu.obs.bundle import write_sweep_bundle
     from madsim_tpu.parallel.sweep import sweep
 
     rcfg = RaftDeviceConfig(n=3, buggy_double_vote=True)
     cfg = EngineConfig(n_nodes=3, outbox_cap=4, queue_cap=64,
-                       t_limit_us=2_000_000, metrics=True)
+                       t_limit_us=2_000_000, metrics=True,
+                       blackbox=BLACKBOX_K)
     eng = DeviceEngine(RaftActor(rcfg), cfg)
     res = sweep(None, cfg, np.arange(256), engine=eng, chunk_steps=64,
                 max_steps=4_000)
@@ -51,16 +61,33 @@ def main() -> int:
           + ", ".join(f"{k}={int(np.asarray(v)[row])}"
                       for k, v in sorted(frames.items())
                       if np.asarray(v).ndim == 1), file=sys.stderr)
+    ring = res.blackbox(seed)
+    print(f"replay-demo: failing seed {seed} black box "
+          f"(last {len(ring)} events):", file=sys.stderr)
+    for e in ring:
+        print(f"  step {e['step']:>4}  t={e['t_us']:>8} µs  {e['kind']}"
+              + (" *** RAISE ***" if e.get("bug_raised") else ""),
+              file=sys.stderr)
+    if not ring or not ring[-1].get("bug_raised"):
+        print("replay-demo: the failing world's ring does not end at the "
+              "invariant raise", file=sys.stderr)
+        return 1
 
     with tempfile.TemporaryDirectory() as td:
+        block = blackbox_block(
+            ring, seed=seed, k=BLACKBOX_K,
+            pos=int(np.asarray(res.observations["bb_pos"])[row]),
+            steps=int(np.asarray(res.observations["steps"])[row]),
+            faults=None)
         bundle_path = write_sweep_bundle(
             td, seed=seed, actor="raft", actor_config=rcfg,
             engine_config=cfg, max_steps=4_000,
-            error="RaftInvariantViolation: double vote")
+            error="RaftInvariantViolation: double vote",
+            extra={"blackbox": block})
         trace_path = os.path.join(td, "trace.json")
         proc = subprocess.run(
             [sys.executable, "-m", "madsim_tpu.obs", "replay",
-             "--bundle", bundle_path, "--out", trace_path],
+             "--bundle", bundle_path, "--crosscheck", "--out", trace_path],
             env={**os.environ}, capture_output=True, text=True)
         sys.stderr.write(proc.stderr)
         if proc.returncode != 0:
@@ -78,7 +105,29 @@ def main() -> int:
                   "the invariant raise", file=sys.stderr)
             return 1
         print(f"replay-demo ok: seed {seed} replayed, {len(events)} trace "
-              f"events, invariant raise at t={final['ts']:.0f} µs")
+              f"events, invariant raise at t={final['ts']:.0f} µs, ring "
+              "crosschecked bitwise")
+
+        # Divergence leg: corrupt one recorded event, re-run the
+        # crosscheck, demand a loud nonzero exit.
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+        bundle["extra"]["blackbox"]["events"][-1]["t_us"] += 1
+        with open(bundle_path, "w") as f:
+            json.dump(bundle, f)
+        proc = subprocess.run(
+            [sys.executable, "-m", "madsim_tpu.obs", "replay",
+             "--bundle", bundle_path, "--crosscheck",
+             "--out", os.path.join(td, "trace2.json")],
+            env={**os.environ}, capture_output=True, text=True)
+        if proc.returncode != 1:
+            print(f"replay-demo: tampered ring crosscheck exited "
+                  f"rc={proc.returncode}, expected 1 (divergence must be "
+                  "loud)", file=sys.stderr)
+            sys.stderr.write(proc.stderr)
+            return 1
+        print("replay-demo ok: tampered ring detected "
+              "(crosscheck exit 1)")
     return 0
 
 
